@@ -1,0 +1,21 @@
+"""jimm_tpu — a TPU-native image-model framework (ViT / CLIP / SigLIP).
+
+TPU-first rebuild of the capabilities of `pythoncrazy/jimm`: flax-NNX models
+with scanned layer stacks, logical-axis sharding policies over `jax.sharding`
+meshes, pure-safetensors HuggingFace checkpoint loading (zero torch), Pallas
+flash attention, and distributed contrastive training with a ring sigmoid
+loss.
+"""
+
+from jimm_tpu.configs import (CLIPConfig, SigLIPConfig, TextConfig,
+                              TransformerConfig, ViTConfig, VisionConfig,
+                              PRESETS, preset)
+from jimm_tpu.models import CLIP, SigLIP, VisionTransformer
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CLIP", "SigLIP", "VisionTransformer",
+    "CLIPConfig", "SigLIPConfig", "ViTConfig", "VisionConfig", "TextConfig",
+    "TransformerConfig", "PRESETS", "preset",
+]

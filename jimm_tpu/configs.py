@@ -1,0 +1,245 @@
+"""Model configuration dataclasses and named presets.
+
+The reference (`/root/reference`) derives hyperparameters ad-hoc from HF
+`config.json` keys or shape inference scattered through each model's
+`from_pretrained` (e.g. `src/jimm/models/vit.py:131-164`). Here every model is
+driven by one frozen dataclass so presets, checkpoint inference, and CLI flags
+all land in the same place.
+
+Parity-critical defaults are documented per field with the reference citation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Literal
+
+Pooling = Literal["cls", "map", "last", "eot", "none"]
+Activation = Literal["gelu", "gelu_tanh", "quick_gelu"]
+AttnImpl = Literal["auto", "xla", "flash"]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    """Shared encoder-stack hyperparameters (vision or text tower)."""
+
+    width: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072  # read from config, NOT hardcoded 4x (ref limitation, SURVEY §2.4)
+    act: Activation = "gelu"
+    ln_eps: float = 1e-6
+    dropout: float = 0.0
+    causal: bool = False
+    attn_impl: AttnImpl = "auto"
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.width // self.num_heads
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    """Vision tower. Mirrors `src/jimm/common/vit.py:104-248` behavior.
+
+    - ``pre_norm``: CLIP applies an extra LayerNorm after embeddings and skips
+      embedding dropout (ref `common/vit.py:181-190,238-241`).
+    - ``patch_bias``: CLIP's patch conv has no bias (ref `models/clip.py:66`).
+    - ``pooling``: "cls" (ViT/CLIP) or "map" (SigLIP MAP head,
+      ref `common/vit.py:12-101`) or "none" (return full sequence).
+    """
+
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    width: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    act: Activation = "gelu"
+    ln_eps: float = 1e-6
+    dropout: float = 0.0
+    pooling: Pooling = "cls"
+    pre_norm: bool = False
+    patch_bias: bool = True
+    attn_impl: AttnImpl = "auto"
+    remat: bool = False
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def num_patches(self) -> int:
+        return self.grid * self.grid
+
+    @property
+    def seq_len(self) -> int:
+        return self.num_patches + (1 if self.pooling == "cls" else 0)
+
+    def encoder(self) -> TransformerConfig:
+        return TransformerConfig(
+            width=self.width, depth=self.depth, num_heads=self.num_heads,
+            mlp_dim=self.mlp_dim, act=self.act, ln_eps=self.ln_eps,
+            dropout=self.dropout, causal=False, attn_impl=self.attn_impl,
+            remat=self.remat,
+        )
+
+
+@dataclass(frozen=True)
+class TextConfig:
+    """Text tower. CLIP: causal + EOT-argmax pooling (ref `models/clip.py:92-104,
+    164-166`). SigLIP: bidirectional + last-token pooling (ref
+    `models/siglip.py:79-91,151-152`)."""
+
+    vocab_size: int = 49408
+    context_length: int = 77
+    width: int = 512
+    depth: int = 12
+    num_heads: int = 8
+    mlp_dim: int = 2048
+    act: Activation = "quick_gelu"
+    ln_eps: float = 1e-5
+    dropout: float = 0.0
+    causal: bool = True
+    pooling: Pooling = "eot"
+    proj_bias: bool = False  # CLIP text_projection is bias-free; SigLIP head has bias
+    attn_impl: AttnImpl = "auto"
+    remat: bool = False
+
+    def encoder(self) -> TransformerConfig:
+        return TransformerConfig(
+            width=self.width, depth=self.depth, num_heads=self.num_heads,
+            mlp_dim=self.mlp_dim, act=self.act, ln_eps=self.ln_eps,
+            dropout=self.dropout, causal=self.causal, attn_impl=self.attn_impl,
+            remat=self.remat,
+        )
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    """ViT image classifier (ref `models/vit.py:16-103`): post-norm backbone,
+    CLS pooling, LN eps 1e-12 (ref `models/vit.py:73`), optional linear head."""
+
+    vision: VisionConfig = field(default_factory=lambda: VisionConfig(ln_eps=1e-12))
+    num_classes: int = 1000
+    do_classification: bool = True
+
+
+@dataclass(frozen=True)
+class CLIPConfig:
+    """CLIP dual tower (ref `models/clip.py:15-188`): pre-norm QuickGELU vision
+    tower without patch bias, causal text tower, bias-free projections,
+    learned ``logit_scale``."""
+
+    vision: VisionConfig = field(default_factory=lambda: VisionConfig(
+        width=768, depth=12, num_heads=12, mlp_dim=3072, act="quick_gelu",
+        ln_eps=1e-5, pooling="cls", pre_norm=True, patch_bias=False,
+        patch_size=32))
+    text: TextConfig = field(default_factory=TextConfig)
+    projection_dim: int = 512
+    logit_scale_init: float = 2.6592  # ln(1/0.07), OpenAI CLIP init
+
+
+@dataclass(frozen=True)
+class SigLIPConfig:
+    """SigLIP dual tower (ref `models/siglip.py:15-174`): MAP-pooled vision
+    tower (gelu_tanh, eps 1e-6), bidirectional text tower with last-token
+    pooling and biased projection, ``logit_scale`` AND ``logit_bias``."""
+
+    vision: VisionConfig = field(default_factory=lambda: VisionConfig(
+        image_size=256, patch_size=16, width=768, depth=12, num_heads=12,
+        mlp_dim=3072, act="gelu_tanh", ln_eps=1e-6, pooling="map",
+        pre_norm=False, patch_bias=True))
+    text: TextConfig = field(default_factory=lambda: TextConfig(
+        vocab_size=32000, context_length=64, width=768, depth=12, num_heads=12,
+        mlp_dim=3072, act="gelu_tanh", ln_eps=1e-6, causal=False,
+        pooling="last", proj_bias=True))
+    # SigLIP projects both towers to the (shared) text width, not a separate dim
+    projection_dim: int = 768
+    logit_scale_init: float = 2.3026  # ln(10), SigLIP paper init
+    logit_bias_init: float = -10.0
+
+
+def _vit(size: str, patch: int, image: int, classes: int = 1000) -> ViTConfig:
+    w, d, h, m = {
+        "T": (192, 12, 3, 768),
+        "S": (384, 12, 6, 1536),
+        "B": (768, 12, 12, 3072),
+        "L": (1024, 24, 16, 4096),
+        "H": (1280, 32, 16, 5120),
+        "g": (1408, 40, 16, 6144),
+        "G": (1664, 48, 16, 8192),
+    }[size]
+    return ViTConfig(
+        vision=VisionConfig(image_size=image, patch_size=patch, width=w,
+                            depth=d, num_heads=h, mlp_dim=m, ln_eps=1e-12),
+        num_classes=classes)
+
+
+def _siglip(size: str, patch: int, image: int, vocab: int = 32000,
+            ctx: int = 64) -> SigLIPConfig:
+    w, d, h, m = {
+        "B": (768, 12, 12, 3072),
+        "L": (1024, 24, 16, 4096),
+        "So400m": (1152, 27, 16, 4304),  # non-4x MLP: loadable here, not in ref
+    }[size]
+    return SigLIPConfig(
+        vision=VisionConfig(image_size=image, patch_size=patch, width=w, depth=d,
+                            num_heads=h, mlp_dim=m, act="gelu_tanh", ln_eps=1e-6,
+                            pooling="map"),
+        text=TextConfig(vocab_size=vocab, context_length=ctx, width=w, depth=d,
+                        num_heads=h, mlp_dim=m, act="gelu_tanh", ln_eps=1e-6,
+                        causal=False, pooling="last", proj_bias=True),
+        projection_dim=w)
+
+
+def _clip(vision_size: str, patch: int, image: int = 224) -> CLIPConfig:
+    vw, vd, vh, vm, proj = {
+        "B": (768, 12, 12, 3072, 512),
+        "L": (1024, 24, 16, 4096, 768),
+    }[vision_size]
+    tw, td, th, tm = {"B": (512, 12, 8, 2048), "L": (768, 12, 12, 3072)}[vision_size]
+    return CLIPConfig(
+        vision=VisionConfig(image_size=image, patch_size=patch, width=vw,
+                            depth=vd, num_heads=vh, mlp_dim=vm, act="quick_gelu",
+                            ln_eps=1e-5, pooling="cls", pre_norm=True,
+                            patch_bias=False),
+        text=TextConfig(vocab_size=49408, context_length=77, width=tw, depth=td,
+                        num_heads=th, mlp_dim=tm, act="quick_gelu", ln_eps=1e-5,
+                        causal=True, pooling="eot", proj_bias=False),
+        projection_dim=proj)
+
+
+#: Named presets covering the BASELINE.json tracked configs.
+PRESETS: dict[str, Any] = {
+    # ViT
+    "vit-tiny-patch16-224": _vit("T", 16, 224),
+    "vit-small-patch16-224": _vit("S", 16, 224),
+    "vit-base-patch16-224": _vit("B", 16, 224),
+    "vit-base-patch32-384": _vit("B", 32, 384),
+    "vit-large-patch16-384": _vit("L", 16, 384),
+    "vit-huge-patch14-224": _vit("H", 14, 224),
+    # CLIP
+    "clip-vit-base-patch32": _clip("B", 32),
+    "clip-vit-base-patch16": _clip("B", 16),
+    "clip-vit-large-patch14": _clip("L", 14),
+    "clip-vit-large-patch14-336": _clip("L", 14, 336),
+    # SigLIP
+    "siglip-base-patch16-224": _siglip("B", 16, 224),
+    "siglip-base-patch16-256": _siglip("B", 16, 256),
+    "siglip-base-patch16-384": _siglip("B", 16, 384),
+    "siglip-large-patch16-256": _siglip("L", 16, 256),
+    "siglip-large-patch16-384": _siglip("L", 16, 384),
+    "siglip-so400m-patch14-384": _siglip("So400m", 14, 384),
+    "siglip2-base-patch16-256": _siglip("B", 16, 256, vocab=256000),
+    "siglip2-large-patch16-512": _siglip("L", 16, 512, vocab=256000),
+}
+
+
+def preset(name: str, **overrides: Any):
+    """Fetch a named preset, optionally overriding top-level fields."""
+    cfg = PRESETS[name]
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg

@@ -1,0 +1,208 @@
+"""CLIP dual-tower model.
+
+Capability parity with `src/jimm/models/clip.py:15-416`: pre-norm QuickGELU
+vision tower without patch bias, causal text tower with EOT-argmax pooling,
+bias-free projections, learned ``logit_scale``; HF checkpoint loading with
+config parsing + shape inference. Returns ``logits_per_image`` like the
+reference ``__call__`` (ref `models/clip.py:169-188`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from jimm_tpu.configs import CLIPConfig, TextConfig, VisionConfig
+from jimm_tpu.nn.text import TextTower
+from jimm_tpu.nn.vision import VisionTower
+from jimm_tpu.parallel.sharding import (ShardingRules, TENSOR_PARALLEL,
+                                        logical, shard_model)
+from jimm_tpu.weights.loader import M, T, apply_mapping
+from jimm_tpu.weights.resolve import resolve_checkpoint
+
+
+def _scalar(w: np.ndarray) -> np.ndarray:
+    return np.asarray(w).reshape(())
+
+
+class CLIP(nnx.Module):
+    def __init__(self, config: CLIPConfig | None = None, *,
+                 rngs: nnx.Rngs | None = None,
+                 mesh: jax.sharding.Mesh | None = None,
+                 rules: ShardingRules | str = TENSOR_PARALLEL,
+                 dtype=None, param_dtype=jnp.float32):
+        cfg = config or CLIPConfig()
+        self.config = cfg
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.vision = VisionTower(cfg.vision, rngs, dtype=dtype,
+                                  param_dtype=param_dtype)
+        self.visual_projection = nnx.Linear(
+            cfg.vision.width, cfg.projection_dim, use_bias=False, dtype=dtype,
+            param_dtype=param_dtype,
+            kernel_init=logical(nnx.initializers.xavier_uniform(),
+                                "embed", "proj"),
+            rngs=rngs)
+        self.text = TextTower(cfg.text, rngs, dtype=dtype,
+                              param_dtype=param_dtype)
+        self.text_projection = nnx.Linear(
+            cfg.text.width, cfg.projection_dim, use_bias=False, dtype=dtype,
+            param_dtype=param_dtype,
+            kernel_init=logical(nnx.initializers.xavier_uniform(),
+                                "embed", "proj"),
+            rngs=rngs)
+        self.logit_scale = nnx.Param(jnp.asarray(cfg.logit_scale_init,
+                                                 dtype=param_dtype))
+        if mesh is not None:
+            shard_model(self, mesh, rules)
+
+    def encode_image(self, images: jax.Array) -> jax.Array:
+        """(B, H, W, C) -> unnormalized (B, projection_dim)."""
+        return self.visual_projection(self.vision(images))
+
+    def encode_text(self, text: jax.Array) -> jax.Array:
+        """(B, S) token ids -> unnormalized (B, projection_dim); pools at the
+        EOT token via argmax over ids (ref `models/clip.py:164-166`)."""
+        hidden = self.text(text)
+        return self.text_projection(self.text.pool(hidden, text))
+
+    def __call__(self, images: jax.Array, text: jax.Array) -> jax.Array:
+        img = self.encode_image(images)
+        txt = self.encode_text(text)
+        img = img / jnp.linalg.norm(img, axis=-1, keepdims=True)
+        txt = txt / jnp.linalg.norm(txt, axis=-1, keepdims=True)
+        scale = jnp.exp(self.logit_scale[...])
+        return scale * img @ txt.T  # logits_per_image
+
+    # ------------------------------------------------------------------
+    # Checkpoint loading
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def config_from_hf(config: dict[str, Any] | None,
+                       weights: dict[str, np.ndarray]) -> CLIPConfig:
+        if config and "vision_config" in config:
+            vc, tc = config["vision_config"], config["text_config"]
+            vision = VisionConfig(
+                image_size=vc.get("image_size", 224),
+                patch_size=vc.get("patch_size", 32),
+                width=vc.get("hidden_size", 768),
+                depth=vc.get("num_hidden_layers", 12),
+                num_heads=vc.get("num_attention_heads",
+                                 max(1, vc.get("hidden_size", 768) // 64)),
+                mlp_dim=vc.get("intermediate_size",
+                               4 * vc.get("hidden_size", 768)),
+                act=vc.get("hidden_act", "quick_gelu"),
+                ln_eps=vc.get("layer_norm_eps", 1e-5),
+                pooling="cls", pre_norm=True, patch_bias=False)
+            text = TextConfig(
+                vocab_size=tc.get("vocab_size", 49408),
+                context_length=tc.get("max_position_embeddings", 77),
+                width=tc.get("hidden_size", 512),
+                depth=tc.get("num_hidden_layers", 12),
+                num_heads=tc.get("num_attention_heads",
+                                 max(1, tc.get("hidden_size", 512) // 64)),
+                mlp_dim=tc.get("intermediate_size",
+                               4 * tc.get("hidden_size", 512)),
+                act=tc.get("hidden_act", "quick_gelu"),
+                ln_eps=tc.get("layer_norm_eps", 1e-5),
+                causal=True, pooling="eot", proj_bias=False)
+            return CLIPConfig(vision=vision, text=text,
+                              projection_dim=config.get("projection_dim", 512))
+        # shape inference (ref models/clip.py:208-247)
+        w = weights
+        v_width = w["vision_model.post_layernorm.weight"].shape[0]
+        t_width = w["text_model.final_layer_norm.weight"].shape[0]
+        v_depth = 1 + max(int(k.split(".")[3]) for k in w
+                          if k.startswith("vision_model.encoder.layers."))
+        t_depth = 1 + max(int(k.split(".")[3]) for k in w
+                          if k.startswith("text_model.encoder.layers."))
+        patch = w["vision_model.embeddings.patch_embedding.weight"].shape[-1]
+        n_pos = w["vision_model.embeddings.position_embedding.weight"].shape[0] - 1
+        image = int(round(n_pos ** 0.5)) * patch
+        vocab, _ = w["text_model.embeddings.token_embedding.weight"].shape
+        ctx = w["text_model.embeddings.position_embedding.weight"].shape[0]
+        proj = w["visual_projection.weight"].shape[0]
+        vision = VisionConfig(
+            image_size=image, patch_size=patch, width=v_width, depth=v_depth,
+            num_heads=max(1, v_width // 64),
+            mlp_dim=w["vision_model.encoder.layers.0.mlp.fc1.weight"].shape[0],
+            act="quick_gelu", ln_eps=1e-5, pooling="cls", pre_norm=True,
+            patch_bias=False)
+        text = TextConfig(
+            vocab_size=vocab, context_length=ctx, width=t_width, depth=t_depth,
+            num_heads=max(1, t_width // 64),
+            mlp_dim=w["text_model.encoder.layers.0.mlp.fc1.weight"].shape[0],
+            act="quick_gelu", ln_eps=1e-5, causal=True, pooling="eot",
+            proj_bias=False)
+        return CLIPConfig(vision=vision, text=text, projection_dim=proj)
+
+    @staticmethod
+    def hf_mapping(cfg: CLIPConfig) -> list[M]:
+        def tower(dst_prefix: str, src_prefix: str) -> list[M]:
+            p = src_prefix + "encoder.layers.{i}."
+            d = dst_prefix + "encoder.blocks."
+            return [
+                M(d + "ln1.scale", p + "layer_norm1.weight"),
+                M(d + "ln1.bias", p + "layer_norm1.bias"),
+                M(d + "attn.q.kernel", p + "self_attn.q_proj.weight", T.linear),
+                M(d + "attn.q.bias", p + "self_attn.q_proj.bias"),
+                M(d + "attn.k.kernel", p + "self_attn.k_proj.weight", T.linear),
+                M(d + "attn.k.bias", p + "self_attn.k_proj.bias"),
+                M(d + "attn.v.kernel", p + "self_attn.v_proj.weight", T.linear),
+                M(d + "attn.v.bias", p + "self_attn.v_proj.bias"),
+                M(d + "attn.out.kernel", p + "self_attn.out_proj.weight",
+                  T.linear),
+                M(d + "attn.out.bias", p + "self_attn.out_proj.bias"),
+                M(d + "ln2.scale", p + "layer_norm2.weight"),
+                M(d + "ln2.bias", p + "layer_norm2.bias"),
+                M(d + "mlp.fc1.kernel", p + "mlp.fc1.weight", T.linear),
+                M(d + "mlp.fc1.bias", p + "mlp.fc1.bias"),
+                M(d + "mlp.fc2.kernel", p + "mlp.fc2.weight", T.linear),
+                M(d + "mlp.fc2.bias", p + "mlp.fc2.bias"),
+            ]
+
+        return [
+            M("vision.cls_token", "vision_model.embeddings.class_embedding",
+              lambda w: w.reshape(1, 1, -1)),
+            M("vision.pos_embed",
+              "vision_model.embeddings.position_embedding.weight",
+              T.unsqueeze),
+            M("vision.patch_embed.conv.kernel",
+              "vision_model.embeddings.patch_embedding.weight", T.conv),
+            # HF's misspelled "pre_layrnorm" is the checkpoint-visible name
+            M("vision.ln_pre.scale", "vision_model.pre_layrnorm.weight"),
+            M("vision.ln_pre.bias", "vision_model.pre_layrnorm.bias"),
+            M("vision.ln_post.scale", "vision_model.post_layernorm.weight"),
+            M("vision.ln_post.bias", "vision_model.post_layernorm.bias"),
+            M("visual_projection.kernel", "visual_projection.weight", T.linear),
+            M("text.token_embed.embedding",
+              "text_model.embeddings.token_embedding.weight"),
+            M("text.pos_embed",
+              "text_model.embeddings.position_embedding.weight"),
+            M("text.ln_final.scale", "text_model.final_layer_norm.weight"),
+            M("text.ln_final.bias", "text_model.final_layer_norm.bias"),
+            M("text_projection.kernel", "text_projection.weight", T.linear),
+            M("logit_scale", "logit_scale", _scalar),
+            *tower("vision.", "vision_model."),
+            *tower("text.", "text_model."),
+        ]
+
+    @classmethod
+    def from_pretrained(cls, name_or_path: str, *,
+                        mesh: jax.sharding.Mesh | None = None,
+                        rules: ShardingRules | str = TENSOR_PARALLEL,
+                        dtype=None) -> "CLIP":
+        weights, config = resolve_checkpoint(name_or_path)
+        cfg = cls.config_from_hf(config, weights)
+        param_dtype = dtype if dtype is not None else jnp.float32
+        model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,
+                    param_dtype=param_dtype)
+        apply_mapping(model, weights, cls.hf_mapping(cfg),
+                      num_layers=cfg.vision.depth,
+                      num_layers_by_prefix={"text.": cfg.text.depth},
+                      param_dtype=param_dtype)
+        return model

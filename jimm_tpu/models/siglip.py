@@ -1,0 +1,210 @@
+"""SigLIP dual-tower model (v1 and v2, non-NaFlex variants).
+
+Capability parity with `src/jimm/models/siglip.py:15-385`: MAP-pooled vision
+tower (post-norm, gelu_tanh, eps 1e-6), bidirectional text tower with
+last-token pooling and *biased* text projection, ``logit_scale`` and
+``logit_bias``; HF checkpoint loading incl. the fused torch
+``in_proj_weight`` q/k/v split for the MAP head (ref `siglip.py:352-363`).
+Unlike the reference, ``intermediate_size`` is read from config, so
+So400m-class checkpoints (non-4x MLP) load (SURVEY §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from jimm_tpu.configs import SigLIPConfig, TextConfig, VisionConfig
+from jimm_tpu.nn.text import TextTower
+from jimm_tpu.nn.vision import VisionTower
+from jimm_tpu.parallel.sharding import (ShardingRules, TENSOR_PARALLEL,
+                                        logical, shard_model)
+from jimm_tpu.weights.loader import M, T, apply_mapping
+from jimm_tpu.weights.resolve import resolve_checkpoint
+
+
+def _scalar(w: np.ndarray) -> np.ndarray:
+    return np.asarray(w).reshape(())
+
+
+class SigLIP(nnx.Module):
+    def __init__(self, config: SigLIPConfig | None = None, *,
+                 rngs: nnx.Rngs | None = None,
+                 mesh: jax.sharding.Mesh | None = None,
+                 rules: ShardingRules | str = TENSOR_PARALLEL,
+                 dtype=None, param_dtype=jnp.float32):
+        cfg = config or SigLIPConfig()
+        self.config = cfg
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.vision = VisionTower(cfg.vision, rngs, dtype=dtype,
+                                  param_dtype=param_dtype)
+        self.text = TextTower(cfg.text, rngs, dtype=dtype,
+                              param_dtype=param_dtype)
+        # biased projection to the shared embedding dim (ref siglip.py:111-119)
+        self.text_projection = nnx.Linear(
+            cfg.text.width, cfg.projection_dim, use_bias=True, dtype=dtype,
+            param_dtype=param_dtype,
+            kernel_init=logical(nnx.initializers.xavier_uniform(),
+                                "embed", "proj"),
+            bias_init=logical(nnx.initializers.zeros_init(), "proj"),
+            rngs=rngs)
+        self.logit_scale = nnx.Param(jnp.asarray(cfg.logit_scale_init,
+                                                 dtype=param_dtype))
+        self.logit_bias = nnx.Param(jnp.asarray(cfg.logit_bias_init,
+                                                dtype=param_dtype))
+        if mesh is not None:
+            shard_model(self, mesh, rules)
+
+    def encode_image(self, images: jax.Array) -> jax.Array:
+        """(B, H, W, C) -> unnormalized (B, width): the MAP-head output is the
+        image feature — no separate visual projection (ref siglip.py:140-149)."""
+        return self.vision(images)
+
+    def encode_text(self, text: jax.Array) -> jax.Array:
+        """(B, S) -> unnormalized (B, projection_dim); pools the LAST position
+        (requires max-length padding) then biased projection
+        (ref `siglip.py:151-152`)."""
+        hidden = self.text(text)
+        return self.text_projection(self.text.pool(hidden, text))
+
+    def __call__(self, images: jax.Array, text: jax.Array) -> jax.Array:
+        img = self.encode_image(images)
+        txt = self.encode_text(text)
+        img = img / jnp.linalg.norm(img, axis=-1, keepdims=True)
+        txt = txt / jnp.linalg.norm(txt, axis=-1, keepdims=True)
+        scale = jnp.exp(self.logit_scale[...])
+        return scale * img @ txt.T + self.logit_bias[...]  # logits_per_image
+
+    # ------------------------------------------------------------------
+    # Checkpoint loading
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def config_from_hf(config: dict[str, Any] | None,
+                       weights: dict[str, np.ndarray]) -> SigLIPConfig:
+        w = weights
+        # shape inference first (the reference is nearly config-free:
+        # ref siglip.py:193-207); config fills gaps when present
+        v_width = w["vision_model.post_layernorm.weight"].shape[0]
+        t_width = w["text_model.final_layer_norm.weight"].shape[0]
+        v_depth = 1 + max(int(k.split(".")[3]) for k in w
+                          if k.startswith("vision_model.encoder.layers."))
+        t_depth = 1 + max(int(k.split(".")[3]) for k in w
+                          if k.startswith("text_model.encoder.layers."))
+        patch = w["vision_model.embeddings.patch_embedding.weight"].shape[-1]
+        n_pos = w["vision_model.embeddings.position_embedding.weight"].shape[0]
+        vocab, _ = w["text_model.embeddings.token_embedding.weight"].shape
+        ctx = w["text_model.embeddings.position_embedding.weight"].shape[0]
+        vc = (config or {}).get("vision_config", {})
+        tc = (config or {}).get("text_config", {})
+        image = vc.get("image_size", int(round(n_pos ** 0.5)) * patch)
+        vision = VisionConfig(
+            image_size=image, patch_size=patch, width=v_width, depth=v_depth,
+            num_heads=vc.get("num_attention_heads", max(1, v_width // 64)),
+            mlp_dim=w["vision_model.encoder.layers.0.mlp.fc1.weight"].shape[0],
+            act=vc.get("hidden_act", "gelu_tanh"),
+            ln_eps=vc.get("layer_norm_eps", 1e-6),
+            pooling="map", pre_norm=False, patch_bias=True)
+        text = TextConfig(
+            vocab_size=vocab, context_length=ctx, width=t_width, depth=t_depth,
+            num_heads=tc.get("num_attention_heads", max(1, t_width // 64)),
+            mlp_dim=w["text_model.encoder.layers.0.mlp.fc1.weight"].shape[0],
+            act=tc.get("hidden_act", "gelu_tanh"),
+            ln_eps=tc.get("layer_norm_eps", 1e-6),
+            causal=False, pooling="last", proj_bias=True)
+        proj = w["text_model.head.weight"].shape[0]
+        return SigLIPConfig(vision=vision, text=text, projection_dim=proj)
+
+    @staticmethod
+    def hf_mapping(cfg: SigLIPConfig) -> list[M]:
+        def tower(dst_prefix: str, src_prefix: str) -> list[M]:
+            p = src_prefix + "encoder.layers.{i}."
+            d = dst_prefix + "encoder.blocks."
+            return [
+                M(d + "ln1.scale", p + "layer_norm1.weight"),
+                M(d + "ln1.bias", p + "layer_norm1.bias"),
+                M(d + "attn.q.kernel", p + "self_attn.q_proj.weight", T.linear),
+                M(d + "attn.q.bias", p + "self_attn.q_proj.bias"),
+                M(d + "attn.k.kernel", p + "self_attn.k_proj.weight", T.linear),
+                M(d + "attn.k.bias", p + "self_attn.k_proj.bias"),
+                M(d + "attn.v.kernel", p + "self_attn.v_proj.weight", T.linear),
+                M(d + "attn.v.bias", p + "self_attn.v_proj.bias"),
+                M(d + "attn.out.kernel", p + "self_attn.out_proj.weight",
+                  T.linear),
+                M(d + "attn.out.bias", p + "self_attn.out_proj.bias"),
+                M(d + "ln2.scale", p + "layer_norm2.weight"),
+                M(d + "ln2.bias", p + "layer_norm2.bias"),
+                M(d + "mlp.fc1.kernel", p + "mlp.fc1.weight", T.linear),
+                M(d + "mlp.fc1.bias", p + "mlp.fc1.bias"),
+                M(d + "mlp.fc2.kernel", p + "mlp.fc2.weight", T.linear),
+                M(d + "mlp.fc2.bias", p + "mlp.fc2.bias"),
+            ]
+
+        h = "vision_model.head."
+        return [
+            M("vision.pos_embed",
+              "vision_model.embeddings.position_embedding.weight",
+              T.unsqueeze),
+            M("vision.patch_embed.conv.kernel",
+              "vision_model.embeddings.patch_embedding.weight", T.conv),
+            M("vision.patch_embed.conv.bias",
+              "vision_model.embeddings.patch_embedding.bias"),
+            M("vision.ln_post.scale", "vision_model.post_layernorm.weight"),
+            M("vision.ln_post.bias", "vision_model.post_layernorm.bias"),
+            # MAP pooling head; torch fuses q/k/v into in_proj_* — split into
+            # thirds (ref siglip.py:352-363)
+            M("vision.head.probe", h + "probe"),
+            M("vision.head.attn.q.kernel", h + "attention.in_proj_weight",
+              T.chunk(3, 0, T.linear)),
+            M("vision.head.attn.k.kernel", h + "attention.in_proj_weight",
+              T.chunk(3, 1, T.linear)),
+            M("vision.head.attn.v.kernel", h + "attention.in_proj_weight",
+              T.chunk(3, 2, T.linear)),
+            M("vision.head.attn.q.bias", h + "attention.in_proj_bias",
+              T.chunk(3, 0)),
+            M("vision.head.attn.k.bias", h + "attention.in_proj_bias",
+              T.chunk(3, 1)),
+            M("vision.head.attn.v.bias", h + "attention.in_proj_bias",
+              T.chunk(3, 2)),
+            M("vision.head.attn.out.kernel", h + "attention.out_proj.weight",
+              T.linear),
+            M("vision.head.attn.out.bias", h + "attention.out_proj.bias"),
+            M("vision.head.ln.scale", h + "layernorm.weight"),
+            M("vision.head.ln.bias", h + "layernorm.bias"),
+            M("vision.head.mlp.fc1.kernel", h + "mlp.fc1.weight", T.linear),
+            M("vision.head.mlp.fc1.bias", h + "mlp.fc1.bias"),
+            M("vision.head.mlp.fc2.kernel", h + "mlp.fc2.weight", T.linear),
+            M("vision.head.mlp.fc2.bias", h + "mlp.fc2.bias"),
+            M("text.token_embed.embedding",
+              "text_model.embeddings.token_embedding.weight"),
+            M("text.pos_embed",
+              "text_model.embeddings.position_embedding.weight"),
+            M("text.ln_final.scale", "text_model.final_layer_norm.weight"),
+            M("text.ln_final.bias", "text_model.final_layer_norm.bias"),
+            M("text_projection.kernel", "text_model.head.weight", T.linear),
+            M("text_projection.bias", "text_model.head.bias"),
+            M("logit_scale", "logit_scale", _scalar),
+            M("logit_bias", "logit_bias", _scalar),
+            *tower("vision.", "vision_model."),
+            *tower("text.", "text_model."),
+        ]
+
+    @classmethod
+    def from_pretrained(cls, name_or_path: str, *,
+                        mesh: jax.sharding.Mesh | None = None,
+                        rules: ShardingRules | str = TENSOR_PARALLEL,
+                        dtype=None) -> "SigLIP":
+        weights, config = resolve_checkpoint(name_or_path)
+        cfg = cls.config_from_hf(config, weights)
+        param_dtype = dtype if dtype is not None else jnp.float32
+        model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,
+                    param_dtype=param_dtype)
+        apply_mapping(model, weights, cls.hf_mapping(cfg),
+                      num_layers=cfg.vision.depth,
+                      num_layers_by_prefix={"text.": cfg.text.depth},
+                      param_dtype=param_dtype)
+        return model

@@ -1,0 +1,172 @@
+"""VisionTransformer image classifier.
+
+Capability parity with `src/jimm/models/vit.py:16-273`: any size/resolution,
+optional classifier head, CLS pooling, LN eps 1e-12, HF checkpoint loading
+with config parsing + shape-inference fallback and strict mapping
+verification. TPU-first differences: stacked/scanned encoder, logical-axis
+sharding policy, safetensors-only weight path (zero torch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from jimm_tpu.configs import VisionConfig, ViTConfig
+from jimm_tpu.nn.vision import VisionTower
+from jimm_tpu.parallel.sharding import (ShardingRules, TENSOR_PARALLEL, logical,
+                                        shard_model)
+from jimm_tpu.weights.loader import M, T, apply_mapping
+from jimm_tpu.weights.resolve import resolve_checkpoint
+
+
+def _act_from_hf(name: str | None) -> str:
+    if name in (None, "gelu"):
+        return "gelu"
+    if name == "quick_gelu":
+        return "quick_gelu"
+    if name in ("gelu_new", "gelu_pytorch_tanh"):
+        return "gelu_tanh"
+    return name  # get_activation warns + falls back (ref models/vit.py:139-142)
+
+
+class VisionTransformer(nnx.Module):
+    """ViT with optional linear classification head (ref `models/vit.py:16`)."""
+
+    def __init__(self, config: ViTConfig | None = None, *,
+                 rngs: nnx.Rngs | None = None,
+                 mesh: jax.sharding.Mesh | None = None,
+                 rules: ShardingRules | str = TENSOR_PARALLEL,
+                 dtype=None, param_dtype=jnp.float32):
+        cfg = config or ViTConfig()
+        self.config = cfg
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.vision = VisionTower(cfg.vision, rngs, dtype=dtype,
+                                  param_dtype=param_dtype)
+        if cfg.do_classification:
+            self.classifier = nnx.Linear(
+                cfg.vision.width, cfg.num_classes, dtype=dtype,
+                param_dtype=param_dtype,
+                kernel_init=logical(nnx.initializers.zeros_init(),
+                                    "embed", "classes"),
+                bias_init=logical(nnx.initializers.zeros_init(), "classes"),
+                rngs=rngs)
+        if mesh is not None:
+            shard_model(self, mesh, rules)
+
+    def __call__(self, images: jax.Array) -> jax.Array:
+        pooled = self.vision(images)
+        if self.config.do_classification:
+            return self.classifier(pooled)
+        return pooled
+
+    # ------------------------------------------------------------------
+    # Checkpoint loading
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def config_from_hf(config: dict[str, Any] | None,
+                       weights: dict[str, np.ndarray]) -> ViTConfig:
+        """HF `config.json` -> ViTConfig; shape inference when absent
+        (ref `models/vit.py:131-164`)."""
+        if config:
+            num_classes = (len(config["id2label"]) if config.get("id2label")
+                           else config.get("num_labels", 1000))
+            vision = VisionConfig(
+                image_size=config.get("image_size", 224),
+                patch_size=config.get("patch_size", 16),
+                channels=config.get("num_channels", 3),
+                width=config.get("hidden_size", 768),
+                depth=config.get("num_hidden_layers", 12),
+                num_heads=config.get("num_attention_heads", 12),
+                mlp_dim=config.get("intermediate_size", 4 * config.get("hidden_size", 768)),
+                act=_act_from_hf(config.get("hidden_act")),
+                ln_eps=config.get("layer_norm_eps", 1e-12),
+                pooling="cls")
+            return ViTConfig(vision=vision, num_classes=num_classes,
+                             do_classification="classifier.weight" in weights)
+        # shape inference from checkpoint keys (ref models/vit.py:144-164)
+        w = weights
+        width = w["vit.embeddings.cls_token"].shape[-1]
+        depth = 1 + max(int(k.split(".")[3]) for k in w
+                        if k.startswith("vit.encoder.layer."))
+        mlp_dim = w["vit.encoder.layer.0.intermediate.dense.weight"].shape[0]
+        patch = w["vit.embeddings.patch_embeddings.projection.weight"].shape[-1]
+        n_pos = w["vit.embeddings.position_embeddings"].shape[1] - 1
+        image = int(round(n_pos ** 0.5)) * patch
+        has_head = "classifier.weight" in w
+        num_classes = w["classifier.weight"].shape[0] if has_head else 1000
+        vision = VisionConfig(image_size=image, patch_size=patch, width=width,
+                              depth=depth, num_heads=max(1, width // 64),
+                              mlp_dim=mlp_dim, ln_eps=1e-12, pooling="cls")
+        return ViTConfig(vision=vision, num_classes=num_classes,
+                         do_classification=has_head)
+
+    @staticmethod
+    def hf_mapping(cfg: ViTConfig) -> list[M]:
+        """Declarative HF->jimm_tpu name mapping (replaces the imperative loop
+        at ref `models/vit.py:192-257`)."""
+        p = "vit.encoder.layer.{i}."
+        maps = [
+            M("vision.cls_token", "vit.embeddings.cls_token"),
+            M("vision.pos_embed", "vit.embeddings.position_embeddings"),
+            M("vision.patch_embed.conv.kernel",
+              "vit.embeddings.patch_embeddings.projection.weight", T.conv),
+            M("vision.patch_embed.conv.bias",
+              "vit.embeddings.patch_embeddings.projection.bias"),
+            M("vision.ln_post.scale", "vit.layernorm.weight"),
+            M("vision.ln_post.bias", "vit.layernorm.bias"),
+            # stacked encoder params (leading `layers` dim)
+            M("vision.encoder.blocks.ln1.scale", p + "layernorm_before.weight"),
+            M("vision.encoder.blocks.ln1.bias", p + "layernorm_before.bias"),
+            M("vision.encoder.blocks.attn.q.kernel",
+              p + "attention.attention.query.weight", T.linear),
+            M("vision.encoder.blocks.attn.q.bias",
+              p + "attention.attention.query.bias"),
+            M("vision.encoder.blocks.attn.k.kernel",
+              p + "attention.attention.key.weight", T.linear),
+            M("vision.encoder.blocks.attn.k.bias",
+              p + "attention.attention.key.bias"),
+            M("vision.encoder.blocks.attn.v.kernel",
+              p + "attention.attention.value.weight", T.linear),
+            M("vision.encoder.blocks.attn.v.bias",
+              p + "attention.attention.value.bias"),
+            M("vision.encoder.blocks.attn.out.kernel",
+              p + "attention.output.dense.weight", T.linear),
+            M("vision.encoder.blocks.attn.out.bias",
+              p + "attention.output.dense.bias"),
+            M("vision.encoder.blocks.ln2.scale", p + "layernorm_after.weight"),
+            M("vision.encoder.blocks.ln2.bias", p + "layernorm_after.bias"),
+            M("vision.encoder.blocks.mlp.fc1.kernel",
+              p + "intermediate.dense.weight", T.linear),
+            M("vision.encoder.blocks.mlp.fc1.bias",
+              p + "intermediate.dense.bias"),
+            M("vision.encoder.blocks.mlp.fc2.kernel",
+              p + "output.dense.weight", T.linear),
+            M("vision.encoder.blocks.mlp.fc2.bias", p + "output.dense.bias"),
+        ]
+        if cfg.do_classification:
+            maps += [M("classifier.kernel", "classifier.weight", T.linear),
+                     M("classifier.bias", "classifier.bias")]
+        return maps
+
+    @classmethod
+    def from_pretrained(cls, name_or_path: str, *,
+                        mesh: jax.sharding.Mesh | None = None,
+                        rules: ShardingRules | str = TENSOR_PARALLEL,
+                        dtype=None) -> "VisionTransformer":
+        """Load any HF ViT checkpoint (safetensors). ``dtype`` sets both
+        compute and param dtype (ref `models/vit.py:181-182`)."""
+        weights, config = resolve_checkpoint(name_or_path)
+        cfg = cls.config_from_hf(config, weights)
+        param_dtype = dtype if dtype is not None else jnp.float32
+        model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,
+                    param_dtype=param_dtype)
+        apply_mapping(model, weights, cls.hf_mapping(cfg),
+                      num_layers=cfg.vision.depth, param_dtype=param_dtype)
+        return model

@@ -1,0 +1,56 @@
+"""Text tower: token + positional embedding, encoder, final LN, pooling.
+
+Parity notes (SURVEY Appendix A):
+- CLIP: causal encoder; pooled feature = hidden state at ``argmax(token_ids)``
+  (EOT has the maximum token id in CLIP's vocab — ref `models/clip.py:164-166`).
+- SigLIP: bidirectional encoder; pooled feature = last position ``x[:, -1]``
+  (requires max-length padding at tokenization — ref `models/siglip.py:151`).
+- Positional embedding is sliced to the input sequence length
+  (ref `models/clip.py:160`, `models/siglip.py:147`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from jimm_tpu.configs import TextConfig
+from jimm_tpu.nn.transformer import Transformer, _layernorm
+from jimm_tpu.parallel.sharding import logical, logical_constraint
+
+
+class TextTower(nnx.Module):
+    def __init__(self, cfg: TextConfig, rngs: nnx.Rngs, *, dtype=None,
+                 param_dtype=jnp.float32):
+        self.cfg = cfg
+        self.token_embed = nnx.Embed(
+            cfg.vocab_size, cfg.width, dtype=dtype, param_dtype=param_dtype,
+            embedding_init=logical(nnx.initializers.normal(0.02),
+                                   "vocab", "embed"),
+            rngs=rngs)
+        self.pos_embed = nnx.Param(
+            logical(nnx.initializers.normal(0.01), "pos", "embed")(
+                rngs.params(), (cfg.context_length, cfg.width), param_dtype))
+        self.encoder = Transformer(cfg.encoder(), rngs, dtype=dtype,
+                                   param_dtype=param_dtype)
+        self.ln_final = _layernorm(cfg.width, cfg.ln_eps, rngs, dtype=dtype,
+                                   param_dtype=param_dtype)
+
+    def __call__(self, text: jax.Array) -> jax.Array:
+        """(B, S) int token ids -> (B, S, width) final hidden states."""
+        seq_len = text.shape[1]
+        x = self.token_embed(text)
+        x = x + self.pos_embed[...][:seq_len].astype(x.dtype)
+        x = logical_constraint(x, "batch", "seq", None)
+        x = self.encoder(x)
+        return self.ln_final(x)
+
+    def pool(self, hidden: jax.Array, text: jax.Array) -> jax.Array:
+        """Pool final hidden states per the configured strategy."""
+        if self.cfg.pooling == "eot":
+            eot = jnp.argmax(text, axis=-1)
+            return hidden[jnp.arange(hidden.shape[0]), eot]
+        if self.cfg.pooling == "last":
+            return hidden[:, -1]
+        raise ValueError(f"unsupported text pooling {self.cfg.pooling!r}")

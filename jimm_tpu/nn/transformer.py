@@ -1,0 +1,148 @@
+"""Transformer encoder stack, TPU-first.
+
+Differences from the reference (`src/jimm/common/transformer.py`):
+
+- Layers are *stacked* (one set of parameters with a leading ``layers`` dim,
+  built via ``nnx.vmap``) and the forward is a ``jax.lax.scan`` via
+  ``nnx.scan`` — constant compile time in depth and a clean FSDP unit,
+  instead of the reference's python-unrolled ``nnx.Sequential``
+  (ref `common/transformer.py:171-188`).
+- Attention is a swappable functional kernel (`jimm_tpu/ops/attention.py`)
+  over explicit ``(B, S, N, D)`` tensors with plain ``(H, H)`` projection
+  kernels, not ``nnx.MultiHeadAttention``'s ``(H, N, D)`` layout — simpler
+  checkpoint mapping and a direct hand-off to Pallas flash attention.
+- Sharding comes from logical axis names resolved by a rules table
+  (`jimm_tpu/parallel/sharding.py`), not per-callsite PartitionSpecs.
+
+Parity-preserved semantics (SURVEY Appendix A):
+- pre-LN residual order ``x + attn(ln1(x))``; ``x + mlp(ln2(x))``
+  (ref `common/transformer.py:130-131`).
+- causal masking equivalent to the reference's sliced float ``tril`` mask
+  (ref `common/transformer.py:125-129`, `models/clip.py:62`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from jimm_tpu.configs import TransformerConfig
+from jimm_tpu.ops.activations import get_activation
+from jimm_tpu.ops.attention import dot_product_attention
+from jimm_tpu.parallel.sharding import logical, logical_constraint
+
+Dtype = jnp.dtype | None
+
+
+def _linear(din: int, dout: int, names: tuple, rngs: nnx.Rngs, *,
+            use_bias: bool = True, dtype: Dtype, param_dtype) -> nnx.Linear:
+    return nnx.Linear(
+        din, dout, use_bias=use_bias, dtype=dtype, param_dtype=param_dtype,
+        kernel_init=logical(nnx.initializers.xavier_uniform(), *names),
+        bias_init=logical(nnx.initializers.zeros_init(), names[-1]),
+        rngs=rngs)
+
+
+def _layernorm(dim: int, eps: float, rngs: nnx.Rngs, *, dtype: Dtype,
+               param_dtype) -> nnx.LayerNorm:
+    return nnx.LayerNorm(
+        dim, epsilon=eps, dtype=dtype, param_dtype=param_dtype,
+        scale_init=logical(nnx.initializers.ones_init(), "embed"),
+        bias_init=logical(nnx.initializers.zeros_init(), "embed"),
+        rngs=rngs)
+
+
+class Attention(nnx.Module):
+    """Multi-head attention with (H, H) q/k/v/out kernels; supports
+    self-attention and cross-attention (MAP pooling probe)."""
+
+    def __init__(self, width: int, num_heads: int, rngs: nnx.Rngs, *,
+                 is_causal: bool = False, impl: str = "auto",
+                 dtype: Dtype = None, param_dtype=jnp.float32):
+        if width % num_heads:
+            raise ValueError(f"width {width} not divisible by heads {num_heads}")
+        self.num_heads = num_heads
+        self.head_dim = width // num_heads
+        self.is_causal = is_causal
+        self.impl = impl
+        lin = partial(_linear, dtype=dtype, param_dtype=param_dtype)
+        self.q = lin(width, width, ("embed", "heads"), rngs)
+        self.k = lin(width, width, ("embed", "heads"), rngs)
+        self.v = lin(width, width, ("embed", "heads"), rngs)
+        self.out = lin(width, width, ("heads", "embed"), rngs)
+
+    def __call__(self, x: jax.Array, kv: jax.Array | None = None,
+                 mask: jax.Array | None = None) -> jax.Array:
+        kv = x if kv is None else kv
+        B, Sq, _ = x.shape
+        Sk = kv.shape[1]
+        q = self.q(x).reshape(B, Sq, self.num_heads, self.head_dim)
+        k = self.k(kv).reshape(B, Sk, self.num_heads, self.head_dim)
+        v = self.v(kv).reshape(B, Sk, self.num_heads, self.head_dim)
+        o = dot_product_attention(q, k, v, is_causal=self.is_causal,
+                                  mask=mask, impl=self.impl)
+        return self.out(o.reshape(B, Sq, self.num_heads * self.head_dim))
+
+
+class Mlp(nnx.Module):
+    def __init__(self, width: int, mlp_dim: int, act: str, rngs: nnx.Rngs, *,
+                 dtype: Dtype = None, param_dtype=jnp.float32):
+        lin = partial(_linear, dtype=dtype, param_dtype=param_dtype)
+        self.fc1 = lin(width, mlp_dim, ("embed", "mlp"), rngs)
+        self.fc2 = lin(mlp_dim, width, ("mlp", "embed"), rngs)
+        self.act: Callable = get_activation(act)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return self.fc2(self.act(self.fc1(x)))
+
+
+class Block(nnx.Module):
+    """Pre-LN residual block (ref `common/transformer.py:116-132`)."""
+
+    def __init__(self, cfg: TransformerConfig, rngs: nnx.Rngs, *,
+                 dtype: Dtype = None, param_dtype=jnp.float32):
+        self.ln1 = _layernorm(cfg.width, cfg.ln_eps, rngs, dtype=dtype,
+                              param_dtype=param_dtype)
+        self.attn = Attention(cfg.width, cfg.num_heads, rngs,
+                              is_causal=cfg.causal, impl=cfg.attn_impl,
+                              dtype=dtype, param_dtype=param_dtype)
+        self.ln2 = _layernorm(cfg.width, cfg.ln_eps, rngs, dtype=dtype,
+                              param_dtype=param_dtype)
+        self.mlp = Mlp(cfg.width, cfg.mlp_dim, cfg.act, rngs, dtype=dtype,
+                       param_dtype=param_dtype)
+        self.dropout = nnx.Dropout(cfg.dropout, rngs=rngs)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = x + self.dropout(self.mlp(self.ln2(x)))
+        return logical_constraint(x, "batch", "seq", None)
+
+
+class Transformer(nnx.Module):
+    """Depth-stacked encoder, scanned over the ``layers`` axis."""
+
+    def __init__(self, cfg: TransformerConfig, rngs: nnx.Rngs, *,
+                 dtype: Dtype = None, param_dtype=jnp.float32):
+        self.cfg = cfg
+
+        @nnx.split_rngs(splits=cfg.depth)
+        @nnx.vmap(in_axes=0, out_axes=0,
+                  transform_metadata={nnx.PARTITION_NAME: "layers"})
+        def create_block(rngs: nnx.Rngs) -> Block:
+            return Block(cfg, rngs, dtype=dtype, param_dtype=param_dtype)
+
+        self.blocks = create_block(rngs)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        def body(block: Block, x: jax.Array) -> jax.Array:
+            return block(x)
+
+        if self.cfg.remat:
+            body = nnx.remat(body)
+        scan = nnx.scan(body, in_axes=(0, nnx.Carry), out_axes=nnx.Carry,
+                        transform_metadata={nnx.PARTITION_NAME: "layers"})
+        return scan(self.blocks, x)

@@ -1,0 +1,123 @@
+"""Vision tower: patch embedding, positional embeddings, encoder, pooling.
+
+Behavioral parity with `src/jimm/common/vit.py:104-248` (see SURVEY Appendix
+A): CLS-vs-MAP pooling, learned position embeddings, optional pre-LN (CLIP)
+which *replaces* embedding dropout (ref `common/vit.py:238-241`), post-LN
+before pooling, and the MAP head's exact residual order
+(ref `common/vit.py:96-101`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from jimm_tpu.configs import VisionConfig
+from jimm_tpu.nn.transformer import Attention, Mlp, Transformer, _layernorm
+from jimm_tpu.parallel.sharding import logical, logical_constraint
+
+
+class PatchEmbed(nnx.Module):
+    """Non-overlapping conv patchifier: (B, H, W, C) -> (B, N, width)."""
+
+    def __init__(self, cfg: VisionConfig, rngs: nnx.Rngs, *, dtype=None,
+                 param_dtype=jnp.float32):
+        self.conv = nnx.Conv(
+            cfg.channels, cfg.width,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size), padding="VALID",
+            use_bias=cfg.patch_bias, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=logical(nnx.initializers.xavier_uniform(),
+                                "patch", "patch", "patch", "embed"),
+            bias_init=logical(nnx.initializers.zeros_init(), "embed"),
+            rngs=rngs)
+
+    def __call__(self, images: jax.Array) -> jax.Array:
+        x = self.conv(images)  # (B, gh, gw, width)
+        return x.reshape(x.shape[0], -1, x.shape[-1])
+
+
+class MAPHead(nnx.Module):
+    """SigLIP Multi-head Attention Pooling (ref `common/vit.py:12-101`).
+
+    Residual order is parity-critical: the residual is the *pre-LayerNorm*
+    attention output (ref `common/vit.py:96-101`)::
+
+        x = attn(probe, h, h); res = x; x = res + mlp(ln(x)); return x[:, 0]
+    """
+
+    def __init__(self, cfg: VisionConfig, rngs: nnx.Rngs, *, dtype=None,
+                 param_dtype=jnp.float32):
+        self.probe = nnx.Param(
+            logical(nnx.initializers.xavier_uniform(), None, None, "embed")(
+                rngs.params(), (1, 1, cfg.width), param_dtype))
+        self.attn = Attention(cfg.width, cfg.num_heads, rngs, impl="xla",
+                              dtype=dtype, param_dtype=param_dtype)
+        self.ln = _layernorm(cfg.width, cfg.ln_eps, rngs, dtype=dtype,
+                             param_dtype=param_dtype)
+        self.mlp = Mlp(cfg.width, cfg.mlp_dim, cfg.act, rngs, dtype=dtype,
+                       param_dtype=param_dtype)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        B = x.shape[0]
+        probe = jnp.broadcast_to(self.probe[...], (B, 1, x.shape[-1])
+                                 ).astype(x.dtype)
+        x = self.attn(probe, kv=x)        # (B, 1, width)
+        residual = x
+        x = residual + self.mlp(self.ln(x))
+        return x[:, 0]
+
+
+class VisionTower(nnx.Module):
+    """ViT backbone (ref `common/vit.py:104-248`)."""
+
+    def __init__(self, cfg: VisionConfig, rngs: nnx.Rngs, *, dtype=None,
+                 param_dtype=jnp.float32):
+        self.cfg = cfg
+        self.patch_embed = PatchEmbed(cfg, rngs, dtype=dtype,
+                                      param_dtype=param_dtype)
+        if cfg.pooling == "cls":
+            self.cls_token = nnx.Param(
+                logical(nnx.initializers.zeros_init(), None, None, "embed")(
+                    rngs.params(), (1, 1, cfg.width), param_dtype))
+        self.pos_embed = nnx.Param(
+            logical(nnx.initializers.normal(0.02), None, "pos", "embed")(
+                rngs.params(), (1, cfg.seq_len, cfg.width), param_dtype))
+        if cfg.pre_norm:
+            self.ln_pre = _layernorm(cfg.width, cfg.ln_eps, rngs, dtype=dtype,
+                                     param_dtype=param_dtype)
+        else:
+            self.dropout = nnx.Dropout(cfg.dropout, rngs=rngs)
+        self.encoder = Transformer(cfg.encoder(), rngs, dtype=dtype,
+                                   param_dtype=param_dtype)
+        self.ln_post = _layernorm(cfg.width, cfg.ln_eps, rngs, dtype=dtype,
+                                  param_dtype=param_dtype)
+        if cfg.pooling == "map":
+            self.head = MAPHead(cfg, rngs, dtype=dtype, param_dtype=param_dtype)
+
+    def __call__(self, images: jax.Array) -> jax.Array:
+        """(B, H, W, C) images -> pooled (B, width) (or (B, N, width) when
+        ``pooling == "none"``)."""
+        if images.shape[1:3] != (self.cfg.image_size, self.cfg.image_size):
+            raise ValueError(
+                f"expected {self.cfg.image_size}x{self.cfg.image_size} input "
+                f"images (NHWC), got {images.shape}")
+        x = self.patch_embed(images)
+        if self.cfg.pooling == "cls":
+            cls = jnp.broadcast_to(self.cls_token[...],
+                                   (x.shape[0], 1, x.shape[-1])).astype(x.dtype)
+            x = jnp.concatenate([cls, x], axis=1)
+        x = x + self.pos_embed[...].astype(x.dtype)
+        # parity quirk: pre-norm models (CLIP) LayerNorm the embeddings and
+        # skip dropout; post-norm models (ViT/SigLIP) apply dropout
+        # (ref common/vit.py:238-241)
+        x = self.ln_pre(x) if self.cfg.pre_norm else self.dropout(x)
+        x = logical_constraint(x, "batch", "seq", None)
+        x = self.encoder(x)
+        x = self.ln_post(x)
+        if self.cfg.pooling == "cls":
+            return x[:, 0]
+        if self.cfg.pooling == "map":
+            return self.head(x)
+        return x

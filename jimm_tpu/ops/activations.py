@@ -1,0 +1,50 @@
+"""Activation functions.
+
+The reference maps HF ``hidden_act`` loosely — any non-quick_gelu act falls
+back to flax's default (tanh-approximate) GELU (ref `models/vit.py:139-142`,
+`common/transformer.py:90`). We keep exact semantics per HF name instead:
+``gelu`` is the erf GELU, ``gelu_tanh``/``gelu_pytorch_tanh`` the tanh
+approximation, ``quick_gelu`` the sigmoid approximation
+(ref `common/transformer.py:12-19`).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def quick_gelu(x: jax.Array) -> jax.Array:
+    """OpenAI CLIP's GELU approximation: ``x * sigmoid(1.702 * x)``."""
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def gelu_exact(x: jax.Array) -> jax.Array:
+    return jax.nn.gelu(x, approximate=False)
+
+
+def gelu_tanh(x: jax.Array) -> jax.Array:
+    return jax.nn.gelu(x, approximate=True)
+
+
+_ACTS: dict[str, Callable[[jax.Array], jax.Array]] = {
+    "gelu": gelu_exact,
+    "gelu_tanh": gelu_tanh,
+    "gelu_pytorch_tanh": gelu_tanh,
+    "gelu_new": gelu_tanh,
+    "quick_gelu": quick_gelu,
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+}
+
+
+def get_activation(name: str) -> Callable[[jax.Array], jax.Array]:
+    """Resolve an activation by (HF) name; warn + GELU fallback like the
+    reference (`models/vit.py:139-142`) for unknown names."""
+    if name not in _ACTS:
+        warnings.warn(f"unknown activation {name!r}; falling back to gelu_tanh")
+        return gelu_tanh
+    return _ACTS[name]

@@ -1,0 +1,77 @@
+"""Attention dispatch: one functional entry point, swappable kernels.
+
+The reference locks attention to ``flax.nnx.MultiHeadAttention``'s einsum path
+(ref `common/transformer.py:67-87`). Here attention is a *function* over
+``(B, S, N, D)`` q/k/v so the kernel is a config choice:
+
+- ``"xla"``  — ``jax.nn.dot_product_attention`` (XLA fuses; fine for short
+  vision/text sequences and for CPU tests).
+- ``"flash"`` — Pallas TPU flash attention (fwd + custom-vjp bwd), used for
+  training and long sequences. See `jimm_tpu/ops/flash_attention.py`.
+- ``"auto"`` — flash on TPU when shapes qualify, else XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.cache
+def _default_backend() -> str:
+    return jax.default_backend()
+
+
+def _flash_eligible(q: jax.Array, k: jax.Array) -> bool:
+    # flash kernel wants seq lengths it can block; q_len==1 (MAP probe) or
+    # tiny sequences gain nothing.
+    return (q.shape[1] >= 128 and k.shape[1] >= 128
+            and q.shape[-1] in (64, 128, 256))
+
+
+def dot_product_attention(
+    q: jax.Array,  # (B, Sq, N, D)
+    k: jax.Array,  # (B, Sk, N, D)
+    v: jax.Array,  # (B, Sk, N, D)
+    *,
+    is_causal: bool = False,
+    mask: jax.Array | None = None,  # broadcastable to (B, N, Sq, Sk), bool
+    impl: str = "auto",
+) -> jax.Array:
+    """Scaled dot-product attention over (batch, seq, heads, head_dim)."""
+    if impl == "auto":
+        if _default_backend() == "tpu" and mask is None and _flash_eligible(q, k):
+            impl = "flash"
+        else:
+            impl = "xla"
+    if impl == "flash":
+        if mask is not None:
+            raise ValueError("flash attention does not support explicit "
+                             "masks; use is_causal or impl='xla'")
+        from jimm_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, is_causal=is_causal)
+    if impl == "xla":
+        return jax.nn.dot_product_attention(q, k, v, mask=mask,
+                                            is_causal=is_causal)
+    if impl == "einsum":  # reference semantics, fp32 softmax; used in tests
+        return reference_attention(q, k, v, is_causal=is_causal, mask=mask)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def reference_attention(q, k, v, *, is_causal=False, mask=None):
+    """Plain einsum attention with fp32 softmax — numerical oracle for tests."""
+    dtype = q.dtype
+    depth = q.shape[-1]
+    q = q.astype(jnp.float32) / jnp.sqrt(depth)
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k.astype(jnp.float32))
+    sq, sk = logits.shape[-2], logits.shape[-1]
+    if is_causal:
+        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        logits = jnp.where(causal, logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnqk,bknd->bqnd", weights, v.astype(jnp.float32))
+    return out.astype(dtype)

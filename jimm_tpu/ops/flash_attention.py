@@ -1,0 +1,10 @@
+"""Pallas TPU flash attention (placeholder: XLA fallback until the kernel
+lands)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def flash_attention(q, k, v, *, is_causal=False):
+    return jax.nn.dot_product_attention(q, k, v, is_causal=is_causal)

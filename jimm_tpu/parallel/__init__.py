@@ -1,0 +1,14 @@
+from jimm_tpu.parallel.mesh import make_hybrid_mesh, make_mesh
+from jimm_tpu.parallel.sharding import (DATA_PARALLEL, FSDP, FSDP_TP,
+                                        PRESET_RULES, REPLICATED,
+                                        SEQUENCE_PARALLEL, TENSOR_PARALLEL,
+                                        ShardingRules, create_sharded,
+                                        logical, logical_constraint,
+                                        shard_batch, shard_model, use_sharding)
+
+__all__ = [
+    "make_mesh", "make_hybrid_mesh", "ShardingRules", "use_sharding",
+    "create_sharded", "shard_model", "shard_batch", "logical",
+    "logical_constraint", "REPLICATED", "DATA_PARALLEL", "TENSOR_PARALLEL",
+    "FSDP", "FSDP_TP", "SEQUENCE_PARALLEL", "PRESET_RULES",
+]

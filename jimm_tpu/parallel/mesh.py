@@ -1,0 +1,79 @@
+"""Device-mesh construction for single-host, multi-host slice, and
+multi-slice (ICI x DCN hybrid) topologies.
+
+The reference builds only a trivial single-host mesh
+(`examples/vit_training.py:180-183`). TPU pods need: ICI-contiguous axes for
+tensor/FSDP sharding inside a slice and a DCN axis for data parallelism
+across slices. `jax.experimental.mesh_utils` computes ICI-friendly device
+orders; we wrap it with a named-axis dict API.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Mapping
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+def make_mesh(axes: Mapping[str, int] | None = None,
+              devices: list | None = None) -> Mesh:
+    """Build a mesh from ``{"axis": size}``; ``-1`` means "all remaining
+    devices". Axis order follows dict order (outermost first) — put the
+    slowest-varying (DCN/data) axis first, ICI-heavy (model) axes last, which
+    keeps model-axis collectives on ICI neighbours.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = {"data": n}
+    axes = OrderedDict(axes)
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh {dict(zip(axes, sizes))} != {n} devices")
+    try:
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    except (ValueError, AssertionError):  # non-TPU or odd topology
+        dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def make_hybrid_mesh(ici: Mapping[str, int], dcn: Mapping[str, int]) -> Mesh:
+    """Multi-slice mesh: ``dcn`` axes span slices (data-parallel over DCN),
+    ``ici`` axes live inside a slice. E.g. v5e-64 = 4 slices of 16:
+    ``make_hybrid_mesh(ici={"data": 4, "model": 4}, dcn={"replica": 4})``."""
+    ici = OrderedDict(ici)
+    dcn = OrderedDict(dcn)
+    # create_hybrid_device_mesh multiplies same-rank shapes elementwise, so
+    # pad each side with 1s to keep dcn and ici axes distinct and named.
+    mesh_shape = (1,) * len(dcn) + tuple(ici.values())
+    dcn_shape = tuple(dcn.values()) + (1,) * len(ici)
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=mesh_shape, dcn_mesh_shape=dcn_shape,
+        devices=jax.devices())
+    return Mesh(dev_array, tuple(dcn.keys()) + tuple(ici.keys()))
+
+
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Multi-host bootstrap. On Cloud TPU the arguments are auto-detected from
+    the metadata server; pass them explicitly elsewhere. Safe to call twice."""
+    if jax.process_count() > 1:
+        return  # already initialized
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except (RuntimeError, ValueError):
+        pass  # single-process environment

@@ -1,0 +1,3 @@
+from jimm_tpu.utils.jit import jit_forward
+
+__all__ = ["jit_forward"]

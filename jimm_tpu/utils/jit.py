@@ -1,0 +1,22 @@
+"""JIT helpers.
+
+``nnx.jit(model)`` on a module whose forward uses inner transforms (our
+scan-over-layers) trips flax's closure-capture trace-level check; binding the
+module as an explicit argument is the supported spelling. ``jit_forward``
+packages that: it returns a compiled callable over (inputs...) reusing the
+reference UX of `examples/vit_inference.py:44`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from flax import nnx
+
+
+def jit_forward(model: nnx.Module, method: str = "__call__"):
+    @nnx.jit(static_argnums=(1,))
+    def _fwd(m, method, *args, **kwargs):
+        return getattr(m, method)(*args, **kwargs)
+
+    return functools.partial(_fwd, model, method)

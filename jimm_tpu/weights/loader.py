@@ -1,0 +1,141 @@
+"""Declarative checkpoint-mapping engine.
+
+Replaces the reference's three ~200-line imperative mapping loops
+(ref `models/vit.py:185-269`, `clip.py:267-414`, `siglip.py:224-383`) with a
+table of :class:`M` entries applied by one engine that:
+
+- stacks per-layer HF tensors into the scanned ``(layers, ...)`` params,
+- applies transpose/reshape transforms (:class:`T`),
+- places every tensor with ``jax.device_put`` onto the *existing* sharding of
+  the target parameter (params stay born-sharded, ref `models/vit.py:254`),
+- enforces the reference's strict verification: every model parameter
+  assigned exactly once, every checkpoint tensor consumed, with
+  ``position_ids`` buffers the only tolerated leftovers
+  (ref `models/vit.py:259-268`, SURVEY Appendix A.13-14).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import numpy as np
+from flax import nnx
+
+
+class T:
+    """Weight transforms (HF torch layout -> jimm_tpu layout)."""
+
+    @staticmethod
+    def linear(w: np.ndarray) -> np.ndarray:
+        """torch Linear (out, in) -> flax kernel (in, out)."""
+        return np.ascontiguousarray(w.transpose())
+
+    @staticmethod
+    def conv(w: np.ndarray) -> np.ndarray:
+        """torch Conv2d OIHW -> flax HWIO (ref `models/vit.py:239-240`)."""
+        return np.ascontiguousarray(w.transpose(2, 3, 1, 0))
+
+    @staticmethod
+    def unsqueeze(w: np.ndarray) -> np.ndarray:
+        return w[None]
+
+    @staticmethod
+    def chunk(n: int, idx: int, then: Callable | None = None) -> Callable:
+        """Take the idx-th of n equal chunks along axis 0 — used for torch's
+        fused MAP-head ``in_proj_weight`` (ref `siglip.py:352-363`)."""
+        def f(w: np.ndarray) -> np.ndarray:
+            part = np.split(w, n, axis=0)[idx]
+            return then(part) if then else part
+        return f
+
+
+@dataclass(frozen=True)
+class M:
+    """One mapping entry: ``src`` may contain ``{i}`` to denote a per-layer
+    tensor that is stacked over the ``layers`` axis of ``dst``."""
+
+    dst: str
+    src: str
+    transform: Callable[[np.ndarray], np.ndarray] | None = None
+    optional: bool = False  # skip silently if src/dst absent (CLIP-style
+    #                         leniency, ref `clip.py:343-348`)
+
+
+class MappingError(ValueError):
+    pass
+
+
+def apply_mapping(model: nnx.Module, weights: dict[str, np.ndarray],
+                  entries: list[M], *, num_layers: int,
+                  num_layers_by_prefix: dict[str, int] | None = None,
+                  allowed_unused: tuple[str, ...] = ("position_ids",),
+                  param_dtype=None) -> None:
+    def layer_count(dst: str) -> int:
+        for prefix, n in (num_layers_by_prefix or {}).items():
+            if dst.startswith(prefix):
+                return n
+        return num_layers
+    params = dict(nnx.to_flat_state(nnx.state(model, nnx.Param)))
+    consumed: set[str] = set()
+    assigned: dict[tuple, jax.Array] = {}
+
+    def take(key: str, optional: bool) -> np.ndarray | None:
+        if key not in weights:
+            if optional:
+                return None
+            raise MappingError(f"checkpoint missing tensor {key!r}")
+        consumed.add(key)
+        return weights[key]
+
+    for e in entries:
+        dst = tuple(e.dst.split("."))
+        if dst not in params:
+            if e.optional:
+                continue
+            raise MappingError(f"model has no parameter {e.dst!r}")
+        if "{i}" in e.src:
+            per_layer = []
+            missing = False
+            for i in range(layer_count(e.dst)):
+                arr = take(e.src.format(i=i), e.optional)
+                if arr is None:
+                    missing = True
+                    break
+                per_layer.append(e.transform(arr) if e.transform else arr)
+            if missing:
+                continue
+            arr = np.stack(per_layer)
+        else:
+            arr = take(e.src, e.optional)
+            if arr is None:
+                continue
+            if e.transform:
+                arr = e.transform(arr)
+        var = params[dst]
+        target = var.get_value()
+        if tuple(arr.shape) != tuple(target.shape):
+            raise MappingError(
+                f"shape mismatch for {e.dst}: checkpoint {arr.shape} vs "
+                f"model {target.shape} (src {e.src!r})")
+        dtype = param_dtype if param_dtype is not None else target.dtype
+        sharding = (target.sharding if isinstance(target, jax.Array)
+                    else None)
+        if dst in assigned:
+            raise MappingError(f"parameter {e.dst} assigned twice")
+        assigned[dst] = jax.device_put(arr.astype(dtype), sharding)
+
+    not_assigned = set(params) - set(assigned)
+    if not_assigned:
+        pretty = sorted(".".join(map(str, p)) for p in not_assigned)
+        raise MappingError(f"model parameters not loaded: {pretty}")
+    leftovers = [k for k in weights if k not in consumed
+                 and not any(k.endswith(suf) for suf in allowed_unused)]
+    if leftovers:
+        raise MappingError(f"unused checkpoint tensors: {sorted(leftovers)}")
+
+    for path, value in assigned.items():
+        params[path].set_value(value)
+    nnx.update(model, nnx.from_flat_state(
+        [(p, v) for p, v in params.items()]))

@@ -1,0 +1,104 @@
+"""Checkpoint resolution: local safetensors file/dir or HF hub repo id.
+
+Preserves the reference's user-visible loading contract minus torch
+(SURVEY §7.1.1): local `.safetensors` file with sibling/parent `config.json`
+discovery (ref `common/utils.py:77-86`), local directory, or HF hub repo-id
+(ref `common/utils.py:74-99`). Adds sharded-checkpoint support
+(`model.safetensors.index.json`), which the reference lacks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from jimm_tpu.weights.safetensors_io import load_file
+
+
+def _load_config(path: Path) -> dict[str, Any] | None:
+    if path.is_file():
+        with open(path) as f:
+            return json.load(f)
+    return None
+
+
+def _from_dir(d: Path) -> tuple[dict[str, np.ndarray], dict | None]:
+    config = _load_config(d / "config.json")
+    index = d / "model.safetensors.index.json"
+    if index.is_file():
+        with open(index) as f:
+            weight_map: dict[str, str] = json.load(f)["weight_map"]
+        weights: dict[str, np.ndarray] = {}
+        for shard in sorted(set(weight_map.values())):
+            weights.update(load_file(d / shard))
+        return weights, config
+    single = d / "model.safetensors"
+    if single.is_file():
+        return load_file(single), config
+    candidates = sorted(d.glob("*.safetensors"))
+    if candidates:
+        weights = {}
+        for c in candidates:
+            weights.update(load_file(c))
+        return weights, config
+    raise FileNotFoundError(f"no .safetensors weights under {d}")
+
+
+def _from_file(p: Path) -> tuple[dict[str, np.ndarray], dict | None]:
+    weights = load_file(p)
+    # config discovery: sibling config.json, else parent of a `model/` dir
+    # (ref common/utils.py:77-86)
+    config = _load_config(p.parent / "config.json")
+    if config is None and p.parent.name == "model":
+        config = _load_config(p.parent.parent / "config.json")
+    return weights, config
+
+
+def _from_hub(repo_id: str) -> tuple[dict[str, np.ndarray], dict | None]:
+    try:
+        from huggingface_hub import hf_hub_download
+    except ImportError as e:  # pragma: no cover
+        raise FileNotFoundError(
+            f"{repo_id!r} is not a local path and huggingface_hub is "
+            "unavailable") from e
+    weights: dict[str, np.ndarray] = {}
+    try:
+        # sharded checkpoints first (large models), then the single file
+        try:
+            index_path = hf_hub_download(repo_id,
+                                         "model.safetensors.index.json")
+            with open(index_path) as f:
+                weight_map: dict[str, str] = json.load(f)["weight_map"]
+            for shard in sorted(set(weight_map.values())):
+                weights.update(load_file(hf_hub_download(repo_id, shard)))
+        except Exception:
+            weights = load_file(hf_hub_download(repo_id, "model.safetensors"))
+    except Exception as e:
+        raise FileNotFoundError(
+            f"could not fetch {repo_id!r} from the HF hub (offline?): {e}"
+        ) from e
+    try:
+        config_path = hf_hub_download(repo_id, "config.json")
+        config = _load_config(Path(config_path))
+    except Exception:
+        config = None
+    return weights, config
+
+
+def resolve_checkpoint(name_or_path: str | os.PathLike
+                       ) -> tuple[dict[str, np.ndarray], dict | None]:
+    """Return ``(flat hf tensor dict, hf config dict | None)``."""
+    p = Path(name_or_path).expanduser()
+    if p.is_dir():
+        return _from_dir(p)
+    if p.is_file():
+        return _from_file(p)
+    name = str(name_or_path)
+    if name.startswith((".", "/", "~")) or name.count("/") != 1:
+        # filesystem-looking, but nothing there — don't confuse with a repo id
+        raise FileNotFoundError(f"no checkpoint file or directory at {name!r}")
+    return _from_hub(name)

@@ -1,0 +1,89 @@
+"""Pure-numpy safetensors reader/writer — no torch, no Rust wheel needed.
+
+The format (https://github.com/huggingface/safetensors) is: 8-byte LE uint64
+header length, a JSON header mapping tensor name -> {dtype, shape,
+data_offsets}, then raw little-endian tensor bytes. The reference depends on
+the `safetensors` wheel (ref `src/jimm/common/utils.py:11,102`); this
+implementation removes the dependency (SURVEY §2.2) and adds bf16 support via
+`ml_dtypes` (already a jax dependency).
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+from typing import Any, Mapping
+
+import ml_dtypes
+import numpy as np
+
+_DTYPES: dict[str, np.dtype] = {
+    "F64": np.dtype(np.float64),
+    "F32": np.dtype(np.float32),
+    "F16": np.dtype(np.float16),
+    "BF16": np.dtype(ml_dtypes.bfloat16),
+    "F8_E4M3": np.dtype(ml_dtypes.float8_e4m3fn),
+    "F8_E5M2": np.dtype(ml_dtypes.float8_e5m2),
+    "I64": np.dtype(np.int64),
+    "I32": np.dtype(np.int32),
+    "I16": np.dtype(np.int16),
+    "I8": np.dtype(np.int8),
+    "U8": np.dtype(np.uint8),
+    "U16": np.dtype(np.uint16),
+    "U32": np.dtype(np.uint32),
+    "U64": np.dtype(np.uint64),
+    "BOOL": np.dtype(np.bool_),
+}
+_DTYPE_NAMES = {v: k for k, v in _DTYPES.items()}
+
+
+def load_file(path: str | os.PathLike) -> dict[str, np.ndarray]:
+    """Read every tensor from a .safetensors file (zero-copy mmap views)."""
+    with open(path, "rb") as f:
+        header_len = int.from_bytes(f.read(8), "little")
+        header: dict[str, Any] = json.loads(f.read(header_len))
+        data_start = 8 + header_len
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    out: dict[str, np.ndarray] = {}
+    for name, info in header.items():
+        if name == "__metadata__":
+            continue
+        dtype = _DTYPES[info["dtype"]]
+        start, end = info["data_offsets"]
+        count = (end - start) // dtype.itemsize
+        # np.frombuffer over the mmap is a true zero-copy view; slicing the
+        # mmap object would copy the bytes
+        arr = np.frombuffer(mm, dtype=dtype, count=count,
+                            offset=data_start + start).reshape(info["shape"])
+        out[name] = arr
+    return out
+
+
+def save_file(tensors: Mapping[str, np.ndarray], path: str | os.PathLike,
+              metadata: Mapping[str, str] | None = None) -> None:
+    """Write tensors to a .safetensors file (HF-interoperable export)."""
+    header: dict[str, Any] = {}
+    if metadata:
+        header["__metadata__"] = dict(metadata)
+    offset = 0
+    blobs: list[bytes] = []
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        dt = np.dtype(arr.dtype)
+        if dt not in _DTYPE_NAMES:
+            raise ValueError(f"unsupported dtype {dt} for tensor {name!r}")
+        blob = arr.tobytes()
+        header[name] = {"dtype": _DTYPE_NAMES[dt], "shape": list(arr.shape),
+                        "data_offsets": [offset, offset + len(blob)]}
+        blobs.append(blob)
+        offset += len(blob)
+    hjson = json.dumps(header, separators=(",", ":")).encode()
+    # pad header to 8-byte alignment like the upstream implementation
+    pad = (8 - len(hjson) % 8) % 8
+    hjson += b" " * pad
+    with open(path, "wb") as f:
+        f.write(len(hjson).to_bytes(8, "little"))
+        f.write(hjson)
+        for blob in blobs:
+            f.write(blob)

@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device virtual CPU platform so sharding,
+FSDP/TP, ring-loss, and distributed tests run without a TPU pod
+(SURVEY §4 "Implication for the build").
+
+Must run before jax initializes a backend — pytest imports conftest first.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng() -> np.random.RandomState:
+    return np.random.RandomState(0)
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs

@@ -1,0 +1,70 @@
+"""Helpers to build tiny *random-init* HF torch oracle checkpoints locally.
+
+The reference's tests download real checkpoints from the network at test time
+(ref `tests/test_vit.py:17-52`), which is impossible offline and slow anyway.
+Instead we instantiate the HF torch modeling code from a config (no network),
+save a safetensors checkpoint to a tmpdir, and use the torch forward as the
+numerical oracle. This exercises the exact same mapping/parity surface.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TINY_TEXT = dict(hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                 num_attention_heads=2, vocab_size=100,
+                 max_position_embeddings=16, eos_token_id=99)
+TINY_VISION = dict(hidden_size=96, intermediate_size=192, num_hidden_layers=3,
+                   num_attention_heads=3, image_size=32, patch_size=16)
+
+
+def save_tiny_vit(tmpdir, **overrides) -> str:
+    import torch  # noqa: F401  (test-only oracle; never imported by jimm_tpu)
+    from transformers import ViTConfig, ViTForImageClassification
+    cfg = ViTConfig(hidden_size=64, num_hidden_layers=3, num_attention_heads=4,
+                    intermediate_size=128, image_size=48, patch_size=16,
+                    num_labels=7, **overrides)
+    model = ViTForImageClassification(cfg).eval()
+    model.save_pretrained(tmpdir, safe_serialization=True)
+    return str(tmpdir)
+
+
+def save_tiny_clip(tmpdir, projection_dim: int = 32) -> str:
+    from transformers import CLIPConfig, CLIPModel
+    cfg = CLIPConfig(text_config=dict(TINY_TEXT), vision_config=dict(TINY_VISION),
+                     projection_dim=projection_dim)
+    model = CLIPModel(cfg).eval()
+    model.save_pretrained(tmpdir, safe_serialization=True)
+    return str(tmpdir)
+
+
+def save_tiny_siglip(tmpdir, mlp_ratio_text: int = 2) -> str:
+    """SigLIP towers must share hidden_size; use a non-4x MLP on purpose
+    (So400m-class capability the reference lacks, SURVEY §2.4)."""
+    from transformers import SiglipConfig, SiglipModel
+    text = dict(TINY_TEXT, hidden_size=96, num_attention_heads=3,
+                intermediate_size=96 * mlp_ratio_text)
+    cfg = SiglipConfig(text_config=text, vision_config=dict(TINY_VISION))
+    model = SiglipModel(cfg).eval()
+    model.save_pretrained(tmpdir, safe_serialization=True)
+    return str(tmpdir)
+
+
+def sample_image(rng: np.random.RandomState, n: int = 2, size: int = 32
+                 ) -> np.ndarray:
+    return rng.randn(n, size, size, 3).astype(np.float32)
+
+
+def sample_text(rng: np.random.RandomState, n: int = 2, seq: int = 16
+                ) -> np.ndarray:
+    """Token ids with the EOT (max id 99) at a distinct position per row, so
+    argmax-EOT pooling (CLIP) and HF eos-position pooling coincide."""
+    txt = rng.randint(1, 90, size=(n, seq))
+    for row in range(n):
+        txt[row, 5 + row] = 99
+    return txt
+
+
+def torch_image(img_nhwc: np.ndarray):
+    import torch
+    return torch.tensor(img_nhwc).permute(0, 3, 1, 2)

@@ -1,0 +1,72 @@
+"""CLIP parity tests (reference anchor: `tests/test_clip.py`, atol there 1e-1
+— we hold ~1e-5)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jimm_tpu import CLIP
+
+from hf_util import sample_image, sample_text, save_tiny_clip, torch_image
+
+
+@pytest.fixture(scope="module")
+def clip_ckpt(tmp_path_factory):
+    return save_tiny_clip(tmp_path_factory.mktemp("clip"))
+
+
+@pytest.fixture(scope="module")
+def oracle(clip_ckpt):
+    from transformers import CLIPModel
+    return CLIPModel.from_pretrained(clip_ckpt).eval()
+
+
+def test_logits_per_image_parity(clip_ckpt, oracle, rng):
+    import torch
+    model = CLIP.from_pretrained(clip_ckpt)
+    img, txt = sample_image(rng), sample_text(rng)
+    ours = np.asarray(model(jnp.asarray(img), jnp.asarray(txt)))
+    with torch.no_grad():
+        theirs = oracle(input_ids=torch.tensor(txt),
+                        pixel_values=torch_image(img)).logits_per_image.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+def test_encode_image_and_text_parity(clip_ckpt, oracle, rng):
+    import torch
+    model = CLIP.from_pretrained(clip_ckpt)
+    img, txt = sample_image(rng), sample_text(rng)
+    with torch.no_grad():
+        img_ref = oracle.get_image_features(torch_image(img)).numpy()
+        txt_ref = oracle.get_text_features(torch.tensor(txt)).numpy()
+    np.testing.assert_allclose(np.asarray(model.encode_image(jnp.asarray(img))),
+                               img_ref, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(model.encode_text(jnp.asarray(txt))),
+                               txt_ref, atol=1e-4)
+
+
+def test_eot_pooling_uses_argmax(clip_ckpt, rng):
+    """Moving the EOT token must change which position is pooled
+    (ref `models/clip.py:164-166`)."""
+    model = CLIP.from_pretrained(clip_ckpt)
+    txt = sample_text(rng, n=1)
+    a = np.asarray(model.encode_text(jnp.asarray(txt)))
+    txt2 = txt.copy()
+    eot_pos = int(np.argmax(txt2[0]))
+    txt2[0, eot_pos] = 1
+    txt2[0, (eot_pos + 3) % txt2.shape[1]] = 99
+    b = np.asarray(model.encode_text(jnp.asarray(txt2)))
+    assert np.abs(a - b).max() > 1e-3
+
+
+def test_shape_inference_without_config(clip_ckpt, tmp_path, rng):
+    import os, shutil
+    d = tmp_path / "noconfig"
+    d.mkdir()
+    shutil.copy(os.path.join(clip_ckpt, "model.safetensors"), d)
+    model = CLIP.from_pretrained(str(d / "model.safetensors"))
+    assert model.config.vision.width == 96
+    assert model.config.text.width == 64
+    assert model.config.projection_dim == 32
+    out = model(jnp.asarray(sample_image(rng)), jnp.asarray(sample_text(rng)))
+    assert out.shape == (2, 2)

@@ -1,0 +1,59 @@
+"""Pure-numpy safetensors reader/writer roundtrip + interop with the
+upstream Rust wheel."""
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from jimm_tpu.weights.safetensors_io import load_file, save_file
+
+
+@pytest.fixture
+def tensors(rng):
+    return {
+        "a.weight": rng.randn(4, 8).astype(np.float32),
+        "a.bias": rng.randn(8).astype(np.float16),
+        "b.scale": rng.randn(3, 3, 2).astype(np.float64),
+        "b.bf16": rng.randn(5, 7).astype(np.float32).astype(ml_dtypes.bfloat16),
+        "ids": np.arange(12, dtype=np.int64).reshape(3, 4),
+        "flag": np.array([True, False]),
+    }
+
+
+def test_roundtrip(tensors, tmp_path):
+    path = tmp_path / "t.safetensors"
+    save_file(tensors, path)
+    loaded = load_file(path)
+    assert set(loaded) == set(tensors)
+    for k in tensors:
+        assert loaded[k].dtype == tensors[k].dtype
+        np.testing.assert_array_equal(loaded[k], tensors[k])
+
+
+def test_reads_upstream_wheel_output(tensors, tmp_path):
+    st = pytest.importorskip("safetensors.numpy")
+    path = tmp_path / "up.safetensors"
+    upstream = {k: v for k, v in tensors.items()
+                if v.dtype != ml_dtypes.bfloat16}
+    st.save_file(upstream, str(path))
+    loaded = load_file(path)
+    for k in upstream:
+        np.testing.assert_array_equal(loaded[k], upstream[k])
+
+
+def test_upstream_wheel_reads_our_output(tensors, tmp_path):
+    st = pytest.importorskip("safetensors.numpy")
+    path = tmp_path / "ours.safetensors"
+    ours = {k: v for k, v in tensors.items()
+            if v.dtype != ml_dtypes.bfloat16}
+    save_file(ours, path, metadata={"format": "jimm_tpu"})
+    loaded = st.load_file(str(path))
+    for k in ours:
+        np.testing.assert_array_equal(loaded[k], ours[k])
+
+
+def test_metadata_ignored_on_load(tmp_path, rng):
+    path = tmp_path / "m.safetensors"
+    save_file({"x": rng.randn(2).astype(np.float32)}, path,
+              metadata={"origin": "test"})
+    assert set(load_file(path)) == {"x"}
