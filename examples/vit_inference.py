@@ -1,0 +1,60 @@
+"""Batched sharded ViT inference (equivalent of the reference's
+`examples/vit_inference.py`: bf16 `from_pretrained` on a mesh, jit once,
+reuse across batches).
+
+Run:  python examples/vit_inference.py --checkpoint <dir-or-hub-id> \
+          [--batches 8 --batch-size 128 --model-axis 1]
+"""
+
+from __future__ import annotations
+
+import jimm_tpu.utils.env
+jimm_tpu.utils.env.configure_platform()
+
+import argparse
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from jimm_tpu import VisionTransformer
+from jimm_tpu.parallel import (TENSOR_PARALLEL, make_mesh, shard_batch,
+                               use_sharding)
+from jimm_tpu.utils import jit_forward
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--checkpoint", required=True,
+                   help="local safetensors file/dir or HF hub id")
+    p.add_argument("--batches", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--model-axis", type=int, default=1)
+    args = p.parse_args()
+
+    mesh = make_mesh({"data": -1, "model": args.model_axis})
+    model = VisionTransformer.from_pretrained(args.checkpoint, mesh=mesh,
+                                              dtype=jnp.bfloat16)
+    size = model.config.vision.image_size
+    print(f"loaded {args.checkpoint}: {model.config.vision.width}w x "
+          f"{model.config.vision.depth}d, {size}px, "
+          f"{model.config.num_classes} classes, mesh {dict(mesh.shape)}")
+
+    forward = jit_forward(model)  # jit once, reuse across batches
+    rng = np.random.RandomState(0)
+    with use_sharding(mesh, TENSOR_PARALLEL):
+        for i in range(args.batches):
+            batch = shard_batch(
+                rng.rand(args.batch_size, size, size, 3).astype(np.float32),
+                mesh, TENSOR_PARALLEL)
+            t0 = time.perf_counter()
+            logits = forward(batch.astype(jnp.bfloat16))
+            logits.block_until_ready()
+            dt = time.perf_counter() - t0
+            preds = np.asarray(jnp.argmax(logits, -1))[:4]
+            print(f"batch {i}: {args.batch_size / dt:7.1f} img/s  "
+                  f"top classes {preds}")
+
+
+if __name__ == "__main__":
+    main()

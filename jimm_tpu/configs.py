@@ -20,6 +20,21 @@ Activation = Literal["gelu", "gelu_tanh", "quick_gelu"]
 AttnImpl = Literal["auto", "xla", "flash"]
 
 
+def normalize_act(name: str | None, default: str = "gelu") -> str:
+    """HF ``hidden_act`` -> canonical Activation name."""
+    if name is None:
+        return default
+    return {"gelu": "gelu", "gelu_new": "gelu_tanh",
+            "gelu_pytorch_tanh": "gelu_tanh",
+            "quick_gelu": "quick_gelu"}.get(name, name)
+
+
+def act_to_hf(name: str) -> str:
+    """Canonical Activation name -> HF ``hidden_act``."""
+    return {"gelu": "gelu", "gelu_tanh": "gelu_pytorch_tanh",
+            "quick_gelu": "quick_gelu"}.get(name, name)
+
+
 @dataclass(frozen=True)
 class TransformerConfig:
     """Shared encoder-stack hyperparameters (vision or text tower)."""
@@ -106,6 +121,9 @@ class TextConfig:
     causal: bool = True
     pooling: Pooling = "eot"
     proj_bias: bool = False  # CLIP text_projection is bias-free; SigLIP head has bias
+    # recorded at load, re-emitted at export; HF CLIP pools at this token's
+    # first occurrence (argmax-equivalent when EOT is the max id)
+    eos_token_id: int | None = None
     attn_impl: AttnImpl = "auto"
     remat: bool = False
 

@@ -1,0 +1,4 @@
+from jimm_tpu.data.pipeline import PrefetchIterator
+from jimm_tpu.data.synthetic import blob_classification, contrastive_pairs
+
+__all__ = ["PrefetchIterator", "blob_classification", "contrastive_pairs"]

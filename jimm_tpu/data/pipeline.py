@@ -1,0 +1,70 @@
+"""Host-side input pipeline: background prefetch + device placement.
+
+The reference's loop does a blocking numpy->device copy every step
+(ref `examples/vit_training.py:45-57,214-226`), serializing host work with
+TPU compute. This pipeline runs the producer in a worker thread and keeps a
+small queue of batches already ``device_put`` onto the mesh, so the next
+batch's H2D transfer overlaps the current step.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator
+
+import jax
+
+from jimm_tpu.parallel.sharding import DATA_PARALLEL, ShardingRules, shard_batch
+
+
+class PrefetchIterator:
+    """Wrap a host batch iterator; yields device-resident batches."""
+
+    def __init__(self, source: Iterator[Any], *,
+                 mesh: jax.sharding.Mesh | None = None,
+                 rules: ShardingRules | str = DATA_PARALLEL,
+                 prefetch: int = 2,
+                 place: Callable[[Any], Any] | None = None):
+        self._source = source
+        if place is not None:
+            self._place = place
+        elif mesh is not None:
+            self._place = lambda b: shard_batch(b, mesh, rules)
+        else:
+            self._place = lambda b: jax.tree.map(jax.device_put, b)
+        self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
+        self._stop = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self) -> None:
+        try:
+            for batch in self._source:
+                if self._stop.is_set():
+                    return
+                self._queue.put(self._place(batch))
+        except Exception as e:  # surface producer errors to the consumer
+            self._queue.put(e)
+        self._queue.put(StopIteration())
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self) -> Any:
+        if self._done:
+            raise StopIteration
+        item = self._queue.get()
+        if isinstance(item, StopIteration):
+            self._done = True
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._done = True
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        while not self._queue.empty():
+            self._queue.get_nowait()

@@ -1,0 +1,51 @@
+"""Synthetic datasets for offline training demos/benchmarks.
+
+The reference's training example depends on a tfds MNIST download
+(ref `examples/vit_training.py:205-212`), which needs network. These
+generators are procedural (learnable but offline) and shape-compatible with
+the real pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+def blob_classification(batch_size: int, *, image_size: int = 28,
+                        num_classes: int = 4, channels: int = 3,
+                        seed: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Classify which quadrant contains a bright Gaussian blob — a learnable
+    stand-in for MNIST in the from-scratch training demo."""
+    rng = np.random.RandomState(seed)
+    grid = np.stack(np.meshgrid(np.arange(image_size), np.arange(image_size),
+                                indexing="ij"), -1).astype(np.float32)
+    half = image_size / 2
+    centers = np.asarray([(0.25, 0.25), (0.25, 0.75), (0.75, 0.25),
+                          (0.75, 0.75)], np.float32) * image_size
+    while True:
+        labels = rng.randint(0, num_classes, size=batch_size)
+        jitter = rng.randn(batch_size, 2).astype(np.float32) * half * 0.15
+        mu = centers[labels % 4] + jitter
+        d2 = np.sum((grid[None] - mu[:, None, None]) ** 2, -1)
+        images = np.exp(-d2 / (2 * (image_size * 0.08) ** 2))
+        images = images[..., None].repeat(channels, -1)
+        images += rng.randn(*images.shape).astype(np.float32) * 0.05
+        yield images.astype(np.float32), labels.astype(np.int32)
+
+
+def contrastive_pairs(batch_size: int, *, image_size: int = 32,
+                      vocab_size: int = 64, seq_len: int = 8,
+                      channels: int = 3, seed: int = 0
+                      ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Image/text pairs with shared latent structure: the text tokens encode
+    the blob quadrant, so contrastive training has signal to align on."""
+    rng = np.random.RandomState(seed)
+    img_gen = blob_classification(batch_size, image_size=image_size,
+                                  num_classes=4, channels=channels, seed=seed)
+    while True:
+        images, labels = next(img_gen)
+        text = rng.randint(4, vocab_size, size=(batch_size, seq_len))
+        text[:, 0] = labels  # class token leads the caption
+        yield images, text.astype(np.int32)

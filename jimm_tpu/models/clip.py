@@ -16,17 +16,13 @@ import jax.numpy as jnp
 import numpy as np
 from flax import nnx
 
-from jimm_tpu.configs import CLIPConfig, TextConfig, VisionConfig
+from jimm_tpu.configs import act_to_hf, normalize_act, CLIPConfig, TextConfig, VisionConfig
 from jimm_tpu.nn.text import TextTower
 from jimm_tpu.nn.vision import VisionTower
 from jimm_tpu.parallel.sharding import (ShardingRules, TENSOR_PARALLEL,
                                         logical, shard_model)
 from jimm_tpu.weights.loader import M, T, apply_mapping
 from jimm_tpu.weights.resolve import resolve_checkpoint
-
-
-def _scalar(w: np.ndarray) -> np.ndarray:
-    return np.asarray(w).reshape(())
 
 
 class CLIP(nnx.Module):
@@ -95,7 +91,7 @@ class CLIP(nnx.Module):
                                  max(1, vc.get("hidden_size", 768) // 64)),
                 mlp_dim=vc.get("intermediate_size",
                                4 * vc.get("hidden_size", 768)),
-                act=vc.get("hidden_act", "quick_gelu"),
+                act=normalize_act(vc.get("hidden_act"), "quick_gelu"),
                 ln_eps=vc.get("layer_norm_eps", 1e-5),
                 pooling="cls", pre_norm=True, patch_bias=False)
             text = TextConfig(
@@ -107,9 +103,10 @@ class CLIP(nnx.Module):
                                  max(1, tc.get("hidden_size", 512) // 64)),
                 mlp_dim=tc.get("intermediate_size",
                                4 * tc.get("hidden_size", 512)),
-                act=tc.get("hidden_act", "quick_gelu"),
+                act=normalize_act(tc.get("hidden_act"), "quick_gelu"),
                 ln_eps=tc.get("layer_norm_eps", 1e-5),
-                causal=True, pooling="eot", proj_bias=False)
+                causal=True, pooling="eot", proj_bias=False,
+                eos_token_id=tc.get("eos_token_id"))
             return CLIPConfig(vision=vision, text=text,
                               projection_dim=config.get("projection_dim", 512))
         # shape inference (ref models/clip.py:208-247)
@@ -167,7 +164,7 @@ class CLIP(nnx.Module):
 
         return [
             M("vision.cls_token", "vision_model.embeddings.class_embedding",
-              lambda w: w.reshape(1, 1, -1)),
+              T.reshape_1_1_d),
             M("vision.pos_embed",
               "vision_model.embeddings.position_embedding.weight",
               T.unsqueeze),
@@ -186,7 +183,7 @@ class CLIP(nnx.Module):
             M("text.ln_final.scale", "text_model.final_layer_norm.weight"),
             M("text.ln_final.bias", "text_model.final_layer_norm.bias"),
             M("text_projection.kernel", "text_projection.weight", T.linear),
-            M("logit_scale", "logit_scale", _scalar),
+            M("logit_scale", "logit_scale", T.scalar),
             *tower("vision.", "vision_model."),
             *tower("text.", "text_model."),
         ]
@@ -206,3 +203,45 @@ class CLIP(nnx.Module):
                       num_layers_by_prefix={"text.": cfg.text.depth},
                       param_dtype=param_dtype)
         return model
+
+    # ------------------------------------------------------------------
+    # Checkpoint saving (HF-interoperable; absent from the reference)
+    # ------------------------------------------------------------------
+
+    def hf_config(self) -> dict:
+        cfg = self.config
+        vision = {
+            "projection_dim": cfg.projection_dim,
+            "hidden_size": cfg.vision.width,
+            "num_hidden_layers": cfg.vision.depth,
+            "num_attention_heads": cfg.vision.num_heads,
+            "intermediate_size": cfg.vision.mlp_dim,
+            "image_size": cfg.vision.image_size,
+            "patch_size": cfg.vision.patch_size,
+            "hidden_act": act_to_hf(cfg.vision.act),
+            "layer_norm_eps": cfg.vision.ln_eps,
+        }
+        text = {
+            "projection_dim": cfg.projection_dim,
+            # eos 2 selects HF's legacy argmax pooling = our EOT semantics
+            "eos_token_id": (cfg.text.eos_token_id
+                             if cfg.text.eos_token_id is not None else 2),
+            "hidden_size": cfg.text.width,
+            "num_hidden_layers": cfg.text.depth,
+            "num_attention_heads": cfg.text.num_heads,
+            "intermediate_size": cfg.text.mlp_dim,
+            "vocab_size": cfg.text.vocab_size,
+            "max_position_embeddings": cfg.text.context_length,
+            "hidden_act": act_to_hf(cfg.text.act),
+            "layer_norm_eps": cfg.text.ln_eps,
+        }
+        return {
+            "architectures": ["CLIPModel"],
+            "model_type": "clip",
+            "projection_dim": cfg.projection_dim,
+            "vision_config": vision, "text_config": text,
+        }
+
+    def save_pretrained(self, save_dir) -> None:
+        from jimm_tpu.weights.export import save_pretrained
+        save_pretrained(self, save_dir)

@@ -18,17 +18,13 @@ import jax.numpy as jnp
 import numpy as np
 from flax import nnx
 
-from jimm_tpu.configs import SigLIPConfig, TextConfig, VisionConfig
+from jimm_tpu.configs import act_to_hf, normalize_act, SigLIPConfig, TextConfig, VisionConfig
 from jimm_tpu.nn.text import TextTower
 from jimm_tpu.nn.vision import VisionTower
 from jimm_tpu.parallel.sharding import (ShardingRules, TENSOR_PARALLEL,
                                         logical, shard_model)
 from jimm_tpu.weights.loader import M, T, apply_mapping
 from jimm_tpu.weights.resolve import resolve_checkpoint
-
-
-def _scalar(w: np.ndarray) -> np.ndarray:
-    return np.asarray(w).reshape(())
 
 
 class SigLIP(nnx.Module):
@@ -106,14 +102,14 @@ class SigLIP(nnx.Module):
             image_size=image, patch_size=patch, width=v_width, depth=v_depth,
             num_heads=vc.get("num_attention_heads", max(1, v_width // 64)),
             mlp_dim=w["vision_model.encoder.layers.0.mlp.fc1.weight"].shape[0],
-            act=vc.get("hidden_act", "gelu_tanh"),
+            act=normalize_act(vc.get("hidden_act"), "gelu_tanh"),
             ln_eps=vc.get("layer_norm_eps", 1e-6),
             pooling="map", pre_norm=False, patch_bias=True)
         text = TextConfig(
             vocab_size=vocab, context_length=ctx, width=t_width, depth=t_depth,
             num_heads=tc.get("num_attention_heads", max(1, t_width // 64)),
             mlp_dim=w["text_model.encoder.layers.0.mlp.fc1.weight"].shape[0],
-            act=tc.get("hidden_act", "gelu_tanh"),
+            act=normalize_act(tc.get("hidden_act"), "gelu_tanh"),
             ln_eps=tc.get("layer_norm_eps", 1e-6),
             causal=False, pooling="last", proj_bias=True)
         proj = w["text_model.head.weight"].shape[0]
@@ -187,8 +183,8 @@ class SigLIP(nnx.Module):
             M("text.ln_final.bias", "text_model.final_layer_norm.bias"),
             M("text_projection.kernel", "text_model.head.weight", T.linear),
             M("text_projection.bias", "text_model.head.bias"),
-            M("logit_scale", "logit_scale", _scalar),
-            M("logit_bias", "logit_bias", _scalar),
+            M("logit_scale", "logit_scale", T.scalar_1d),
+            M("logit_bias", "logit_bias", T.scalar_1d),
             *tower("vision.", "vision_model."),
             *tower("text.", "text_model."),
         ]
@@ -208,3 +204,40 @@ class SigLIP(nnx.Module):
                       num_layers_by_prefix={"text.": cfg.text.depth},
                       param_dtype=param_dtype)
         return model
+
+    # ------------------------------------------------------------------
+    # Checkpoint saving (HF-interoperable; absent from the reference)
+    # ------------------------------------------------------------------
+
+    def hf_config(self) -> dict:
+        cfg = self.config
+        vision = {
+            "hidden_size": cfg.vision.width,
+            "num_hidden_layers": cfg.vision.depth,
+            "num_attention_heads": cfg.vision.num_heads,
+            "intermediate_size": cfg.vision.mlp_dim,
+            "image_size": cfg.vision.image_size,
+            "patch_size": cfg.vision.patch_size,
+            "hidden_act": act_to_hf(cfg.vision.act),
+            "layer_norm_eps": cfg.vision.ln_eps,
+        }
+        text = {
+            "hidden_size": cfg.text.width,
+            "num_hidden_layers": cfg.text.depth,
+            "num_attention_heads": cfg.text.num_heads,
+            "intermediate_size": cfg.text.mlp_dim,
+            "vocab_size": cfg.text.vocab_size,
+            "max_position_embeddings": cfg.text.context_length,
+            "hidden_act": act_to_hf(cfg.text.act),
+            "layer_norm_eps": cfg.text.ln_eps,
+        }
+        return {
+            "architectures": ["SiglipModel"],
+            "model_type": "siglip",
+            
+            "vision_config": vision, "text_config": text,
+        }
+
+    def save_pretrained(self, save_dir) -> None:
+        from jimm_tpu.weights.export import save_pretrained
+        save_pretrained(self, save_dir)

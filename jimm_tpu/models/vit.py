@@ -17,22 +17,12 @@ import jax.numpy as jnp
 import numpy as np
 from flax import nnx
 
-from jimm_tpu.configs import VisionConfig, ViTConfig
+from jimm_tpu.configs import VisionConfig, ViTConfig, act_to_hf, normalize_act
 from jimm_tpu.nn.vision import VisionTower
 from jimm_tpu.parallel.sharding import (ShardingRules, TENSOR_PARALLEL, logical,
                                         shard_model)
 from jimm_tpu.weights.loader import M, T, apply_mapping
 from jimm_tpu.weights.resolve import resolve_checkpoint
-
-
-def _act_from_hf(name: str | None) -> str:
-    if name in (None, "gelu"):
-        return "gelu"
-    if name == "quick_gelu":
-        return "quick_gelu"
-    if name in ("gelu_new", "gelu_pytorch_tanh"):
-        return "gelu_tanh"
-    return name  # get_activation warns + falls back (ref models/vit.py:139-142)
 
 
 class VisionTransformer(nnx.Module):
@@ -85,7 +75,7 @@ class VisionTransformer(nnx.Module):
                 depth=config.get("num_hidden_layers", 12),
                 num_heads=config.get("num_attention_heads", 12),
                 mlp_dim=config.get("intermediate_size", 4 * config.get("hidden_size", 768)),
-                act=_act_from_hf(config.get("hidden_act")),
+                act=normalize_act(config.get("hidden_act")),
                 ln_eps=config.get("layer_norm_eps", 1e-12),
                 pooling="cls")
             return ViTConfig(vision=vision, num_classes=num_classes,
@@ -170,3 +160,28 @@ class VisionTransformer(nnx.Module):
         apply_mapping(model, weights, cls.hf_mapping(cfg),
                       num_layers=cfg.vision.depth, param_dtype=param_dtype)
         return model
+
+    # ------------------------------------------------------------------
+    # Checkpoint saving (HF-interoperable; absent from the reference)
+    # ------------------------------------------------------------------
+
+    def hf_config(self) -> dict:
+        cfg, v = self.config, self.config.vision
+        act = act_to_hf(v.act)
+        return {
+            "architectures": ["ViTForImageClassification"],
+            "model_type": "vit",
+            "hidden_size": v.width, "num_hidden_layers": v.depth,
+            "num_attention_heads": v.num_heads,
+            "intermediate_size": v.mlp_dim, "image_size": v.image_size,
+            "patch_size": v.patch_size, "num_channels": v.channels,
+            "hidden_act": act, "layer_norm_eps": v.ln_eps,
+            "qkv_bias": True,
+            "id2label": {str(i): f"LABEL_{i}"
+                         for i in range(cfg.num_classes)},
+            "label2id": {f"LABEL_{i}": i for i in range(cfg.num_classes)},
+        }
+
+    def save_pretrained(self, save_dir) -> None:
+        from jimm_tpu.weights.export import save_pretrained
+        save_pretrained(self, save_dir)

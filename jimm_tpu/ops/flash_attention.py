@@ -1,10 +1,312 @@
-"""Pallas TPU flash attention (placeholder: XLA fallback until the kernel
-lands)."""
+"""Pallas TPU flash attention: online-softmax forward + custom-VJP backward.
+
+Replaces ``nnx.MultiHeadAttention``'s materialized (Sq, Sk) attention matrix
+(ref `common/transformer.py:67-87`) with a blocked kernel: per (batch*head,
+q-block) grid cell the kernel streams kv blocks from VMEM, maintaining the
+running max/denominator (the flash-attention recurrence), so HBM traffic is
+O(S*D) instead of O(S^2). The backward pass recomputes attention blockwise
+from the saved logsumexp — two kernels (dq; dk/dv) in the standard
+flash-attention-2 arrangement, fp32 accumulation throughout.
+
+Numerical contract: matches `jimm_tpu.ops.attention.reference_attention`
+(fp32 softmax einsum) to ~1e-5 in f32, tested in interpret mode on CPU and
+compiled on TPU (`tests/test_flash_attention.py`).
+
+Masking uses a large negative constant (not -inf) so padded/fully-masked rows
+degrade to garbage-but-finite values that the wrapper slices off — no NaNs
+reach the gradient.
+"""
 
 from __future__ import annotations
 
+import functools
+from functools import partial
+
 import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
 
 
-def flash_attention(q, k, v, *, is_causal=False):
-    return jax.nn.dot_product_attention(q, k, v, is_causal=is_causal)
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sk_real: int,
+                block_k: int, causal: bool, sm_scale: float):
+    qi = pl.program_id(1)
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    sk = k_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (bq, d)
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = k_pos < sk_real
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # skip kv blocks strictly above the diagonal
+        last = (pl.program_id(1) + 1) * bq  # first masked-out position + 1
+        n_blocks = jnp.minimum(sk // block_k, pl.cdiv(last, block_k))
+    else:
+        n_blocks = sk // block_k
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0, pl.ds(qi * bq, bq)] = m + jnp.log(l_safe)
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   sk_real: int, block_k: int, causal: bool, sm_scale: float):
+    qi = pl.program_id(1)
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    sk = k_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0, pl.ds(qi * bq, bq)]
+    delta = delta_ref[0, 0, pl.ds(qi * bq, bq)]
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = k_pos < sk_real
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    if causal:
+        n_blocks = jnp.minimum(sk // block_k, pl.cdiv((qi + 1) * bq, block_k))
+    else:
+        n_blocks = sk // block_k
+    dq = jax.lax.fori_loop(0, n_blocks, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sq_real: int, block_q: int,
+                    causal: bool, sm_scale: float):
+    ki = pl.program_id(1)
+    bk, d = k_ref.shape[1], k_ref.shape[2]
+    sq = q_ref.shape[1]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) \
+            * sm_scale
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        mask = q_pos < sq_real
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    if causal:
+        # q blocks whose last row is still left of this kv block never land
+        start = (ki * bk) // block_q
+    else:
+        start = 0
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, sq // block_q, body, (dk0, dv0))
+    # note: q was pre-scaled by sm_scale, so ds.T @ q already carries the
+    # chain-rule factor for dk — no extra scaling here
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Host-side wrappers
+# ---------------------------------------------------------------------------
+
+def _flatten_heads(x: jax.Array) -> jax.Array:
+    b, s, n, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * n, s, d)
+
+
+def _unflatten_heads(x: jax.Array, b: int, n: int) -> jax.Array:
+    bn, s, d = x.shape
+    return x.reshape(b, n, s, d).transpose(0, 2, 1, 3)
+
+
+def _pad_seq(x: jax.Array, target: int) -> jax.Array:
+    pad = target - x.shape[1]
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+
+
+@functools.cache
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _flash_fwd_impl(q3, k3, v3, causal, sm_scale, block_q, block_k):
+    bn, sq, d = q3.shape
+    sk = k3.shape[1]
+    sq_p, sk_p = _ceil_to(sq, block_q), _ceil_to(sk, block_k)
+    qp, kp, vp = (_pad_seq(q3, sq_p), _pad_seq(k3, sk_p), _pad_seq(v3, sk_p))
+    grid = (bn, sq_p // block_q)
+    kernel = partial(_fwd_kernel, sk_real=sk, block_k=block_k, causal=causal,
+                     sm_scale=sm_scale)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, sk_p, d), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((1, sk_p, d), lambda h, i: (h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, 1, sq_p), lambda h, i: (h, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, sq_p, d), q3.dtype),
+            jax.ShapeDtypeStruct((bn, 1, sq_p), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return o[:, :sq], (q3, k3, v3, o[:, :sq], lse[:, 0, :sq])
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q3, k3, v3, causal, sm_scale, block_q, block_k):
+    o, _ = _flash_fwd_impl(q3, k3, v3, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q3, k3, v3, causal, sm_scale, block_q, block_k):
+    return _flash_fwd_impl(q3, k3, v3, causal, sm_scale, block_q, block_k)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
+    q3, k3, v3, o, lse = res
+    bn, sq, d = q3.shape
+    sk = k3.shape[1]
+    sq_p, sk_p = _ceil_to(sq, block_q), _ceil_to(sk, block_k)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    qp, dop = _pad_seq(q3, sq_p), _pad_seq(do, sq_p)
+    kp, vp = _pad_seq(k3, sk_p), _pad_seq(v3, sk_p)
+    lse_p = jnp.pad(lse, ((0, 0), (0, sq_p - lse.shape[1])))[:, None]
+    delta_p = jnp.pad(delta, ((0, 0), (0, sq_p - delta.shape[1])))[:, None]
+
+    dq = pl.pallas_call(
+        partial(_bwd_dq_kernel, sk_real=sk, block_k=block_k, causal=causal,
+                sm_scale=sm_scale),
+        grid=(bn, sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, sk_p, d), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((1, sk_p, d), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, 1, sq_p), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((1, 1, sq_p), lambda h, i: (h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bn, sq_p, d), q3.dtype),
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lse_p, delta_p)[:, :sq]
+
+    dk, dv = pl.pallas_call(
+        partial(_bwd_dkv_kernel, sq_real=sq, block_q=block_q, causal=causal,
+                sm_scale=sm_scale),
+        grid=(bn, sk_p // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq_p, d), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, sq_p, d), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((1, 1, sq_p), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((1, 1, sq_p), lambda h, i: (h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i: (h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, sk_p, d), q3.dtype),
+            jax.ShapeDtypeStruct((bn, sk_p, d), q3.dtype),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lse_p, delta_p)
+    return dq, dk[:, :sk], dv[:, :sk]
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    is_causal: bool = False,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """Flash attention over ``(B, S, N, D)`` q/k/v. Scale is 1/sqrt(D) like
+    `jax.nn.dot_product_attention`. Runs the Pallas interpreter off-TPU so
+    CPU tests exercise the same code path."""
+    b, sq, n, d = q.shape
+    sm_scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, _ceil_to(sq, 128))
+    block_k = min(block_k, _ceil_to(k.shape[1], 128))
+    q3, k3, v3 = map(_flatten_heads, (q, k, v))
+    o = _flash(q3, k3, v3, is_causal, sm_scale, block_q, block_k)
+    return _unflatten_heads(o, b, n)

@@ -1,0 +1,93 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context sequence/context parallelism (absent from the reference — max
+sequence there is 577 vision tokens, SURVEY §2.3). The sequence axis is
+sharded over a mesh axis; each device keeps its local query block while
+key/value blocks travel around the ring via ``jax.lax.ppermute``. Online
+(flash-style) softmax accumulation in fp32 makes the result exact — identical
+to full attention — while no device ever materializes the full sequence or
+the full attention matrix. Differentiable end-to-end through the
+``lax.scan``-of-``ppermute`` (JAX AD transposes the permutes).
+
+Complements the Pallas flash kernel (`jimm_tpu/ops/flash_attention.py`):
+flash blocks *within* a chip, the ring blocks *across* chips; compose them by
+passing ``impl="flash"`` so each local block product uses the kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block(q, k, v, mask):
+    """One (q-block x kv-block) partial attention: returns unnormalized
+    accumulator pieces (m, p_sum, pv) in fp32. Shapes (B, Sq, N, D)."""
+    d = q.shape[-1]
+    qf = q.astype(jnp.float32) / jnp.sqrt(d)
+    s = jnp.einsum("bqnd,bknd->bnqk", qf, k.astype(jnp.float32))
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # (B, N, Sq)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bnqk,bknd->bqnd", p, v.astype(jnp.float32))
+    return m, l, pv
+
+
+def _ring_local(q, k, v, *, axis_name: str, causal: bool):
+    n_dev = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+
+    q_pos = idx * sq + jnp.arange(sq)
+
+    def step(carry, j):
+        k_cur, v_cur, m, l, acc = carry
+        src = (idx - j) % n_dev  # ring owner of the current kv chunk
+        k_pos = src * sk + jnp.arange(sk)
+        mask = jnp.ones((sq, sk), bool)
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]
+        m_blk, l_blk, pv_blk = _block(q, k_cur, v_cur,
+                                      mask[None, None])  # (B,N,Sq[,D])
+        m_new = jnp.maximum(m, m_blk)
+        c_old = jnp.exp(m - m_new)
+        c_blk = jnp.exp(m_blk - m_new)
+        l_new = l * c_old + l_blk * c_blk
+        acc_new = (acc * c_old.transpose(0, 2, 1)[..., None]
+                   + pv_blk * c_blk.transpose(0, 2, 1)[..., None])
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, n, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n, sq), jnp.float32)
+    acc0 = jnp.zeros((b, sq, n, d), jnp.float32)
+    (k, v, m, l, acc), _ = jax.lax.scan(step, (k, v, m0, l0, acc0),
+                                        jnp.arange(n_dev))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, mesh: Mesh,
+                   axis_name: str = "seq", is_causal: bool = False
+                   ) -> jax.Array:
+    """Exact attention over ``(B, S, N, D)`` q/k/v whose sequence dim is
+    sharded over ``axis_name``. Equals full (unsharded) attention to fp32
+    accuracy."""
+    fn = shard_map(
+        partial(_ring_local, axis_name=axis_name, causal=is_causal),
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
+        out_specs=P(None, axis_name),
+        check_vma=False)
+    return fn(q, k, v)

@@ -1,0 +1,78 @@
+"""Orbax-based sharded checkpoint save/restore — the reference is load-only
+(SURVEY §5): no save path, no optimizer state, no resume.
+
+Saves the full training state (model params + optimizer state + step) with
+async, sharded orbax writes; restores onto the *current* mesh sharding (so a
+run can resume on a different topology). HF-interoperable safetensors export
+lives in `jimm_tpu/weights/export.py`.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+from flax import nnx
+
+
+def _split_state(obj) -> Any:
+    return nnx.state(obj)
+
+
+class CheckpointManager:
+    """Thin nnx-aware wrapper over ``orbax.checkpoint.CheckpointManager``."""
+
+    def __init__(self, directory: str | Path, *, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        self._mgr = ocp.CheckpointManager(
+            Path(directory).absolute(),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True))
+
+    def save(self, step: int, model: nnx.Module,
+             optimizer: nnx.Optimizer | None = None, *,
+             extra: dict[str, Any] | None = None, force: bool = False) -> bool:
+        """Async-save model (+ optimizer) state at ``step``."""
+        items: dict[str, Any] = {
+            "model": ocp.args.StandardSave(nnx.state(model, nnx.Param))}
+        if optimizer is not None:
+            items["opt"] = ocp.args.StandardSave(
+                nnx.state(optimizer, nnx.optimizer.OptState))
+        if extra:
+            items["extra"] = ocp.args.JsonSave(extra)
+        return self._mgr.save(step, args=ocp.args.Composite(**items),
+                              force=force)
+
+    def restore(self, model: nnx.Module,
+                optimizer: nnx.Optimizer | None = None,
+                *, step: int | None = None) -> int:
+        """Restore in place (onto each param's current sharding); returns the
+        restored step."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError("no checkpoint found")
+        model_state = nnx.state(model, nnx.Param)
+        items: dict[str, Any] = {
+            "model": ocp.args.StandardRestore(model_state)}
+        if optimizer is not None:
+            items["opt"] = ocp.args.StandardRestore(
+                nnx.state(optimizer, nnx.optimizer.OptState))
+        restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
+        nnx.update(model, restored["model"])
+        if optimizer is not None:
+            nnx.update(optimizer, restored["opt"])
+        return step
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,103 @@
+"""Contrastive losses: CLIP softmax and SigLIP sigmoid, plus the ICI ring
+implementation of the sigmoid all-pairs loss.
+
+The reference has no training losses for its dual-tower models at all (only
+the MNIST example's cross-entropy, ref `examples/vit_training.py:76`). The
+north star (`BASELINE.json`) requires the SigLIP sigmoid all-pairs loss as an
+ICI ring: text embeddings travel around the data-parallel ring via
+``jax.lax.ppermute`` inside ``shard_map`` and each device accumulates its
+local-images x traveling-texts chunk — the SigLIP paper's "chunked" algorithm
+— so the full B x B logit matrix is never materialized on one chip.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def clip_softmax_loss(img: jax.Array, txt: jax.Array, logit_scale: jax.Array
+                      ) -> jax.Array:
+    """Symmetric InfoNCE over the global batch (CLIP). Under pjit with batch
+    sharded over "data", XLA inserts the all-gathers for the full logits."""
+    img = img / jnp.linalg.norm(img, axis=-1, keepdims=True)
+    txt = txt / jnp.linalg.norm(txt, axis=-1, keepdims=True)
+    logits = jnp.exp(logit_scale) * img @ txt.T
+    labels = jnp.arange(logits.shape[0])
+    li = optax_softmax_ce(logits, labels)
+    lt = optax_softmax_ce(logits.T, labels)
+    return (li + lt) / 2
+
+
+def optax_softmax_ce(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(logp[jnp.arange(logits.shape[0]), labels])
+
+
+def sigmoid_pairwise_loss(img: jax.Array, txt: jax.Array,
+                          logit_scale: jax.Array, logit_bias: jax.Array
+                          ) -> jax.Array:
+    """Dense SigLIP sigmoid loss over the full batch — the numerical oracle
+    for the ring version (and fine on a single chip).
+
+    loss = -mean_i sum_j log sigmoid(z_ij * (scale * <img_i, txt_j> + bias)),
+    z_ij = +1 on the diagonal, -1 elsewhere (SigLIP paper eq. 1).
+    """
+    img = img / jnp.linalg.norm(img, axis=-1, keepdims=True)
+    txt = txt / jnp.linalg.norm(txt, axis=-1, keepdims=True)
+    logits = jnp.exp(logit_scale) * img @ txt.T + logit_bias
+    n = logits.shape[0]
+    z = 2 * jnp.eye(n, dtype=logits.dtype) - 1
+    return -jnp.sum(jax.nn.log_sigmoid(z * logits)) / n
+
+
+def _ring_sigmoid_local(img: jax.Array, txt: jax.Array, scale: jax.Array,
+                        bias: jax.Array, *, axis_name: str) -> jax.Array:
+    """Per-device body: local images stay put; text chunks ride the ring."""
+    n_dev = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b = img.shape[0]
+    img = img / jnp.linalg.norm(img, axis=-1, keepdims=True)
+    txt = txt / jnp.linalg.norm(txt, axis=-1, keepdims=True)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def chunk_loss(txt_chunk: jax.Array, positives: jax.Array) -> jax.Array:
+        logits = jnp.exp(scale) * img @ txt_chunk.T + bias
+        z = jnp.where(positives, 1.0, -1.0).astype(logits.dtype)
+        return -jnp.sum(jax.nn.log_sigmoid(z * logits))
+
+    def step(carry, j):
+        txt_chunk, acc = carry
+        # chunk j originated on device (idx - j) mod n_dev; positives only
+        # for our own chunk (j == 0)
+        eye = jnp.eye(b, dtype=bool)
+        positives = jnp.where(j == 0, eye, jnp.zeros_like(eye))
+        acc = acc + chunk_loss(txt_chunk, positives)
+        txt_chunk = jax.lax.ppermute(txt_chunk, axis_name, perm)
+        return (txt_chunk, acc), None
+
+    (_, total), _ = jax.lax.scan(step, (txt, jnp.zeros((), img.dtype)),
+                                 jnp.arange(n_dev))
+    # average over the *global* batch like the dense reference
+    total = jax.lax.psum(total, axis_name)
+    return total / (b * n_dev)
+
+
+def ring_sigmoid_loss(img: jax.Array, txt: jax.Array, logit_scale: jax.Array,
+                      logit_bias: jax.Array, *, mesh: Mesh,
+                      axis_name: str = "data") -> jax.Array:
+    """SigLIP sigmoid loss over a batch sharded on ``axis_name``, computed as
+    a ``ppermute`` ring so no device ever holds the global text batch or the
+    full logit matrix. Differentiable end-to-end (``ppermute``'s transpose is
+    the reverse permute, handled by JAX AD)."""
+    fn = shard_map(
+        partial(_ring_sigmoid_local, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(), P()),
+        out_specs=P(),
+        check_vma=False)
+    return fn(img, txt, logit_scale, logit_bias)

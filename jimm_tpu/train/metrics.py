@@ -1,0 +1,147 @@
+"""Profiling & observability: MFU, throughput, structured metric logging.
+
+The reference's only observability is ``print`` per step
+(ref `examples/vit_training.py:226`). The north star requires MFU as the
+metric of record (`BASELINE.json`), so we compute achieved FLOP/s from XLA's
+own cost analysis of the compiled step and divide by the chip's peak.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, IO
+
+import jax
+
+#: Peak dense (bf16) TFLOP/s per chip. Sources: public TPU/GPU spec sheets.
+PEAK_TFLOPS: dict[str, float] = {
+    "tpu v2": 22.5, "tpu v3": 61.0, "tpu v4": 137.5, "tpu v5 lite": 196.6,
+    "tpu v5e": 196.6, "tpu v5p": 459.0, "tpu v6e": 918.0, "tpu v6 lite": 918.0,
+    "cpu": 0.1,
+}
+
+
+def device_peak_tflops(device: jax.Device | None = None) -> float:
+    device = device or jax.devices()[0]
+    kind = device.device_kind.lower()
+    for name, peak in PEAK_TFLOPS.items():
+        if kind.startswith(name):
+            return peak
+    return PEAK_TFLOPS.get(device.platform, 1.0)
+
+
+def compiled_flops(compiled) -> float | None:
+    """Total FLOPs of one execution from XLA cost analysis (per-process)."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
+def mfu(flops_per_step: float, step_time_s: float,
+        n_devices: int | None = None,
+        device: jax.Device | None = None) -> float:
+    """Model FLOPs utilization in [0, 1]. ``flops_per_step`` is the global
+    FLOP count of one step; peak scales with device count."""
+    n = n_devices if n_devices is not None else jax.device_count()
+    peak = device_peak_tflops(device) * 1e12 * n
+    return flops_per_step / (step_time_s * peak)
+
+
+@dataclass
+class StepTimer:
+    """Wall-clock step timing with device sync on the boundaries.
+
+    Sync is by host materialization (``jax.device_get``), not
+    ``block_until_ready``: on remote-tunnel TPU platforms the latter can
+    return before the dispatch chain executes.
+    """
+
+    t0: float = 0.0
+
+    def start(self, *sync: jax.Array) -> None:
+        for a in sync:
+            jax.device_get(a)
+        self.t0 = time.perf_counter()
+
+    def stop(self, *sync: jax.Array) -> float:
+        for a in sync:
+            jax.device_get(a)
+        return time.perf_counter() - self.t0
+
+
+@dataclass
+class MetricsLogger:
+    """Structured metrics: console + JSONL file (one object per step)."""
+
+    path: str | Path | None = None
+    print_every: int = 1
+    _file: IO | None = field(default=None, repr=False)
+    _step: int = 0
+
+    def log(self, step: int, **metrics: Any) -> None:
+        record = {"step": step, "time": time.time(), **metrics}
+        if self.path is not None:
+            if self._file is None:
+                Path(self.path).parent.mkdir(parents=True, exist_ok=True)
+                self._file = open(self.path, "a")
+            self._file.write(json.dumps(record, default=float) + "\n")
+            self._file.flush()
+        if self.print_every and step % self.print_every == 0:
+            parts = " ".join(f"{k}={float(v):.4g}" if isinstance(v, (int, float))
+                             else f"{k}={v}" for k, v in metrics.items())
+            print(f"step {step}: {parts}")
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+# ---------------------------------------------------------------------------
+# Analytic model FLOPs (XLA cost analysis counts a scanned layer body once,
+# so compiled_flops undercounts depth-L towers by ~L; MFU uses these instead)
+# ---------------------------------------------------------------------------
+
+def _tower_fwd_flops(width: int, depth: int, mlp_dim: int, seq: int) -> float:
+    matmul_params = depth * (4 * width * width + 2 * width * mlp_dim)
+    attn = depth * 4 * seq * seq * width  # qk^T and pv
+    return 2 * matmul_params * seq + attn
+
+
+def vision_fwd_flops(v) -> float:
+    """Per-image forward FLOPs of a VisionConfig tower (+ patch conv, MAP)."""
+    seq = v.seq_len
+    total = _tower_fwd_flops(v.width, v.depth, v.mlp_dim, seq)
+    total += 2 * (v.patch_size ** 2 * v.channels * v.width) * v.num_patches
+    if v.pooling == "map":
+        # probe cross-attention: k/v projections over seq + mlp on 1 token
+        total += 2 * (2 * v.width ** 2) * seq + 2 * (2 * v.width * v.mlp_dim)
+    return total
+
+
+def text_fwd_flops(t) -> float:
+    return _tower_fwd_flops(t.width, t.depth, t.mlp_dim, t.context_length)
+
+
+def model_fwd_flops(cfg) -> float:
+    """Per-sample forward FLOPs for a ViT/CLIP/SigLIP config."""
+    total = vision_fwd_flops(cfg.vision)
+    if hasattr(cfg, "text"):
+        total += text_fwd_flops(cfg.text)
+        proj = getattr(cfg, "projection_dim", cfg.text.width)
+        total += 2 * cfg.text.width * proj
+        if hasattr(cfg.vision, "width") and cfg.vision.pooling == "cls":
+            total += 2 * cfg.vision.width * proj  # CLIP visual projection
+    return total
+
+
+def train_step_flops(cfg, batch_size: int) -> float:
+    """Model FLOPs (no remat recompute) of one training step: fwd + 2x bwd."""
+    return 3.0 * model_fwd_flops(cfg) * batch_size

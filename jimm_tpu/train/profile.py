@@ -1,0 +1,30 @@
+"""Profiling hooks (SURVEY §5 tracing row): `jax.profiler` trace capture
+around training steps, viewable in TensorBoard / Perfetto."""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from pathlib import Path
+
+import jax
+
+
+@contextmanager
+def trace(log_dir: str | Path, *, host_tracer_level: int = 2):
+    """Capture a device+host trace for the enclosed steps::
+
+        with trace("/tmp/profile"):
+            for _ in range(5):
+                train_step(...)
+    """
+    Path(log_dir).mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up in the trace timeline."""
+    return jax.profiler.TraceAnnotation(name)

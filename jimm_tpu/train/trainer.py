@@ -1,0 +1,144 @@
+"""Training loop machinery: optimizer factory, jitted step builders for
+classification and contrastive training.
+
+The reference ships one MNIST example loop (`examples/vit_training.py`) and
+nothing for its dual-tower models. Here training is library code: steps are
+built once per (model, loss) pair, jitted with donated state, and work on any
+mesh/rules combination (replicated, DP, TP, FSDP, FSDP+TP) because sharding
+comes from the logical-rules context — not from the step code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import nnx
+
+from jimm_tpu.train.losses import (clip_softmax_loss, ring_sigmoid_loss,
+                                   sigmoid_pairwise_loss)
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    learning_rate: float = 1e-3
+    weight_decay: float = 1e-4
+    warmup_steps: int = 0
+    total_steps: int | None = None  # cosine decay horizon; None = constant
+    b1: float = 0.9
+    b2: float = 0.999
+    grad_clip_norm: float | None = 1.0
+    min_lr_ratio: float = 0.0
+
+
+def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
+    if cfg.total_steps is None:
+        if cfg.warmup_steps:
+            return optax.linear_schedule(0.0, cfg.learning_rate,
+                                         cfg.warmup_steps)
+        return optax.constant_schedule(cfg.learning_rate)
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=cfg.learning_rate,
+        warmup_steps=cfg.warmup_steps, decay_steps=cfg.total_steps,
+        end_value=cfg.learning_rate * cfg.min_lr_ratio)
+
+
+def make_optimizer(model: nnx.Module, cfg: OptimizerConfig) -> nnx.Optimizer:
+    """AdamW with warmup-cosine schedule and global-norm clipping; weight
+    decay is masked off 1-D params (LayerNorm/bias) and scalars."""
+    schedule = make_schedule(cfg)
+
+    def decay_mask(params):
+        return jax.tree.map(lambda p: jnp.ndim(p) > 1, params)
+
+    chain = []
+    if cfg.grad_clip_norm:
+        chain.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    chain.append(optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2,
+                             weight_decay=cfg.weight_decay, mask=decay_mask))
+    return nnx.Optimizer(model, optax.chain(*chain), wrt=nnx.Param)
+
+
+# ---------------------------------------------------------------------------
+# Step builders
+# ---------------------------------------------------------------------------
+
+def make_classifier_train_step() -> Callable:
+    """Cross-entropy classification step (ref `examples/vit_training.py:81-102`
+    semantics: value_and_grad over model, accuracy metric, optimizer update)."""
+
+    @nnx.jit
+    def train_step(model: nnx.Module, optimizer: nnx.Optimizer,
+                   images: jax.Array, labels: jax.Array) -> dict[str, jax.Array]:
+        def loss_fn(model):
+            logits = model(images)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            return loss, logits
+
+        (loss, logits), grads = nnx.value_and_grad(loss_fn, has_aux=True)(model)
+        optimizer.update(model, grads)
+        accuracy = jnp.mean(jnp.argmax(logits, axis=-1) == labels)
+        return {"loss": loss, "accuracy": accuracy}
+
+    return train_step
+
+
+def make_classifier_eval_step() -> Callable:
+    @nnx.jit
+    def eval_step(model: nnx.Module, images: jax.Array, labels: jax.Array
+                  ) -> dict[str, jax.Array]:
+        logits = model(images)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        accuracy = jnp.mean(jnp.argmax(logits, axis=-1) == labels)
+        return {"loss": loss, "accuracy": accuracy}
+
+    return eval_step
+
+
+def contrastive_loss_fn(model, images: jax.Array, text: jax.Array, *,
+                        kind: str, mesh=None, axis_name: str = "data"
+                        ) -> jax.Array:
+    """Shared loss dispatch for CLIP/SigLIP models.
+
+    - ``"clip"``: symmetric softmax InfoNCE (needs ``logit_scale``).
+    - ``"siglip"``: dense sigmoid all-pairs (oracle / single chip).
+    - ``"siglip_ring"``: ppermute-ring sigmoid over ``axis_name`` —
+      the north-star loss.
+    """
+    img = model.encode_image(images)
+    txt = model.encode_text(text)
+    scale = model.logit_scale[...]
+    if kind == "clip":
+        return clip_softmax_loss(img, txt, scale)
+    bias = model.logit_bias[...]
+    if kind == "siglip":
+        return sigmoid_pairwise_loss(img, txt, scale, bias)
+    if kind == "siglip_ring":
+        return ring_sigmoid_loss(img, txt, scale, bias, mesh=mesh,
+                                 axis_name=axis_name)
+    raise ValueError(f"unknown contrastive loss kind {kind!r}")
+
+
+def make_contrastive_train_step(kind: str = "siglip_ring", *, mesh=None,
+                                axis_name: str = "data") -> Callable:
+    loss = partial(contrastive_loss_fn, kind=kind, mesh=mesh,
+                   axis_name=axis_name)
+
+    @nnx.jit
+    def train_step(model: nnx.Module, optimizer: nnx.Optimizer,
+                   images: jax.Array, text: jax.Array) -> dict[str, jax.Array]:
+        def loss_fn(model):
+            return loss(model, images, text)
+
+        loss_val, grads = nnx.value_and_grad(loss_fn)(model)
+        optimizer.update(model, grads)
+        return {"loss": loss_val}
+
+    return train_step

@@ -1,3 +1,4 @@
+from jimm_tpu.utils.env import configure_platform
 from jimm_tpu.utils.jit import jit_forward
 
-__all__ = ["jit_forward"]
+__all__ = ["configure_platform", "jit_forward"]

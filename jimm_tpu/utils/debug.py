@@ -1,0 +1,60 @@
+"""Numerical-safety tooling (SURVEY §5 "race detection / sanitizers" row:
+the TPU-native equivalents are nan-checking and bounds checkify).
+
+- :func:`nan_debug` — context manager enabling ``jax_debug_nans`` /
+  ``jax_debug_infs`` so the first NaN/Inf produced inside jit raises with a
+  de-optimized traceback.
+- :func:`checked` — wrap a function with ``jax.experimental.checkify`` to
+  surface division/OOB/NaN errors as python exceptions.
+- :func:`assert_finite` — pytree-wide finiteness assert for tests/trainers.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+
+@contextmanager
+def nan_debug(infs: bool = True):
+    old_nans = jax.config.jax_debug_nans
+    old_infs = jax.config.jax_debug_infs
+    jax.config.update("jax_debug_nans", True)
+    if infs:
+        jax.config.update("jax_debug_infs", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", old_nans)
+        jax.config.update("jax_debug_infs", old_infs)
+
+
+def checked(fn: Callable, *, errors=checkify.float_checks) -> Callable:
+    """Return ``fn`` instrumented with checkify; raises on the host at call
+    time if a float error fired inside."""
+    cfn = checkify.checkify(fn, errors=errors)
+
+    def wrapper(*args: Any, **kwargs: Any):
+        err, out = cfn(*args, **kwargs)
+        err.throw()
+        return out
+
+    return wrapper
+
+
+def assert_finite(tree: Any, *, name: str = "tree") -> None:
+    bad = []
+
+    def visit(path, leaf):
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            if not bool(jnp.isfinite(arr).all()):
+                bad.append(jax.tree_util.keystr(path))
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    if bad:
+        raise FloatingPointError(f"non-finite values in {name}: {bad}")

@@ -1,0 +1,24 @@
+"""Environment plumbing.
+
+This runtime exports ``JAX_PLATFORMS=axon`` globally and the plugin re-merges
+it, so the env var alone cannot force a backend. ``configure_platform`` reads
+``JIMM_PLATFORM`` (e.g. ``cpu``) and ``JIMM_HOST_DEVICES`` (virtual CPU
+device count for mesh testing) and applies them in-process *before* the first
+backend use — call it at the top of every script entry point.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def configure_platform() -> None:
+    plat = os.environ.get("JIMM_PLATFORM")
+    n = os.environ.get("JIMM_HOST_DEVICES")
+    if not plat and not n:
+        return
+    import jax
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    if n:
+        jax.config.update("jax_num_cpu_devices", int(n))

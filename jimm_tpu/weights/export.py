@@ -1,0 +1,86 @@
+"""HF-interoperable checkpoint export — the save path the reference lacks
+entirely (SURVEY §5 "Checkpoint / resume": load-only).
+
+Reverses each model's declarative mapping table (`jimm_tpu/weights/loader.py`)
+to produce an HF-keyed safetensors state dict: per-layer tensors are unstacked
+from the scanned ``(layers, ...)`` params, transforms are inverted, and
+``Chunk`` entries sharing one torch fused tensor (the MAP head's
+``in_proj_*``) are re-fused by concatenation. Round-trip is tested against
+``transformers.*.from_pretrained`` in `tests/test_export.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+from flax import nnx
+
+from jimm_tpu.weights.loader import Chunk, M
+from jimm_tpu.weights.safetensors_io import save_file
+
+
+def _to_numpy(value) -> np.ndarray:
+    return np.asarray(value)
+
+
+def to_hf_state_dict(model: nnx.Module, entries: list[M], *, num_layers: int,
+                     num_layers_by_prefix: dict[str, int] | None = None
+                     ) -> dict[str, np.ndarray]:
+    params = dict(nnx.to_flat_state(nnx.state(model, nnx.Param)))
+    flat = {".".join(map(str, k)): _to_numpy(v.get_value())
+            for k, v in params.items()}
+
+    def layer_count(dst: str) -> int:
+        for prefix, n in (num_layers_by_prefix or {}).items():
+            if dst.startswith(prefix):
+                return n
+        return num_layers
+
+    out: dict[str, np.ndarray] = {}
+    fused: dict[str, list[tuple[int, np.ndarray]]] = {}
+    for e in entries:
+        if e.dst not in flat:
+            if e.optional:
+                continue
+            raise KeyError(f"model has no parameter {e.dst!r}")
+        arr = flat[e.dst]
+        per_layer = "{i}" in e.src
+        layers = ([(e.src.format(i=i), arr[i]) for i in range(layer_count(e.dst))]
+                  if per_layer else [(e.src, arr)])
+        for key, a in layers:
+            if isinstance(e.transform, Chunk):
+                fused.setdefault(key, []).append(
+                    (e.transform.idx, e.transform.inv(a)))
+            elif e.transform is not None:
+                out[key] = e.transform.inv(a)
+            else:
+                out[key] = a
+    for key, parts in fused.items():
+        out[key] = np.concatenate(
+            [a for _, a in sorted(parts, key=lambda t: t[0])], axis=0)
+    return out
+
+
+def save_pretrained(model: nnx.Module, save_dir: str | os.PathLike) -> None:
+    """Write an HF-compatible directory: ``model.safetensors`` +
+    ``config.json`` readable by ``transformers`` and by our
+    ``from_pretrained``."""
+    d = Path(save_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    state = to_hf_state_dict(model, model.hf_mapping(model.config),
+                             **_layer_kwargs(model))
+    save_file(state, d / "model.safetensors", metadata={"format": "pt"})
+    with open(d / "config.json", "w") as f:
+        json.dump(model.hf_config(), f, indent=2)
+
+
+def _layer_kwargs(model) -> dict[str, Any]:
+    cfg = model.config
+    if hasattr(cfg, "text"):
+        return {"num_layers": cfg.vision.depth,
+                "num_layers_by_prefix": {"text.": cfg.text.depth}}
+    return {"num_layers": cfg.vision.depth}

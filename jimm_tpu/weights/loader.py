@@ -24,31 +24,59 @@ import numpy as np
 from flax import nnx
 
 
+class Transform:
+    """An invertible tensor transform: ``fwd`` maps HF torch layout to
+    jimm_tpu layout, ``inv`` maps back (used by the HF exporter)."""
+
+    def __init__(self, fwd: Callable[[np.ndarray], np.ndarray],
+                 inv: Callable[[np.ndarray], np.ndarray]):
+        self.fwd = fwd
+        self.inv = inv
+
+    def __call__(self, w: np.ndarray) -> np.ndarray:
+        return self.fwd(w)
+
+
+class Chunk(Transform):
+    """Take the idx-th of n equal chunks along axis 0 — used for torch's
+    fused MAP-head ``in_proj_weight`` (ref `siglip.py:352-363`). The exporter
+    re-fuses all n chunks of the same src key."""
+
+    def __init__(self, n: int, idx: int, then: Transform | None = None):
+        self.n = n
+        self.idx = idx
+        self.then = then
+        super().__init__(self._fwd, self._inv)
+
+    def _fwd(self, w: np.ndarray) -> np.ndarray:
+        part = np.split(w, self.n, axis=0)[self.idx]
+        return self.then(part) if self.then else part
+
+    def _inv(self, w: np.ndarray) -> np.ndarray:
+        """Inverse of the per-chunk path only; fusing happens in the
+        exporter."""
+        return self.then.inv(w) if self.then else w
+
+
 class T:
-    """Weight transforms (HF torch layout -> jimm_tpu layout)."""
+    """Standard transforms (HF torch layout <-> jimm_tpu layout)."""
 
-    @staticmethod
-    def linear(w: np.ndarray) -> np.ndarray:
-        """torch Linear (out, in) -> flax kernel (in, out)."""
-        return np.ascontiguousarray(w.transpose())
-
-    @staticmethod
-    def conv(w: np.ndarray) -> np.ndarray:
-        """torch Conv2d OIHW -> flax HWIO (ref `models/vit.py:239-240`)."""
-        return np.ascontiguousarray(w.transpose(2, 3, 1, 0))
-
-    @staticmethod
-    def unsqueeze(w: np.ndarray) -> np.ndarray:
-        return w[None]
-
-    @staticmethod
-    def chunk(n: int, idx: int, then: Callable | None = None) -> Callable:
-        """Take the idx-th of n equal chunks along axis 0 — used for torch's
-        fused MAP-head ``in_proj_weight`` (ref `siglip.py:352-363`)."""
-        def f(w: np.ndarray) -> np.ndarray:
-            part = np.split(w, n, axis=0)[idx]
-            return then(part) if then else part
-        return f
+    #: torch Linear (out, in) <-> flax kernel (in, out)
+    linear = Transform(lambda w: np.ascontiguousarray(w.transpose()),
+                       lambda w: np.ascontiguousarray(w.transpose()))
+    #: torch Conv2d OIHW <-> flax HWIO (ref `models/vit.py:239-240`)
+    conv = Transform(lambda w: np.ascontiguousarray(w.transpose(2, 3, 1, 0)),
+                     lambda w: np.ascontiguousarray(w.transpose(3, 2, 0, 1)))
+    unsqueeze = Transform(lambda w: w[None], lambda w: w[0])
+    #: reshape to a scalar; exporter restores a rank-1 (1,) tensor iff the
+    #: checkpoint had one (SigLIP's logit_scale/bias are (1,), CLIP's is ())
+    scalar = Transform(lambda w: np.asarray(w).reshape(()),
+                       lambda w: np.asarray(w).reshape(()))
+    scalar_1d = Transform(lambda w: np.asarray(w).reshape(()),
+                          lambda w: np.asarray(w).reshape((1,)))
+    reshape_1_1_d = Transform(lambda w: w.reshape(1, 1, -1),
+                              lambda w: w.reshape(-1))
+    chunk = Chunk
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,53 @@
+"""Input pipeline tests: prefetch ordering, termination, error propagation,
+device placement."""
+
+import numpy as np
+import pytest
+
+from jimm_tpu.data import PrefetchIterator, blob_classification, contrastive_pairs
+from jimm_tpu.parallel import DATA_PARALLEL, make_mesh
+
+
+def test_prefetch_preserves_order_and_stops():
+    src = iter([np.full((2, 2), i, np.float32) for i in range(5)])
+    it = PrefetchIterator(src)
+    got = [int(b[0, 0]) for b in it]
+    assert got == [0, 1, 2, 3, 4]
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_prefetch_propagates_producer_error():
+    def bad():
+        yield np.zeros((1,), np.float32)
+        raise RuntimeError("producer exploded")
+
+    it = PrefetchIterator(bad())
+    next(it)
+    with pytest.raises(RuntimeError, match="producer exploded"):
+        next(it)
+
+
+def test_prefetch_places_on_mesh(eight_devices):
+    mesh = make_mesh({"data": 8})
+    src = (x for x in [(np.zeros((16, 4, 4, 3), np.float32),
+                        np.zeros((16,), np.int32))])
+    it = PrefetchIterator(src, mesh=mesh, rules=DATA_PARALLEL)
+    images, labels = next(it)
+    assert images.sharding.spec == DATA_PARALLEL.spec("batch", None, None, None)
+    it.close()
+
+
+def test_blob_dataset_shapes_and_labels():
+    gen = blob_classification(8, image_size=16)
+    images, labels = next(gen)
+    assert images.shape == (8, 16, 16, 3) and labels.shape == (8,)
+    assert images.dtype == np.float32 and labels.dtype == np.int32
+    assert set(np.unique(labels)).issubset({0, 1, 2, 3})
+
+
+def test_contrastive_pairs_encode_class_in_text():
+    gen = contrastive_pairs(8, image_size=16, vocab_size=32, seq_len=4)
+    _, text = next(gen)
+    assert text.shape == (8, 4)
+    assert (text[:, 0] < 4).all()  # class token leads the caption

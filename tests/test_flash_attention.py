@@ -1,0 +1,71 @@
+"""Flash-attention kernel vs fp32 einsum oracle (SURVEY §4 implication (d)),
+in Pallas interpret mode on CPU (compiled path exercised by bench on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jimm_tpu.ops.attention import reference_attention
+from jimm_tpu.ops.flash_attention import flash_attention
+
+
+def qkv(rng, b=2, s=256, n=2, d=64, dtype=np.float32):
+    return tuple(jnp.asarray(rng.randn(b, s, n, d).astype(dtype) * 0.5)
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_reference(rng, causal):
+    q, k, v = qkv(rng)
+    out = flash_attention(q, k, v, is_causal=causal)
+    ref = reference_attention(q, k, v, is_causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_forward_unaligned_seq(rng):
+    """Sequence lengths that need padding (ViT: 197, 257, 577 tokens)."""
+    q, k, v = qkv(rng, s=197)
+    out = flash_attention(q, k, v)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_reference(rng, causal):
+    q, k, v = qkv(rng, s=128, n=1)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, is_causal=causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, is_causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        np.testing.assert_allclose(a, b, atol=5e-4, err_msg=f"d{name}")
+
+
+def test_gradients_unaligned_seq(rng):
+    q, k, v = qkv(rng, s=197, n=1)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        np.testing.assert_allclose(a, b, atol=5e-4, err_msg=f"d{name}")
+
+
+def test_bf16_inputs(rng):
+    q, k, v = qkv(rng, dtype=np.float32)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb)
+    assert out.dtype == jnp.bfloat16
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(out.astype(np.float32), ref, atol=2e-2)
