@@ -192,8 +192,10 @@ class CLIP(nnx.Module):
     def from_pretrained(cls, name_or_path: str, *,
                         mesh: jax.sharding.Mesh | None = None,
                         rules: ShardingRules | str = TENSOR_PARALLEL,
-                        dtype=None) -> "CLIP":
-        weights, config = resolve_checkpoint(name_or_path)
+                        dtype=None, use_pytorch: bool = False
+                        ) -> "CLIP":
+        weights, config = resolve_checkpoint(name_or_path,
+                                             use_pytorch=use_pytorch)
         cfg = cls.config_from_hf(config, weights)
         param_dtype = dtype if dtype is not None else jnp.float32
         model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,

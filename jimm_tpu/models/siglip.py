@@ -193,8 +193,10 @@ class SigLIP(nnx.Module):
     def from_pretrained(cls, name_or_path: str, *,
                         mesh: jax.sharding.Mesh | None = None,
                         rules: ShardingRules | str = TENSOR_PARALLEL,
-                        dtype=None) -> "SigLIP":
-        weights, config = resolve_checkpoint(name_or_path)
+                        dtype=None, use_pytorch: bool = False
+                        ) -> "SigLIP":
+        weights, config = resolve_checkpoint(name_or_path,
+                                             use_pytorch=use_pytorch)
         cfg = cls.config_from_hf(config, weights)
         param_dtype = dtype if dtype is not None else jnp.float32
         model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,

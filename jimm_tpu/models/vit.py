@@ -149,10 +149,12 @@ class VisionTransformer(nnx.Module):
     def from_pretrained(cls, name_or_path: str, *,
                         mesh: jax.sharding.Mesh | None = None,
                         rules: ShardingRules | str = TENSOR_PARALLEL,
-                        dtype=None) -> "VisionTransformer":
+                        dtype=None, use_pytorch: bool = False
+                        ) -> "VisionTransformer":
         """Load any HF ViT checkpoint (safetensors). ``dtype`` sets both
         compute and param dtype (ref `models/vit.py:181-182`)."""
-        weights, config = resolve_checkpoint(name_or_path)
+        weights, config = resolve_checkpoint(name_or_path,
+                                             use_pytorch=use_pytorch)
         cfg = cls.config_from_hf(config, weights)
         param_dtype = dtype if dtype is not None else jnp.float32
         model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,

@@ -1,10 +1,12 @@
-"""Checkpoint resolution: local safetensors file/dir or HF hub repo id.
+"""Checkpoint resolution: local safetensors/pytorch file/dir or HF hub repo id.
 
-Preserves the reference's user-visible loading contract minus torch
-(SURVEY §7.1.1): local `.safetensors` file with sibling/parent `config.json`
-discovery (ref `common/utils.py:77-86`), local directory, or HF hub repo-id
-(ref `common/utils.py:74-99`). Adds sharded-checkpoint support
-(`model.safetensors.index.json`), which the reference lacks.
+Preserves the reference's full user-visible loading contract
+(SURVEY §2.4 "both formats"): local `.safetensors` or `pytorch_model.bin`
+file with sibling/parent `config.json` discovery (ref `common/utils.py:77-86`),
+local directory, or HF hub repo-id (ref `common/utils.py:55-99`) — but with
+zero torch in the import graph: `.bin` files are read by the stdlib-only
+unpickler in :mod:`jimm_tpu.weights.torch_pickle`. Adds sharded-checkpoint
+support (`*.index.json`), which the reference lacks.
 """
 
 from __future__ import annotations
@@ -16,7 +18,10 @@ from typing import Any
 
 import numpy as np
 
+from jimm_tpu.weights import torch_pickle
 from jimm_tpu.weights.safetensors_io import load_file
+
+_TORCH_SUFFIXES = (".bin", ".pt", ".pth")
 
 
 def _load_config(path: Path) -> dict[str, Any] | None:
@@ -26,30 +31,53 @@ def _load_config(path: Path) -> dict[str, Any] | None:
     return None
 
 
-def _from_dir(d: Path) -> tuple[dict[str, np.ndarray], dict | None]:
+def _sharded(d: Path, index: Path, loader) -> dict[str, np.ndarray]:
+    with open(index) as f:
+        weight_map: dict[str, str] = json.load(f)["weight_map"]
+    weights: dict[str, np.ndarray] = {}
+    for shard in sorted(set(weight_map.values())):
+        weights.update(loader(d / shard))
+    return weights
+
+
+def _from_dir(d: Path, use_pytorch: bool = False
+              ) -> tuple[dict[str, np.ndarray], dict | None]:
     config = _load_config(d / "config.json")
+    if use_pytorch:
+        index = d / "pytorch_model.bin.index.json"
+        if index.is_file():
+            return _sharded(d, index, torch_pickle.load_file), config
+        single = d / "pytorch_model.bin"
+        if single.is_file():
+            return torch_pickle.load_file(single), config
+        raise FileNotFoundError(f"no pytorch_model.bin under {d}")
     index = d / "model.safetensors.index.json"
     if index.is_file():
-        with open(index) as f:
-            weight_map: dict[str, str] = json.load(f)["weight_map"]
-        weights: dict[str, np.ndarray] = {}
-        for shard in sorted(set(weight_map.values())):
-            weights.update(load_file(d / shard))
-        return weights, config
+        return _sharded(d, index, load_file), config
     single = d / "model.safetensors"
     if single.is_file():
         return load_file(single), config
     candidates = sorted(d.glob("*.safetensors"))
     if candidates:
-        weights = {}
+        weights: dict[str, np.ndarray] = {}
         for c in candidates:
             weights.update(load_file(c))
         return weights, config
-    raise FileNotFoundError(f"no .safetensors weights under {d}")
+    # fall back to the torch format when no safetensors exist at all
+    bin_index = d / "pytorch_model.bin.index.json"
+    if bin_index.is_file():
+        return _sharded(d, bin_index, torch_pickle.load_file), config
+    if (d / "pytorch_model.bin").is_file():
+        return torch_pickle.load_file(d / "pytorch_model.bin"), config
+    raise FileNotFoundError(f"no .safetensors or pytorch_model.bin "
+                            f"weights under {d}")
 
 
 def _from_file(p: Path) -> tuple[dict[str, np.ndarray], dict | None]:
-    weights = load_file(p)
+    if p.suffix in _TORCH_SUFFIXES:
+        weights = torch_pickle.load_file(p)
+    else:
+        weights = load_file(p)
     # config discovery: sibling config.json, else parent of a `model/` dir
     # (ref common/utils.py:77-86)
     config = _load_config(p.parent / "config.json")
@@ -58,29 +86,40 @@ def _from_file(p: Path) -> tuple[dict[str, np.ndarray], dict | None]:
     return weights, config
 
 
-def _from_hub(repo_id: str) -> tuple[dict[str, np.ndarray], dict | None]:
+def _from_hub(repo_id: str, use_pytorch: bool = False
+              ) -> tuple[dict[str, np.ndarray], dict | None]:
     try:
         from huggingface_hub import hf_hub_download
     except ImportError as e:  # pragma: no cover
         raise FileNotFoundError(
             f"{repo_id!r} is not a local path and huggingface_hub is "
             "unavailable") from e
-    weights: dict[str, np.ndarray] = {}
-    try:
+    def fetch(single: str, loader) -> dict[str, np.ndarray]:
         # sharded checkpoints first (large models), then the single file
         try:
-            index_path = hf_hub_download(repo_id,
-                                         "model.safetensors.index.json")
+            index_path = hf_hub_download(repo_id, single + ".index.json")
             with open(index_path) as f:
                 weight_map: dict[str, str] = json.load(f)["weight_map"]
+            out: dict[str, np.ndarray] = {}
             for shard in sorted(set(weight_map.values())):
-                weights.update(load_file(hf_hub_download(repo_id, shard)))
+                out.update(loader(hf_hub_download(repo_id, shard)))
+            return out
         except Exception:
-            weights = load_file(hf_hub_download(repo_id, "model.safetensors"))
+            return loader(hf_hub_download(repo_id, single))
+
+    formats = [("model.safetensors", load_file),
+               ("pytorch_model.bin", torch_pickle.load_file)]
+    if use_pytorch:
+        formats.reverse()
+    try:
+        try:
+            weights = fetch(*formats[0])
+        except Exception:
+            weights = fetch(*formats[1])  # repo hosts only the other format
     except Exception as e:
         raise FileNotFoundError(
-            f"could not fetch {repo_id!r} from the HF hub (offline?): {e}"
-        ) from e
+            f"could not fetch {repo_id!r} from the HF hub "
+            f"(offline, or repo has neither format?): {e}") from e
     try:
         config_path = hf_hub_download(repo_id, "config.json")
         config = _load_config(Path(config_path))
@@ -89,16 +128,22 @@ def _from_hub(repo_id: str) -> tuple[dict[str, np.ndarray], dict | None]:
     return weights, config
 
 
-def resolve_checkpoint(name_or_path: str | os.PathLike
+def resolve_checkpoint(name_or_path: str | os.PathLike, *,
+                       use_pytorch: bool = False
                        ) -> tuple[dict[str, np.ndarray], dict | None]:
-    """Return ``(flat hf tensor dict, hf config dict | None)``."""
+    """Return ``(flat hf tensor dict, hf config dict | None)``.
+
+    ``use_pytorch=True`` prefers the ``pytorch_model.bin`` format (ref
+    `common/utils.py:55-71`) — read torch-free by
+    :mod:`~jimm_tpu.weights.torch_pickle`.
+    """
     p = Path(name_or_path).expanduser()
     if p.is_dir():
-        return _from_dir(p)
+        return _from_dir(p, use_pytorch)
     if p.is_file():
         return _from_file(p)
     name = str(name_or_path)
     if name.startswith((".", "/", "~")) or name.count("/") != 1:
         # filesystem-looking, but nothing there — don't confuse with a repo id
         raise FileNotFoundError(f"no checkpoint file or directory at {name!r}")
-    return _from_hub(name)
+    return _from_hub(name, use_pytorch)

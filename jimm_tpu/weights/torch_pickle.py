@@ -1,0 +1,194 @@
+"""Pure-Python reader for ``pytorch_model.bin`` — no torch import.
+
+The reference loads torch checkpoints by lazily importing torch and calling
+``torch.load`` (ref `src/jimm/common/utils.py:55-71`), which drags the whole
+torch runtime into the process. This module reads the same files with only
+the stdlib: a torch "zipfile" checkpoint is a zip archive containing
+``<prefix>/data.pkl`` (a pickle whose persistent ids reference storages) plus
+one raw little-endian buffer per storage under ``<prefix>/data/<key>``.
+
+Security: the unpickler only resolves an explicit whitelist of globals
+(rebuild helpers, storage dtype tags, ``OrderedDict``); any other global in
+the stream raises. That is strictly safer than ``torch.load`` pre-2.6
+defaults.
+
+Legacy (pre-1.6, non-zip) checkpoints are rare on the HF hub; for those we
+fall back to ``torch.load`` iff torch happens to be installed.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import pickle
+import zipfile
+from typing import Any
+
+import ml_dtypes
+import numpy as np
+
+# torch storage class name -> numpy dtype of the raw buffer
+_STORAGE_DTYPES: dict[str, np.dtype] = {
+    "DoubleStorage": np.dtype(np.float64),
+    "FloatStorage": np.dtype(np.float32),
+    "HalfStorage": np.dtype(np.float16),
+    "BFloat16Storage": np.dtype(ml_dtypes.bfloat16),
+    "LongStorage": np.dtype(np.int64),
+    "IntStorage": np.dtype(np.int32),
+    "ShortStorage": np.dtype(np.int16),
+    "CharStorage": np.dtype(np.int8),
+    "ByteStorage": np.dtype(np.uint8),
+    "BoolStorage": np.dtype(np.bool_),
+    "ComplexDoubleStorage": np.dtype(np.complex128),
+    "ComplexFloatStorage": np.dtype(np.complex64),
+    "Float8_e4m3fnStorage": np.dtype(ml_dtypes.float8_e4m3fn),
+    "Float8_e5m2Storage": np.dtype(ml_dtypes.float8_e5m2),
+}
+
+
+class _StorageTag:
+    """Stand-in for a ``torch.XxxStorage`` class appearing as a pickle
+    global. Instances never get constructed — torch pickles reference the
+    class object itself inside persistent ids."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.dtype = _STORAGE_DTYPES[name]
+
+
+class _LazyStorage:
+    """A storage referenced by a persistent id; bytes are read from the zip
+    archive on first use."""
+
+    def __init__(self, read: Any, dtype: np.dtype):
+        self._read = read
+        self.dtype = dtype
+        self._arr: np.ndarray | None = None
+
+    def array(self) -> np.ndarray:
+        if self._arr is None:
+            self._arr = np.frombuffer(self._read(), dtype=self.dtype)
+        return self._arr
+
+
+def _rebuild_tensor_v2(storage: _LazyStorage, storage_offset: int,
+                       size: tuple[int, ...], stride: tuple[int, ...],
+                       requires_grad=False, backward_hooks=None,
+                       metadata=None) -> np.ndarray:
+    flat = storage.array()
+    if storage_offset < 0 or storage_offset >= max(len(flat), 1):
+        raise ValueError(f"storage offset {storage_offset} outside storage "
+                         f"of {len(flat)} elements")
+    if not size:
+        return np.asarray(flat[storage_offset]).reshape(())
+    # bounds-check the pickle-supplied view geometry against the real buffer
+    # before as_strided — a corrupt/crafted stream must not read OOB
+    if any(d < 0 for d in size) or any(s < 0 for s in stride):
+        raise ValueError(f"negative size/stride {size}/{stride}")
+    last = storage_offset + sum((d - 1) * s for d, s in zip(size, stride))
+    if any(d == 0 for d in size):
+        last = storage_offset
+    if last >= len(flat):
+        raise ValueError(
+            f"tensor view (offset {storage_offset}, size {tuple(size)}, "
+            f"stride {tuple(stride)}) exceeds storage of {len(flat)} elements")
+    # torch strides are in elements; honor them so non-contiguous saves load
+    itemsize = flat.dtype.itemsize
+    arr = np.lib.stride_tricks.as_strided(
+        flat[storage_offset:],
+        shape=tuple(size),
+        strides=tuple(s * itemsize for s in stride))
+    return np.ascontiguousarray(arr)
+
+
+def _rebuild_tensor(storage: _LazyStorage, storage_offset: int,
+                    size, stride) -> np.ndarray:
+    return _rebuild_tensor_v2(storage, storage_offset, size, stride)
+
+
+def _rebuild_parameter(data: np.ndarray, requires_grad=False,
+                       backward_hooks=None) -> np.ndarray:
+    return data
+
+
+_ALLOWED_GLOBALS: dict[tuple[str, str], Any] = {
+    ("torch._utils", "_rebuild_tensor_v2"): _rebuild_tensor_v2,
+    ("torch._utils", "_rebuild_tensor"): _rebuild_tensor,
+    ("torch._utils", "_rebuild_parameter"): _rebuild_parameter,
+    # a real OrderedDict: `module.state_dict()` saves carry a `_metadata`
+    # instance attribute that pickle BUILD writes into `__dict__`
+    ("collections", "OrderedDict"): collections.OrderedDict,
+    ("torch.serialization", "_get_layout"): lambda name: name,
+}
+_ALLOWED_GLOBALS.update({("torch", name): _StorageTag(name)
+                         for name in _STORAGE_DTYPES})
+
+
+class _Unpickler(pickle.Unpickler):
+    def __init__(self, file, read_record):
+        super().__init__(file)
+        self._read_record = read_record
+
+    def find_class(self, module: str, name: str):
+        try:
+            return _ALLOWED_GLOBALS[(module, name)]
+        except KeyError:
+            raise pickle.UnpicklingError(
+                f"refusing to unpickle global {module}.{name} — not on the "
+                "torch-checkpoint whitelist") from None
+
+    def persistent_load(self, pid):
+        # ('storage', StorageTag, key, location, numel)
+        if not (isinstance(pid, tuple) and pid and pid[0] == "storage"):
+            raise pickle.UnpicklingError(f"unexpected persistent id {pid!r}")
+        tag, key = pid[1], pid[2]
+        if not isinstance(tag, _StorageTag):
+            raise pickle.UnpicklingError(
+                f"unsupported storage type in persistent id {pid!r}")
+        read = self._read_record
+        return _LazyStorage(lambda k=key: read(k), tag.dtype)
+
+
+def load_file(path: str | os.PathLike) -> dict[str, np.ndarray]:
+    """Read a ``pytorch_model.bin`` state dict into numpy arrays."""
+    if not zipfile.is_zipfile(path):
+        return _load_legacy(path)
+    with zipfile.ZipFile(path) as zf:
+        pkl_name = next((n for n in zf.namelist()
+                         if n.endswith("/data.pkl")), None)
+        if pkl_name is None:
+            raise ValueError(f"{path}: zip archive has no */data.pkl — "
+                             "not a torch checkpoint")
+        prefix = pkl_name[: -len("data.pkl")]
+
+        def read_record(key: str) -> bytes:
+            return zf.read(f"{prefix}data/{key}")
+
+        with zf.open(pkl_name) as f:
+            state = _Unpickler(f, read_record).load()
+    if not isinstance(state, dict):  # e.g. {'state_dict': ..., 'epoch': ...}
+        raise ValueError(f"{path}: expected a state-dict pickle, "
+                         f"got {type(state).__name__}")
+    if "state_dict" in state and isinstance(state["state_dict"], dict):
+        state = state["state_dict"]
+    return {k: v for k, v in state.items() if isinstance(v, np.ndarray)}
+
+
+def _load_legacy(path) -> dict[str, np.ndarray]:  # pragma: no cover
+    try:
+        import torch
+    except ImportError:
+        raise ValueError(
+            f"{path} is a legacy (pre-1.6) torch checkpoint; re-save it in "
+            "the zipfile format or install torch for the fallback path"
+        ) from None
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    if "state_dict" in state and isinstance(state["state_dict"], dict):
+        state = state["state_dict"]
+    out = {}
+    for k, v in state.items():
+        if hasattr(v, "numpy"):
+            v = (v.numpy() if v.dtype != torch.bfloat16 else
+                 v.view(torch.uint16).numpy().view(ml_dtypes.bfloat16))
+            out[k] = v
+    return out

@@ -1,0 +1,171 @@
+"""pytorch_model.bin loading without torch in the import graph.
+
+Capability parity with the reference's `use_pytorch=True` path
+(ref `common/utils.py:55-71`, SURVEY §2.4 "both formats"), implemented by the
+stdlib-only unpickler in `jimm_tpu/weights/torch_pickle.py`. Torch appears
+here only as the oracle that writes the files.
+"""
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jimm_tpu import VisionTransformer
+from jimm_tpu.weights import torch_pickle
+
+from hf_util import sample_image, save_tiny_vit
+
+
+def test_dtype_roundtrip(tmp_path):
+    import torch
+    tensors = {
+        "f32": torch.randn(3, 4),
+        "f64": torch.randn(2, 2, dtype=torch.float64),
+        "f16": torch.randn(5).half(),
+        "bf16": torch.randn(4, 4).bfloat16(),
+        "i64": torch.arange(6).reshape(2, 3),
+        "i32": torch.arange(4, dtype=torch.int32),
+        "u8": torch.arange(10, dtype=torch.uint8),
+        "bool": torch.tensor([True, False, True]),
+        "scalar": torch.tensor(2.5),
+        # non-contiguous view: strides must be honored
+        "noncontig": torch.randn(6, 8).t(),
+        # two tensors sharing one storage with different offsets
+        "slice": torch.arange(20, dtype=torch.float32)[5:15],
+    }
+    torch.save(tensors, tmp_path / "t.bin")
+    loaded = torch_pickle.load_file(tmp_path / "t.bin")
+    assert set(loaded) == set(tensors)
+    for k, v in tensors.items():
+        ref = (v.float().numpy() if v.dtype == torch.bfloat16
+               else v.numpy())
+        got = loaded[k]
+        assert tuple(got.shape) == tuple(v.shape), k
+        np.testing.assert_array_equal(
+            got.astype(np.float32) if k == "bf16" else got, ref, err_msg=k)
+
+
+def test_state_dict_save_with_metadata(tmp_path):
+    """`torch.save(module.state_dict())` writes an OrderedDict carrying a
+    `_metadata` instance attribute — the most common .bin layout in the
+    wild; must load."""
+    import torch
+    lin = torch.nn.Linear(4, 3)
+    torch.save(lin.state_dict(), tmp_path / "sd.bin")
+    loaded = torch_pickle.load_file(tmp_path / "sd.bin")
+    assert set(loaded) == {"weight", "bias"}
+    np.testing.assert_array_equal(loaded["weight"],
+                                  lin.weight.detach().numpy())
+
+
+def test_oob_view_rejected():
+    """A corrupt stream whose tensor view exceeds its storage must raise,
+    not silently read out of bounds via as_strided."""
+    storage = torch_pickle._LazyStorage(
+        lambda: np.arange(4, dtype=np.float32).tobytes(),
+        np.dtype(np.float32))
+    with pytest.raises(ValueError, match="exceeds storage"):
+        torch_pickle._rebuild_tensor_v2(storage, 0, (1048576,), (1,))
+    with pytest.raises(ValueError, match="negative"):
+        torch_pickle._rebuild_tensor_v2(storage, 3, (4,), (-1,))
+    with pytest.raises(ValueError, match="offset"):
+        torch_pickle._rebuild_tensor_v2(storage, 9, (1,), (1,))
+    # a valid strided view at the very edge still works
+    out = torch_pickle._rebuild_tensor_v2(storage, 0, (2, 2), (2, 1))
+    np.testing.assert_array_equal(out, [[0, 1], [2, 3]])
+
+
+def test_non_torch_zip_rejected(tmp_path):
+    import zipfile
+    with zipfile.ZipFile(tmp_path / "x.bin", "w") as zf:
+        zf.writestr("something.txt", "hello")
+    with pytest.raises(ValueError, match="not a torch checkpoint"):
+        torch_pickle.load_file(tmp_path / "x.bin")
+
+
+def test_sharded_bin_dir(tmp_path, rng):
+    """Sharded pytorch_model.bin.index.json checkpoints load, including via
+    the no-safetensors fallback with use_pytorch=False."""
+    import json as _json
+    import torch
+    from transformers import ViTForImageClassification
+    safedir = save_tiny_vit(tmp_path / "safe")
+    hf = ViTForImageClassification.from_pretrained(safedir)
+    sd = {k: v for k, v in hf.state_dict().items()}
+    keys = sorted(sd)
+    half = len(keys) // 2
+    d = tmp_path / "sharded"
+    d.mkdir()
+    shards = {"pytorch_model-00001-of-00002.bin": keys[:half],
+              "pytorch_model-00002-of-00002.bin": keys[half:]}
+    weight_map = {}
+    for shard, ks in shards.items():
+        torch.save({k: sd[k] for k in ks}, d / shard)
+        weight_map.update({k: shard for k in ks})
+    (d / "pytorch_model.bin.index.json").write_text(
+        _json.dumps({"weight_map": weight_map}))
+    import shutil
+    shutil.copy(f"{safedir}/config.json", d / "config.json")
+
+    img = jnp.asarray(sample_image(rng, size=48))
+    ref = VisionTransformer.from_pretrained(safedir)
+    for flag in (True, False):
+        model = VisionTransformer.from_pretrained(str(d), use_pytorch=flag)
+        np.testing.assert_allclose(np.asarray(model(img)),
+                                   np.asarray(ref(img)), atol=1e-6)
+
+
+def test_rejects_arbitrary_globals(tmp_path):
+    """The whitelist unpickler must refuse non-tensor pickles (safer than
+    pre-2.6 torch.load)."""
+    import torch
+
+    class Evil:
+        def __reduce__(self):
+            return (print, ("pwned",))
+
+    torch.save({"w": torch.randn(2), "e": Evil()}, tmp_path / "evil.bin")
+    with pytest.raises(pickle.UnpicklingError, match="whitelist"):
+        torch_pickle.load_file(tmp_path / "evil.bin")
+
+
+@pytest.fixture(scope="module")
+def vit_bin_ckpt(tmp_path_factory):
+    """A tiny HF ViT checkpoint saved in the torch .bin format only."""
+    import torch  # noqa: F401
+    from transformers import ViTForImageClassification
+    safedir = save_tiny_vit(tmp_path_factory.mktemp("vit_safe"))
+    bindir = tmp_path_factory.mktemp("vit_bin")
+    hf = ViTForImageClassification.from_pretrained(safedir)
+    hf.save_pretrained(bindir, safe_serialization=False)
+    assert (bindir / "pytorch_model.bin").is_file()
+    assert not (bindir / "model.safetensors").exists()
+    return safedir, str(bindir)
+
+
+def test_vit_from_pytorch_bin_matches_safetensors(vit_bin_ckpt, rng):
+    safedir, bindir = vit_bin_ckpt
+    ref = VisionTransformer.from_pretrained(safedir)
+    model = VisionTransformer.from_pretrained(bindir, use_pytorch=True)
+    img = jnp.asarray(sample_image(rng, size=48))
+    np.testing.assert_allclose(np.asarray(model(img)),
+                               np.asarray(ref(img)), atol=1e-6)
+
+
+def test_dir_falls_back_to_bin_without_flag(vit_bin_ckpt, rng):
+    """A directory holding only pytorch_model.bin loads even with
+    use_pytorch=False (no safetensors to prefer)."""
+    _, bindir = vit_bin_ckpt
+    model = VisionTransformer.from_pretrained(bindir)
+    out = model(jnp.asarray(sample_image(rng, size=48)))
+    assert out.shape == (2, 7)
+
+
+def test_bare_bin_file_path(vit_bin_ckpt, rng):
+    """Loading a bare .bin file path works, with sibling config discovery."""
+    _, bindir = vit_bin_ckpt
+    model = VisionTransformer.from_pretrained(bindir + "/pytorch_model.bin")
+    out = model(jnp.asarray(sample_image(rng, size=48)))
+    assert out.shape == (2, 7)
