@@ -1,0 +1,82 @@
+"""Perf sweep for the SigLIP-B/16-256 train step on one chip.
+
+Usage: python scripts/perf_sweep.py --configs remat_flash_128 noremat_flash_128 ...
+Prints one JSON line per config: {name, step_ms, img_s, mfu}.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from jimm_tpu import SigLIP, preset
+from jimm_tpu.train import OptimizerConfig, make_contrastive_train_step, make_optimizer, mfu
+from jimm_tpu.train.metrics import train_step_flops
+
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def run(name: str, batch: int, remat: bool, attn: str, steps: int = 30) -> dict:
+    t_start = time.perf_counter()
+    cfg = preset("siglip-base-patch16-256")
+    cfg = dataclasses.replace(
+        cfg,
+        vision=dataclasses.replace(cfg.vision, remat=remat, attn_impl=attn),
+        text=dataclasses.replace(cfg.text, remat=remat, attn_impl=attn))
+    model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
+                   param_dtype=jnp.bfloat16)
+    optimizer = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
+    step_fn = make_contrastive_train_step("siglip")
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(batch, 256, 256, 3), jnp.bfloat16)
+    text = jnp.asarray(rng.randint(1, cfg.text.vocab_size, size=(batch, 64)),
+                       jnp.int32)
+    print(f"# {name}: init done t+{time.perf_counter()-t_start:.0f}s", flush=True)
+    try:
+        metrics = step_fn(model, optimizer, images, text)
+        float(metrics["loss"])
+        print(f"# {name}: compile done t+{time.perf_counter()-t_start:.0f}s", flush=True)
+        for _ in range(2):
+            metrics = step_fn(model, optimizer, images, text)
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            metrics = step_fn(model, optimizer, images, text)
+        float(metrics["loss"])
+        float(nnx.state(model, nnx.Param)["logit_scale"].get_value())
+        dt = (time.perf_counter() - t0) / steps
+    except Exception as e:  # OOM etc.
+        return {"name": name, "error": type(e).__name__, "msg": str(e)[:200]}
+    flops = train_step_flops(cfg, batch)
+    return {"name": name, "batch": batch, "step_ms": round(dt * 1e3, 1),
+            "img_s": round(batch / dt, 1),
+            "mfu": round(mfu(flops, dt, n_devices=1), 4)}
+
+
+CONFIGS = {
+    "remat_flash_128": dict(batch=128, remat=True, attn="flash"),
+    "remat_xla_128": dict(batch=128, remat=True, attn="xla"),
+    "noremat_flash_128": dict(batch=128, remat=False, attn="flash"),
+    "noremat_xla_128": dict(batch=128, remat=False, attn="xla"),
+    "remat_flash_256": dict(batch=256, remat=True, attn="flash"),
+    "remat_xla_256": dict(batch=256, remat=True, attn="xla"),
+    "noremat_xla_256": dict(batch=256, remat=False, attn="xla"),
+    "noremat_flash_256": dict(batch=256, remat=False, attn="flash"),
+    "remat_xla_512": dict(batch=512, remat=True, attn="xla"),
+    "remat_flash_512": dict(batch=512, remat=True, attn="flash"),
+}
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--configs", nargs="+", default=list(CONFIGS))
+    args = p.parse_args()
+    for name in args.configs:
+        print(json.dumps(run(name, **CONFIGS[name])), flush=True)
