@@ -1,0 +1,76 @@
+"""SigLIP zero-shot inference (equivalent of the reference's
+`examples/siglip_inference.ipynb`): encode images and captions, report
+per-pair sigmoid match probabilities.
+
+SigLIP parity notes (SURVEY Appendix A.7-8): captions must be tokenized with
+``padding="max_length"`` because the text tower pools the LAST position, and
+logits are ``exp(logit_scale) * sim + logit_bias`` squashed with a sigmoid —
+probabilities are independent per pair, not a softmax over prompts.
+
+Run:  python examples/siglip_inference.py --checkpoint google/siglip-base-patch16-256 \
+          --prompts "a photo of a cat" "a photo of a dog"
+"""
+
+from __future__ import annotations
+
+import jimm_tpu.utils.env
+jimm_tpu.utils.env.configure_platform()
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jimm_tpu import SigLIP
+from jimm_tpu.parallel import make_mesh
+from jimm_tpu.utils import jit_forward
+
+
+def tokenize(prompts: list[str], checkpoint: str, context: int) -> np.ndarray:
+    from transformers import AutoTokenizer
+    tok = AutoTokenizer.from_pretrained(checkpoint)
+    # padding="max_length" is required for last-token pooling
+    out = tok(prompts, padding="max_length", max_length=context,
+              return_tensors="np")
+    return out["input_ids"].astype(np.int32)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--checkpoint", required=True)
+    p.add_argument("--image", default=None,
+                   help="npy float32 HWC in [-1,1]; random if omitted")
+    p.add_argument("--prompts", nargs="+",
+                   default=["a photo of a cat", "a photo of a dog",
+                            "a photo of a city street"])
+    p.add_argument("--token-file", default=None,
+                   help="pre-tokenized prompts, npy int32 [N, S]")
+    p.add_argument("--model-axis", type=int, default=1)
+    args = p.parse_args()
+
+    mesh = make_mesh({"data": 1, "model": args.model_axis}) \
+        if args.model_axis > 1 else None
+    model = SigLIP.from_pretrained(args.checkpoint, mesh=mesh,
+                                   dtype=jnp.bfloat16)
+    size = model.config.vision.image_size
+
+    if args.image:
+        image = np.load(args.image).astype(np.float32)[None]
+    else:
+        image = np.random.RandomState(0).rand(1, size, size, 3).astype(
+            np.float32) * 2 - 1
+    if args.token_file:
+        text = np.load(args.token_file).astype(np.int32)
+    else:
+        text = tokenize(args.prompts, args.checkpoint,
+                        model.config.text.context_length)
+
+    logits = jit_forward(model)(jnp.asarray(image), jnp.asarray(text))
+    probs = np.asarray(jax.nn.sigmoid(logits.astype(jnp.float32)))[0]
+    for prompt, prob in sorted(zip(args.prompts, probs), key=lambda t: -t[1]):
+        print(f"P(match) = {prob:6.1%}  {prompt}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""``python -m jimm_tpu`` entry point."""
+
+import sys
+
+from jimm_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,340 @@
+"""Command-line interface (SURVEY §5 config row: the reference has no CLI or
+flag system at all — hyperparameters live in module constants,
+ref `examples/vit_training.py:18-29`).
+
+Subcommands::
+
+    python -m jimm_tpu presets                      # list named model presets
+    python -m jimm_tpu train --preset ... --steps N # synthetic-data training
+    python -m jimm_tpu export SRC OUT               # HF checkpoint -> safetensors dir
+    python -m jimm_tpu inspect FILE.safetensors     # tensor names/shapes/dtypes
+    python -m jimm_tpu bench-forward --preset ...   # jitted forward throughput
+
+`train` runs entirely offline on procedural data (`jimm_tpu.data.synthetic`)
+so it works with zero network on CPU or TPU, and exercises the real stack:
+mesh + sharding rules, jitted step, checkpoint/resume, metrics JSONL.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from typing import Any
+
+
+def _configure_backend(args: argparse.Namespace) -> None:
+    import jimm_tpu.utils.env as env
+    import os
+    if getattr(args, "platform", None):
+        os.environ["JIMM_PLATFORM"] = args.platform
+    if getattr(args, "host_devices", None):
+        os.environ["JIMM_HOST_DEVICES"] = str(args.host_devices)
+    env.configure_platform()
+
+
+def _parse_mesh(spec: str | None):
+    """``"data=4,model=2"`` -> Mesh (None -> no mesh: replicated 1-device)."""
+    if not spec:
+        return None
+    from jimm_tpu.parallel import make_mesh
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        axes[name.strip()] = int(size)
+    return make_mesh(axes)
+
+
+def _family(preset_name: str) -> str:
+    for fam in ("vit", "clip", "siglip"):
+        if preset_name.startswith(fam):
+            return fam
+    raise SystemExit(f"cannot infer model family from preset {preset_name!r}")
+
+
+def _tiny_override(cfg: Any) -> Any:
+    """Shrink any preset to CPU-demo size, keeping its architecture class."""
+    from jimm_tpu.configs import CLIPConfig, SigLIPConfig, ViTConfig
+
+    def shrink_vision(v):
+        return dataclasses.replace(v, image_size=32, patch_size=16, width=64,
+                                   depth=2, num_heads=2, mlp_dim=128)
+
+    def shrink_text(t):
+        return dataclasses.replace(t, vocab_size=64, context_length=8,
+                                   width=64, depth=2, num_heads=2, mlp_dim=128)
+
+    if isinstance(cfg, ViTConfig):
+        return dataclasses.replace(cfg, vision=shrink_vision(cfg.vision))
+    if isinstance(cfg, (CLIPConfig, SigLIPConfig)):
+        return dataclasses.replace(cfg, vision=shrink_vision(cfg.vision),
+                                   text=shrink_text(cfg.text),
+                                   projection_dim=64)
+    raise TypeError(type(cfg))
+
+
+# ---------------------------------------------------------------------------
+# Subcommands
+# ---------------------------------------------------------------------------
+
+def cmd_presets(args: argparse.Namespace) -> int:
+    from jimm_tpu.configs import PRESETS
+    for name, cfg in PRESETS.items():
+        v = cfg.vision
+        extra = ""
+        if hasattr(cfg, "text"):
+            extra = (f" text(width={cfg.text.width} depth={cfg.text.depth} "
+                     f"vocab={cfg.text.vocab_size})")
+        print(f"{name:32s} vision(width={v.width} depth={v.depth} "
+              f"img={v.image_size} patch={v.patch_size}){extra}")
+    return 0
+
+
+def cmd_train(args: argparse.Namespace) -> int:
+    _configure_backend(args)
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import CLIP, SigLIP, VisionTransformer, preset
+    from jimm_tpu.data import (PrefetchIterator, blob_classification,
+                               contrastive_pairs)
+    from jimm_tpu.parallel import PRESET_RULES, shard_batch, use_sharding
+    from jimm_tpu.train import (CheckpointManager, MetricsLogger,
+                                OptimizerConfig, StepTimer,
+                                make_classifier_train_step,
+                                make_contrastive_train_step, make_optimizer)
+
+    fam = _family(args.preset)
+    cfg = preset(args.preset)
+    if args.tiny:
+        cfg = _tiny_override(cfg)
+    if fam == "vit":
+        cfg = dataclasses.replace(cfg, num_classes=4)  # synthetic data classes
+
+    mesh = _parse_mesh(args.mesh)
+    rules = PRESET_RULES[args.rules] if args.rules else (
+        PRESET_RULES["dp"] if mesh is not None else None)
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+
+    model_cls = {"vit": VisionTransformer, "clip": CLIP, "siglip": SigLIP}[fam]
+    model = model_cls(cfg, rngs=nnx.Rngs(args.seed), mesh=mesh, rules=rules,
+                      dtype=dtype, param_dtype=dtype)
+    optimizer = make_optimizer(model, OptimizerConfig(
+        learning_rate=args.lr, weight_decay=args.weight_decay,
+        warmup_steps=args.warmup_steps, total_steps=args.steps))
+
+    if fam == "vit":
+        step_fn = make_classifier_train_step()
+        data = blob_classification(args.batch_size,
+                                   image_size=cfg.vision.image_size,
+                                   num_classes=cfg.num_classes, seed=args.seed)
+    else:
+        loss_kind = args.loss or ("clip" if fam == "clip" else
+                                  ("siglip_ring" if mesh is not None
+                                   else "siglip"))
+        step_fn = make_contrastive_train_step(loss_kind, mesh=mesh)
+        data = contrastive_pairs(args.batch_size,
+                                 image_size=cfg.vision.image_size,
+                                 vocab_size=cfg.text.vocab_size,
+                                 seq_len=cfg.text.context_length,
+                                 seed=args.seed)
+
+    ckpt = CheckpointManager(args.ckpt_dir, save_interval_steps=args.save_every) \
+        if args.ckpt_dir else None
+    start_step = 0
+    if ckpt is not None and args.resume:
+        try:
+            start_step = ckpt.restore(model, optimizer) + 1
+            print(f"resumed from step {start_step - 1}")
+        except FileNotFoundError:
+            pass
+
+    logger = MetricsLogger(path=args.metrics_file, print_every=args.log_every)
+    timer = StepTimer()
+
+    def place(batch):
+        if mesh is None:
+            return tuple(jnp.asarray(b) for b in batch)
+        return shard_batch(batch, mesh, rules)
+
+    data = PrefetchIterator(data, mesh=mesh, rules=rules) \
+        if mesh is not None else map(place, data)
+
+    with use_sharding(mesh, rules):
+        for step in range(start_step, args.steps):
+            batch = next(data)
+            timer.start()
+            metrics = step_fn(model, optimizer, *batch)
+            dt = timer.stop(metrics["loss"])
+            logger.log(step, step_time_s=dt,
+                       **{k: float(v) for k, v in metrics.items()})
+            if ckpt is not None:
+                ckpt.save(step, model, optimizer)
+    if ckpt is not None:
+        ckpt.wait()
+        ckpt.close()
+    logger.close()
+    return 0
+
+
+def cmd_export(args: argparse.Namespace) -> int:
+    _configure_backend(args)
+    import jax.numpy as jnp
+
+    from jimm_tpu import CLIP, SigLIP, VisionTransformer
+    from jimm_tpu.weights.export import save_pretrained
+
+    model_cls = {"vit": VisionTransformer, "clip": CLIP,
+                 "siglip": SigLIP}[args.model]
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    model = model_cls.from_pretrained(args.src, dtype=dtype)
+    save_pretrained(model, args.out)
+    print(f"exported {args.src} -> {args.out}")
+    return 0
+
+
+def cmd_inspect(args: argparse.Namespace) -> int:
+    from jimm_tpu.weights.safetensors_io import read_header
+    header, _ = read_header(args.file)
+    total = 0
+    for name, meta in sorted(header.items()):
+        if name == "__metadata__":
+            continue
+        shape, dtype = meta["shape"], meta["dtype"]
+        n = int(np_prod(shape))
+        total += n
+        print(f"{name:60s} {dtype:10s} {tuple(shape)}")
+    print(f"-- {total / 1e6:.1f}M parameters")
+    return 0
+
+
+def np_prod(shape) -> int:
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def cmd_bench_forward(args: argparse.Namespace) -> int:
+    _configure_backend(args)
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import CLIP, SigLIP, VisionTransformer, preset
+    from jimm_tpu.utils import jit_forward
+
+    fam = _family(args.preset)
+    cfg = preset(args.preset)
+    if args.tiny:
+        cfg = _tiny_override(cfg)
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    model_cls = {"vit": VisionTransformer, "clip": CLIP, "siglip": SigLIP}[fam]
+    model = model_cls(cfg, rngs=nnx.Rngs(0), dtype=dtype, param_dtype=dtype)
+    fwd = jit_forward(model)
+
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(args.batch_size, cfg.vision.image_size,
+                                   cfg.vision.image_size, 3), dtype)
+    inputs = (images,)
+    if fam in ("clip", "siglip"):
+        text = jnp.asarray(rng.randint(1, cfg.text.vocab_size,
+                                       size=(args.batch_size,
+                                             cfg.text.context_length)),
+                           jnp.int32)
+        inputs = (images, text)
+
+    out = fwd(*inputs)
+    jax.device_get(out)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        out = fwd(*inputs)
+    jax.device_get(out)
+    dt = (time.perf_counter() - t0) / args.steps
+    print(f"{args.preset}: {args.batch_size / dt:.1f} images/sec "
+          f"({dt * 1e3:.2f} ms/batch of {args.batch_size})")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+def _add_backend_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--platform", choices=["cpu", "tpu"], default=None,
+                   help="force a JAX backend (default: environment)")
+    p.add_argument("--host-devices", type=int, default=None,
+                   help="virtual CPU device count (for mesh testing)")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="jimm_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("presets", help="list named model presets")
+    sp.set_defaults(fn=cmd_presets)
+
+    sp = sub.add_parser("train", help="train on synthetic data (offline)")
+    sp.add_argument("--preset", required=True)
+    sp.add_argument("--tiny", action="store_true",
+                    help="shrink the preset to CPU-demo size")
+    sp.add_argument("--steps", type=int, default=100)
+    sp.add_argument("--batch-size", type=int, default=32)
+    sp.add_argument("--lr", type=float, default=1e-3)
+    sp.add_argument("--weight-decay", type=float, default=1e-4)
+    sp.add_argument("--warmup-steps", type=int, default=0)
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--bf16", action="store_true")
+    sp.add_argument("--mesh", default=None,
+                    help='e.g. "data=4,model=2" (default: no mesh)')
+    sp.add_argument("--rules", default=None,
+                    choices=[None, "replicated", "dp", "tp", "fsdp",
+                             "fsdp_tp", "sp"],
+                    help="sharding rules preset (requires --mesh)")
+    sp.add_argument("--loss", default=None,
+                    choices=[None, "clip", "siglip", "siglip_ring"])
+    sp.add_argument("--ckpt-dir", default=None)
+    sp.add_argument("--resume", action="store_true")
+    sp.add_argument("--save-every", type=int, default=50)
+    sp.add_argument("--log-every", type=int, default=10)
+    sp.add_argument("--metrics-file", default=None,
+                    help="JSONL metrics output path")
+    _add_backend_flags(sp)
+    sp.set_defaults(fn=cmd_train)
+
+    sp = sub.add_parser("export",
+                        help="load a checkpoint and save as HF safetensors")
+    sp.add_argument("src", help="HF repo id, local file, or local dir")
+    sp.add_argument("out", help="output directory")
+    sp.add_argument("--model", required=True, choices=["vit", "clip", "siglip"])
+    sp.add_argument("--bf16", action="store_true")
+    _add_backend_flags(sp)
+    sp.set_defaults(fn=cmd_export)
+
+    sp = sub.add_parser("inspect", help="list tensors in a safetensors file")
+    sp.add_argument("file")
+    sp.set_defaults(fn=cmd_inspect)
+
+    sp = sub.add_parser("bench-forward", help="jitted forward throughput")
+    sp.add_argument("--preset", required=True)
+    sp.add_argument("--tiny", action="store_true")
+    sp.add_argument("--batch-size", type=int, default=32)
+    sp.add_argument("--steps", type=int, default=20)
+    sp.add_argument("--bf16", action="store_true")
+    _add_backend_flags(sp)
+    sp.set_defaults(fn=cmd_bench_forward)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

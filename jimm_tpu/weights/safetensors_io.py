@@ -38,12 +38,21 @@ _DTYPES: dict[str, np.dtype] = {
 _DTYPE_NAMES = {v: k for k, v in _DTYPES.items()}
 
 
-def load_file(path: str | os.PathLike) -> dict[str, np.ndarray]:
-    """Read every tensor from a .safetensors file (zero-copy mmap views)."""
+def read_header(path: str | os.PathLike) -> tuple[dict[str, Any], int]:
+    """Parse just the JSON header: ``(header, data_start_offset)``.
+
+    ``header`` maps tensor name -> {dtype, shape, data_offsets} (plus the
+    optional ``__metadata__`` entry) without touching the tensor bytes."""
     with open(path, "rb") as f:
         header_len = int.from_bytes(f.read(8), "little")
         header: dict[str, Any] = json.loads(f.read(header_len))
-        data_start = 8 + header_len
+    return header, 8 + header_len
+
+
+def load_file(path: str | os.PathLike) -> dict[str, np.ndarray]:
+    """Read every tensor from a .safetensors file (zero-copy mmap views)."""
+    header, data_start = read_header(path)
+    with open(path, "rb") as f:
         mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
     out: dict[str, np.ndarray] = {}
     for name, info in header.items():

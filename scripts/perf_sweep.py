@@ -20,7 +20,11 @@ from jimm_tpu.train import OptimizerConfig, make_contrastive_train_step, make_op
 from jimm_tpu.train.metrics import train_step_flops
 
 
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+import pathlib
+
+jax.config.update("jax_compilation_cache_dir",
+                  str(pathlib.Path(__file__).resolve().parent.parent
+                      / ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 

@@ -1,0 +1,67 @@
+"""CLI smoke tests (`python -m jimm_tpu ...`), in-process via `cli.main`.
+
+The reference has no CLI at all (SURVEY §5 config row); ours must at least
+list presets, train offline on synthetic data with checkpoint/resume, and
+inspect safetensors files.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from jimm_tpu.cli import main
+from jimm_tpu.weights.safetensors_io import save_file
+
+
+def test_presets_lists_all(capsys):
+    assert main(["presets"]) == 0
+    out = capsys.readouterr().out
+    for name in ("vit-base-patch16-224", "clip-vit-large-patch14",
+                 "siglip-so400m-patch14-384", "siglip2-large-patch16-512"):
+        assert name in out
+
+
+def test_train_tiny_vit(tmp_path, capsys):
+    metrics = tmp_path / "metrics.jsonl"
+    assert main(["train", "--preset", "vit-base-patch16-224", "--tiny",
+                 "--steps", "3", "--batch-size", "8",
+                 "--metrics-file", str(metrics)]) == 0
+    records = [json.loads(line) for line in metrics.read_text().splitlines()]
+    assert len(records) == 3
+    assert all(np.isfinite(r["loss"]) for r in records)
+
+
+def test_train_resume(tmp_path):
+    args = ["train", "--preset", "vit-base-patch16-224", "--tiny",
+            "--batch-size", "8", "--ckpt-dir", str(tmp_path / "ckpt"),
+            "--save-every", "1", "--log-every", "0"]
+    assert main(args + ["--steps", "2"]) == 0
+    metrics = tmp_path / "metrics.jsonl"
+    assert main(args + ["--steps", "4", "--resume",
+                        "--metrics-file", str(metrics)]) == 0
+    records = [json.loads(line) for line in metrics.read_text().splitlines()]
+    # resumed at step 2: only steps 2 and 3 ran in the second invocation
+    assert [r["step"] for r in records] == [2, 3]
+
+
+def test_train_sharded_ring_loss(tmp_path, eight_devices, capsys):
+    assert main(["train", "--preset", "siglip-base-patch16-256", "--tiny",
+                 "--steps", "2", "--batch-size", "8",
+                 "--mesh", "data=4,model=2", "--rules", "fsdp_tp",
+                 "--loss", "siglip_ring", "--log-every", "1"]) == 0
+    assert "loss=" in capsys.readouterr().out
+
+
+def test_inspect(tmp_path, capsys):
+    path = tmp_path / "m.safetensors"
+    save_file({"w": np.zeros((3, 5), np.float32)}, path)
+    assert main(["inspect", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "w" in out and "(3, 5)" in out
+
+
+def test_bench_forward_tiny(capsys):
+    assert main(["bench-forward", "--preset", "siglip-base-patch16-256",
+                 "--tiny", "--batch-size", "4", "--steps", "2"]) == 0
+    assert "images/sec" in capsys.readouterr().out
