@@ -59,14 +59,16 @@ def main() -> None:
             np.float32)
     if args.token_file:
         text = np.load(args.token_file).astype(np.int32)
+        labels = [f"prompt[{i}]" for i in range(text.shape[0])]
     else:
         text = tokenize(args.prompts, args.checkpoint,
                         model.config.text.context_length)
+        labels = args.prompts
 
     logits = jit_forward(model)(jnp.asarray(image), jnp.asarray(text))
     probs = np.asarray(jax.nn.softmax(logits, axis=-1))[0]
-    for prompt, prob in sorted(zip(args.prompts, probs), key=lambda t: -t[1]):
-        print(f"{prob:6.1%}  {prompt}")
+    for label, prob in sorted(zip(labels, probs), key=lambda t: -t[1]):
+        print(f"{prob:6.1%}  {label}")
 
 
 if __name__ == "__main__":

@@ -195,6 +195,8 @@ def cmd_export(args: argparse.Namespace) -> int:
 
 
 def cmd_inspect(args: argparse.Namespace) -> int:
+    import math
+
     from jimm_tpu.weights.safetensors_io import read_header
     header, _ = read_header(args.file)
     total = 0
@@ -202,18 +204,25 @@ def cmd_inspect(args: argparse.Namespace) -> int:
         if name == "__metadata__":
             continue
         shape, dtype = meta["shape"], meta["dtype"]
-        n = int(np_prod(shape))
-        total += n
+        total += math.prod(int(s) for s in shape)
         print(f"{name:60s} {dtype:10s} {tuple(shape)}")
     print(f"-- {total / 1e6:.1f}M parameters")
     return 0
 
 
-def np_prod(shape) -> int:
-    out = 1
-    for s in shape:
-        out *= int(s)
-    return out
+def cmd_build_native(args: argparse.Namespace) -> int:
+    """Compile the native host-preprocessing library (g++, no deps)."""
+    import pathlib
+    import subprocess
+    native_dir = pathlib.Path(__file__).resolve().parents[1] / "native"
+    rc = subprocess.call(["make", "-C", str(native_dir)])
+    if rc == 0:
+        from jimm_tpu.data.preprocess import _load_library
+        ok = _load_library() is not None
+        print("native preprocessing library built and loadable"
+              if ok else "built, but failed to load")
+        return 0 if ok else 1
+    return rc
 
 
 def cmd_bench_forward(args: argparse.Namespace) -> int:
@@ -318,6 +327,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("inspect", help="list tensors in a safetensors file")
     sp.add_argument("file")
     sp.set_defaults(fn=cmd_inspect)
+
+    sp = sub.add_parser("build-native",
+                        help="compile native/libjimm_preprocess.so")
+    sp.set_defaults(fn=cmd_build_native)
 
     sp = sub.add_parser("bench-forward", help="jitted forward throughput")
     sp.add_argument("--preset", required=True)

@@ -1,4 +1,14 @@
 from jimm_tpu.data.pipeline import PrefetchIterator
+from jimm_tpu.data.preprocess import (CLIP_MEAN, CLIP_STD, IMAGENET_MEAN,
+                                      IMAGENET_STD, SIGLIP_MEAN, SIGLIP_STD,
+                                      center_crop, native_available,
+                                      preprocess_batch, resize_bilinear,
+                                      to_float_normalized)
 from jimm_tpu.data.synthetic import blob_classification, contrastive_pairs
 
-__all__ = ["PrefetchIterator", "blob_classification", "contrastive_pairs"]
+__all__ = [
+    "PrefetchIterator", "blob_classification", "contrastive_pairs",
+    "preprocess_batch", "to_float_normalized", "resize_bilinear",
+    "center_crop", "native_available", "IMAGENET_MEAN", "IMAGENET_STD",
+    "CLIP_MEAN", "CLIP_STD", "SIGLIP_MEAN", "SIGLIP_STD",
+]

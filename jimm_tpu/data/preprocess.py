@@ -1,0 +1,169 @@
+"""Host-side image preprocessing: native C++ fast path + numpy fallback.
+
+The TPU compute path is XLA; this is the *host* runtime in front of it. The
+C++ library (`native/preprocess.cpp`, built by `make -C native`) multithreads
+the per-batch CPU work (uint8->float32 normalize, bilinear resize, center
+crop) so input prep overlaps device compute instead of serializing with it
+(the reference's input path is single-threaded numpy,
+ref `examples/vit_training.py:45-57`). If the .so is absent every function
+transparently falls back to an equivalent numpy implementation — results are
+identical to ~1e-6.
+
+Conventions: C-contiguous NHWC float32/uint8; resize uses half-pixel centers
+(PIL / ``tf.image.resize`` semantics, not ``jax.image.resize``'s default).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+import numpy as np
+
+#: CLIP / SigLIP standard normalization constants.
+IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
+CLIP_MEAN = np.asarray([0.48145466, 0.4578275, 0.40821073], np.float32)
+CLIP_STD = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)
+SIGLIP_MEAN = np.asarray([0.5, 0.5, 0.5], np.float32)
+SIGLIP_STD = np.asarray([0.5, 0.5, 0.5], np.float32)
+
+_I64 = ctypes.c_int64
+_F32P = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_U8P = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _load_library() -> ctypes.CDLL | None:
+    override = os.environ.get("JIMM_PREPROCESS_LIB")
+    candidates = [override] if override else [
+        str(Path(__file__).resolve().parents[2] / "native"
+            / "libjimm_preprocess.so"),
+    ]
+    for path in candidates:
+        if path and Path(path).exists():
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                continue
+            lib.jimm_u8_to_f32_normalize.argtypes = [
+                _U8P, _F32P, _I64, _I64, _I64, _I64, _F32P, _F32P,
+                ctypes.c_int]
+            lib.jimm_f32_normalize.argtypes = [
+                _F32P, _I64, _I64, _I64, _I64, _F32P, _F32P, ctypes.c_int]
+            lib.jimm_resize_bilinear_f32.argtypes = [
+                _F32P, _F32P, _I64, _I64, _I64, _I64, _I64, _I64,
+                ctypes.c_int]
+            lib.jimm_center_crop_f32.argtypes = [
+                _F32P, _F32P, _I64, _I64, _I64, _I64, _I64, _I64,
+                ctypes.c_int]
+            return lib
+    return None
+
+
+_LIB = _load_library()
+_THREADS = int(os.environ.get("JIMM_PREPROCESS_THREADS",
+                              min(8, os.cpu_count() or 1)))
+
+
+def native_available() -> bool:
+    return _LIB is not None
+
+
+def _chanwise(arr: np.ndarray, c: int) -> np.ndarray:
+    out = np.ascontiguousarray(np.broadcast_to(
+        np.asarray(arr, np.float32), (c,)))
+    return out
+
+
+def to_float_normalized(images: np.ndarray, mean=SIGLIP_MEAN,
+                        std=SIGLIP_STD) -> np.ndarray:
+    """uint8 or float [B,H,W,C] -> float32, ``(x/255 - mean) / std`` (uint8)
+    or ``(x - mean) / std`` (float input, assumed already in [0,1])."""
+    b, h, w, c = images.shape
+    mean = _chanwise(mean, c)
+    std = _chanwise(std, c)
+    if images.dtype == np.uint8:
+        images = np.ascontiguousarray(images)
+        out = np.empty(images.shape, np.float32)
+        if _LIB is not None:
+            _LIB.jimm_u8_to_f32_normalize(images, out, b, h, w, c, mean, std,
+                                          _THREADS)
+        else:
+            out[...] = (images.astype(np.float32) / 255.0 - mean) / std
+        return out
+    out = np.array(images, np.float32, order="C")  # always a fresh copy
+    if _LIB is not None:
+        _LIB.jimm_f32_normalize(out, b, h, w, c, mean, std, _THREADS)
+    else:
+        out[...] = (out - mean) / std
+    return out
+
+
+def resize_bilinear(images: np.ndarray, size: tuple[int, int]) -> np.ndarray:
+    """float32 [B,H,W,C] -> [B,size[0],size[1],C], half-pixel bilinear."""
+    images = np.ascontiguousarray(images, np.float32)
+    b, sh, sw, c = images.shape
+    dh, dw = size
+    if (sh, sw) == (dh, dw):
+        return images
+    out = np.empty((b, dh, dw, c), np.float32)
+    if _LIB is not None:
+        _LIB.jimm_resize_bilinear_f32(images, out, b, sh, sw, dh, dw, c,
+                                      _THREADS)
+        return out
+    # numpy fallback: gather the four corners with precomputed weights
+    ys = np.maximum((np.arange(dh, dtype=np.float32) + 0.5) * (sh / dh) - 0.5,
+                    0.0)
+    xs = np.maximum((np.arange(dw, dtype=np.float32) + 0.5) * (sw / dw) - 0.5,
+                    0.0)
+    y0 = np.minimum(ys.astype(np.int64), sh - 1)
+    x0 = np.minimum(xs.astype(np.int64), sw - 1)
+    y1 = np.minimum(y0 + 1, sh - 1)
+    x1 = np.minimum(x0 + 1, sw - 1)
+    wy = (ys - y0).astype(np.float32)[None, :, None, None]
+    wx = (xs - x0).astype(np.float32)[None, None, :, None]
+    top = (images[:, y0][:, :, x0] * (1 - wx)
+           + images[:, y0][:, :, x1] * wx)
+    bot = (images[:, y1][:, :, x0] * (1 - wx)
+           + images[:, y1][:, :, x1] * wx)
+    out[...] = top * (1 - wy) + bot * wy
+    return out
+
+
+def center_crop(images: np.ndarray, size: tuple[int, int]) -> np.ndarray:
+    """float32 [B,H,W,C] -> centered [B,size[0],size[1],C]."""
+    images = np.ascontiguousarray(images, np.float32)
+    b, h, w, c = images.shape
+    ch, cw = size
+    if (h, w) == (ch, cw):
+        return images
+    if ch > h or cw > w:
+        raise ValueError(f"crop {size} larger than image {(h, w)}")
+    if _LIB is not None:
+        out = np.empty((b, ch, cw, c), np.float32)
+        _LIB.jimm_center_crop_f32(images, out, b, h, w, ch, cw, c, _THREADS)
+        return out
+    y0, x0 = (h - ch) // 2, (w - cw) // 2
+    return np.ascontiguousarray(images[:, y0:y0 + ch, x0:x0 + cw])
+
+
+def preprocess_batch(images: np.ndarray, *, image_size: int,
+                     mean=SIGLIP_MEAN, std=SIGLIP_STD,
+                     crop: bool = False) -> np.ndarray:
+    """Full inference-style pipeline: resize (shorter side or direct) ->
+    optional center crop -> normalize. Input uint8/float [B,H,W,C]."""
+    b, h, w, c = images.shape
+    if images.dtype == np.uint8:
+        if not crop and (h, w) == (image_size, image_size):
+            # single fused multithreaded pass: u8 -> normalized f32
+            return to_float_normalized(images, mean, std)
+        # multithreaded u8 -> [0,1] f32 (mean 0 / std 1), then resize
+        images = to_float_normalized(images, 0.0, 1.0)
+    if crop and (h != w):
+        scale = image_size / min(h, w)
+        images = resize_bilinear(images, (round(h * scale), round(w * scale)))
+        images = center_crop(images, (image_size, image_size))
+    else:
+        images = resize_bilinear(images, (image_size, image_size))
+    return to_float_normalized(images, mean, std)

@@ -1,0 +1,146 @@
+// Native host-side image preprocessing for the input pipeline.
+//
+// The TPU compute path is jax/XLA; the host-side runtime around it is native
+// (the reference has no native code at all — SURVEY §2.2 — its input path is
+// single-threaded numpy, ref `examples/vit_training.py:45-57`). This library
+// does the per-batch CPU work that would otherwise serialize with dispatch:
+// uint8 -> float32 conversion, mean/std normalization, bilinear resize, and
+// center crop, multithreaded over the batch dimension.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image). All
+// arrays are C-contiguous NHWC.
+//
+// Build: make -C native   ->  native/libjimm_preprocess.so
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Run fn(b) for b in [0, batch) over a small thread pool.
+void parallel_for_batch(int64_t batch, int threads,
+                        const std::function<void(int64_t)>& fn) {
+  if (threads <= 1 || batch <= 1) {
+    for (int64_t b = 0; b < batch; ++b) fn(b);
+    return;
+  }
+  int n = std::min<int64_t>(threads, batch);
+  std::vector<std::thread> pool;
+  pool.reserve(n);
+  std::atomic<int64_t> next{0};
+  for (int t = 0; t < n; ++t) {
+    pool.emplace_back([&] {
+      for (int64_t b = next.fetch_add(1); b < batch; b = next.fetch_add(1))
+        fn(b);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+inline float lerp(float a, float b, float w) { return a + (b - a) * w; }
+
+// Bilinear sample of one output row for all channels.
+void resize_row(const float* src, int64_t sh, int64_t sw, int64_t c,
+                float* dst, int64_t dw, float sy, float scale_x) {
+  int64_t y0 = static_cast<int64_t>(sy);
+  y0 = std::min(y0, sh - 1);
+  int64_t y1 = std::min(y0 + 1, sh - 1);
+  float wy = sy - static_cast<float>(y0);
+  const float* row0 = src + y0 * sw * c;
+  const float* row1 = src + y1 * sw * c;
+  for (int64_t x = 0; x < dw; ++x) {
+    float sx = (static_cast<float>(x) + 0.5f) * scale_x - 0.5f;
+    sx = std::max(sx, 0.0f);
+    int64_t x0 = static_cast<int64_t>(sx);
+    x0 = std::min(x0, sw - 1);
+    int64_t x1 = std::min(x0 + 1, sw - 1);
+    float wx = sx - static_cast<float>(x0);
+    for (int64_t k = 0; k < c; ++k) {
+      float top = lerp(row0[x0 * c + k], row0[x1 * c + k], wx);
+      float bot = lerp(row1[x0 * c + k], row1[x1 * c + k], wx);
+      dst[x * c + k] = lerp(top, bot, wy);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// uint8 [B,H,W,C] -> float32 [B,H,W,C], out = (in*(1/255) - mean[c]) / std[c]
+void jimm_u8_to_f32_normalize(const uint8_t* in, float* out, int64_t batch,
+                              int64_t h, int64_t w, int64_t c,
+                              const float* mean, const float* std_,
+                              int threads) {
+  const int64_t plane = h * w * c;
+  std::vector<float> inv_std(c), off(c);
+  for (int64_t k = 0; k < c; ++k) {
+    inv_std[k] = 1.0f / std_[k];
+    off[k] = mean[k];
+  }
+  parallel_for_batch(batch, threads, [&](int64_t b) {
+    const uint8_t* src = in + b * plane;
+    float* dst = out + b * plane;
+    constexpr float kInv255 = 1.0f / 255.0f;
+    for (int64_t i = 0; i < plane; ++i) {
+      int64_t k = i % c;
+      dst[i] = (static_cast<float>(src[i]) * kInv255 - off[k]) * inv_std[k];
+    }
+  });
+}
+
+// float32 [B,H,W,C] in-place channel normalization: (x - mean[c]) / std[c]
+void jimm_f32_normalize(float* data, int64_t batch, int64_t h, int64_t w,
+                        int64_t c, const float* mean, const float* std_,
+                        int threads) {
+  const int64_t plane = h * w * c;
+  std::vector<float> inv_std(c);
+  for (int64_t k = 0; k < c; ++k) inv_std[k] = 1.0f / std_[k];
+  parallel_for_batch(batch, threads, [&](int64_t b) {
+    float* p = data + b * plane;
+    for (int64_t i = 0; i < plane; ++i) {
+      int64_t k = i % c;
+      p[i] = (p[i] - mean[k]) * inv_std[k];
+    }
+  });
+}
+
+// Bilinear resize float32 [B,sh,sw,C] -> [B,dh,dw,C] (half-pixel centers,
+// matching PIL/TF "align_corners=False" semantics).
+void jimm_resize_bilinear_f32(const float* in, float* out, int64_t batch,
+                              int64_t sh, int64_t sw, int64_t dh, int64_t dw,
+                              int64_t c, int threads) {
+  const float scale_y = static_cast<float>(sh) / static_cast<float>(dh);
+  const float scale_x = static_cast<float>(sw) / static_cast<float>(dw);
+  parallel_for_batch(batch, threads, [&](int64_t b) {
+    const float* src = in + b * sh * sw * c;
+    float* dst = out + b * dh * dw * c;
+    for (int64_t y = 0; y < dh; ++y) {
+      float sy = (static_cast<float>(y) + 0.5f) * scale_y - 0.5f;
+      sy = std::max(sy, 0.0f);
+      resize_row(src, sh, sw, c, dst + y * dw * c, dw, sy, scale_x);
+    }
+  });
+}
+
+// Center crop float32 [B,H,W,C] -> [B,ch,cw,C]
+void jimm_center_crop_f32(const float* in, float* out, int64_t batch,
+                          int64_t h, int64_t w, int64_t ch, int64_t cw,
+                          int64_t c, int threads) {
+  const int64_t y0 = (h - ch) / 2;
+  const int64_t x0 = (w - cw) / 2;
+  parallel_for_batch(batch, threads, [&](int64_t b) {
+    const float* src = in + (b * h * w + y0 * w + x0) * c;
+    float* dst = out + b * ch * cw * c;
+    for (int64_t y = 0; y < ch; ++y)
+      std::memcpy(dst + y * cw * c, src + y * w * c,
+                  sizeof(float) * cw * c);
+  });
+}
+
+}  // extern "C"

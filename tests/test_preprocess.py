@@ -1,0 +1,88 @@
+"""Native C++ preprocessing vs numpy fallback: identical results, and the
+numpy path is itself validated against straightforward reference math."""
+
+import numpy as np
+import pytest
+
+import jimm_tpu.data.preprocess as pp
+
+
+needs_native = pytest.mark.skipif(not pp.native_available(),
+                                  reason="native library not built")
+
+
+def _with_fallback(fn, *args, **kwargs):
+    """Run fn with the native library disabled."""
+    lib, pp._LIB = pp._LIB, None
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        pp._LIB = lib
+
+
+def test_normalize_u8_reference(rng):
+    img = rng.randint(0, 256, size=(3, 8, 9, 3)).astype(np.uint8)
+    out = _with_fallback(pp.to_float_normalized, img, pp.CLIP_MEAN,
+                         pp.CLIP_STD)
+    expect = (img.astype(np.float32) / 255.0 - pp.CLIP_MEAN) / pp.CLIP_STD
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+@needs_native
+def test_normalize_native_matches_numpy(rng):
+    img = rng.randint(0, 256, size=(5, 17, 13, 3)).astype(np.uint8)
+    native = pp.to_float_normalized(img, pp.IMAGENET_MEAN, pp.IMAGENET_STD)
+    fallback = _with_fallback(pp.to_float_normalized, img, pp.IMAGENET_MEAN,
+                              pp.IMAGENET_STD)
+    np.testing.assert_allclose(native, fallback, rtol=1e-5, atol=1e-6)
+
+
+@needs_native
+def test_normalize_f32_native_matches_numpy(rng):
+    img = rng.rand(4, 12, 12, 3).astype(np.float32)
+    native = pp.to_float_normalized(img, pp.SIGLIP_MEAN, pp.SIGLIP_STD)
+    fallback = _with_fallback(pp.to_float_normalized, img, pp.SIGLIP_MEAN,
+                              pp.SIGLIP_STD)
+    np.testing.assert_allclose(native, fallback, rtol=1e-5, atol=1e-6)
+
+
+@needs_native
+@pytest.mark.parametrize("src,dst", [((32, 32), (16, 16)),
+                                     ((17, 23), (32, 48)),
+                                     ((64, 64), (63, 65))])
+def test_resize_native_matches_numpy(rng, src, dst):
+    img = rng.rand(3, *src, 3).astype(np.float32)
+    native = pp.resize_bilinear(img, dst)
+    fallback = _with_fallback(pp.resize_bilinear, img, dst)
+    assert native.shape == (3, *dst, 3)
+    np.testing.assert_allclose(native, fallback, rtol=1e-4, atol=1e-5)
+
+
+def test_resize_identity(rng):
+    img = rng.rand(2, 8, 8, 3).astype(np.float32)
+    np.testing.assert_array_equal(pp.resize_bilinear(img, (8, 8)), img)
+
+
+def test_resize_constant_image_is_preserved():
+    img = np.full((1, 10, 10, 1), 3.5, np.float32)
+    for impl in (pp.resize_bilinear,
+                 lambda im, s: _with_fallback(pp.resize_bilinear, im, s)):
+        out = impl(img, (7, 13))
+        np.testing.assert_allclose(out, 3.5, rtol=1e-6)
+
+
+@needs_native
+def test_center_crop_native_matches_numpy(rng):
+    img = rng.rand(2, 20, 30, 3).astype(np.float32)
+    native = pp.center_crop(img, (16, 16))
+    fallback = _with_fallback(pp.center_crop, img, (16, 16))
+    np.testing.assert_array_equal(native, fallback)
+    np.testing.assert_array_equal(native, img[:, 2:18, 7:23])
+
+
+def test_preprocess_batch_end_to_end(rng):
+    img = rng.randint(0, 256, size=(2, 40, 60, 3)).astype(np.uint8)
+    out = pp.preprocess_batch(img, image_size=32, crop=True)
+    assert out.shape == (2, 32, 32, 3) and out.dtype == np.float32
+    # SigLIP normalization maps [0,1] -> [-1,1]
+    assert -1.001 <= out.min() and out.max() <= 1.001
