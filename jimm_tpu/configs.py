@@ -49,6 +49,11 @@ class TransformerConfig:
     causal: bool = False
     attn_impl: AttnImpl = "auto"
     remat: bool = False
+    #: What the backward pass may keep from the forward when ``remat`` is on:
+    #: "none" recomputes everything (min memory, ~1/3 extra FLOPs); "dots"
+    #: saves matmul outputs and recomputes only cheap elementwise ops
+    #: (ln/act/softmax) — the usual best MFU/memory trade on TPU.
+    remat_policy: Literal["none", "dots"] = "none"
 
     @property
     def head_dim(self) -> int:
@@ -81,6 +86,7 @@ class VisionConfig:
     patch_bias: bool = True
     attn_impl: AttnImpl = "auto"
     remat: bool = False
+    remat_policy: Literal["none", "dots"] = "none"
 
     @property
     def grid(self) -> int:
@@ -99,7 +105,7 @@ class VisionConfig:
             width=self.width, depth=self.depth, num_heads=self.num_heads,
             mlp_dim=self.mlp_dim, act=self.act, ln_eps=self.ln_eps,
             dropout=self.dropout, causal=False, attn_impl=self.attn_impl,
-            remat=self.remat,
+            remat=self.remat, remat_policy=self.remat_policy,
         )
 
 
@@ -126,13 +132,14 @@ class TextConfig:
     eos_token_id: int | None = None
     attn_impl: AttnImpl = "auto"
     remat: bool = False
+    remat_policy: Literal["none", "dots"] = "none"
 
     def encoder(self) -> TransformerConfig:
         return TransformerConfig(
             width=self.width, depth=self.depth, num_heads=self.num_heads,
             mlp_dim=self.mlp_dim, act=self.act, ln_eps=self.ln_eps,
             dropout=self.dropout, causal=self.causal, attn_impl=self.attn_impl,
-            remat=self.remat,
+            remat=self.remat, remat_policy=self.remat_policy,
         )
 
 

@@ -123,10 +123,9 @@ def resize_bilinear(images: np.ndarray, size: tuple[int, int]) -> np.ndarray:
     x1 = np.minimum(x0 + 1, sw - 1)
     wy = (ys - y0).astype(np.float32)[None, :, None, None]
     wx = (xs - x0).astype(np.float32)[None, None, :, None]
-    top = (images[:, y0][:, :, x0] * (1 - wx)
-           + images[:, y0][:, :, x1] * wx)
-    bot = (images[:, y1][:, :, x0] * (1 - wx)
-           + images[:, y1][:, :, x1] * wx)
+    rows0, rows1 = images[:, y0], images[:, y1]
+    top = rows0[:, :, x0] * (1 - wx) + rows0[:, :, x1] * wx
+    bot = rows1[:, :, x0] * (1 - wx) + rows1[:, :, x1] * wx
     out[...] = top * (1 - wy) + bot * wy
     return out
 

@@ -142,7 +142,18 @@ class Transformer(nnx.Module):
             return block(x)
 
         if self.cfg.remat:
-            body = nnx.remat(body)
+            # "dots" keeps matmul outputs and recomputes only elementwise ops
+            # in the backward — far cheaper than full remat at slightly more
+            # memory; "none" is classic full rematerialization.
+            if self.cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            elif self.cfg.remat_policy == "none":
+                policy = None
+            else:
+                raise ValueError(
+                    f"unknown remat_policy {self.cfg.remat_policy!r}; "
+                    "expected 'none' or 'dots'")
+            body = nnx.remat(body, policy=policy)
         scan = nnx.scan(body, in_axes=(0, nnx.Carry), out_axes=nnx.Carry,
                         transform_metadata={nnx.PARTITION_NAME: "layers"})
         return scan(self.blocks, x)

@@ -28,13 +28,16 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
-def run(name: str, batch: int, remat: bool, attn: str, steps: int = 30) -> dict:
+def run(name: str, batch: int, remat: bool, attn: str, steps: int = 30,
+        policy: str = "none") -> dict:
     t_start = time.perf_counter()
     cfg = preset("siglip-base-patch16-256")
     cfg = dataclasses.replace(
         cfg,
-        vision=dataclasses.replace(cfg.vision, remat=remat, attn_impl=attn),
-        text=dataclasses.replace(cfg.text, remat=remat, attn_impl=attn))
+        vision=dataclasses.replace(cfg.vision, remat=remat, attn_impl=attn,
+                                   remat_policy=policy),
+        text=dataclasses.replace(cfg.text, remat=remat, attn_impl=attn,
+                                 remat_policy=policy))
     model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
                    param_dtype=jnp.bfloat16)
     optimizer = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
@@ -76,6 +79,11 @@ CONFIGS = {
     "noremat_flash_256": dict(batch=256, remat=False, attn="flash"),
     "remat_xla_512": dict(batch=512, remat=True, attn="xla"),
     "remat_flash_512": dict(batch=512, remat=True, attn="flash"),
+    "dots_flash_128": dict(batch=128, remat=True, attn="flash", policy="dots"),
+    "dots_xla_128": dict(batch=128, remat=True, attn="xla", policy="dots"),
+    "dots_flash_256": dict(batch=256, remat=True, attn="flash", policy="dots"),
+    "dots_xla_256": dict(batch=256, remat=True, attn="xla", policy="dots"),
+    "dots_flash_512": dict(batch=512, remat=True, attn="flash", policy="dots"),
 }
 
 if __name__ == "__main__":

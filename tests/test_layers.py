@@ -96,6 +96,26 @@ def test_transformer_remat_same_output():
     np.testing.assert_allclose(tr(x), tr_r(x), atol=1e-6)
 
 
+def test_transformer_remat_policies_same_gradients():
+    """Full remat ("none") and dots-saveable remat must both match the
+    un-rematerialized gradient — they change memory/FLOPs, not math."""
+    base = dict(width=16, depth=3, num_heads=2, mlp_dim=32)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 16), jnp.float32)
+
+    def grad_sum(cfg):
+        m = Transformer(cfg, nnx.Rngs(0))
+        g = nnx.grad(lambda m: (m(x) ** 2).sum())(m)
+        return jax.tree.reduce(lambda a, b: a + float(jnp.abs(b).sum()),
+                               nnx.state(g, nnx.Param), 0.0)
+
+    plain = grad_sum(TransformerConfig(**base))
+    full = grad_sum(TransformerConfig(**base, remat=True))
+    dots = grad_sum(TransformerConfig(**base, remat=True,
+                                      remat_policy="dots"))
+    np.testing.assert_allclose(full, plain, rtol=1e-5)
+    np.testing.assert_allclose(dots, plain, rtol=1e-5)
+
+
 def test_map_head_residual_is_pre_layernorm():
     """MAP residual order quirk (ref `common/vit.py:96-101`)."""
     cfg = VisionConfig(image_size=32, patch_size=16, width=16, depth=1,
