@@ -240,12 +240,17 @@ def _flash_fwd(q3, k3, v3, causal, sm_scale, block_q, block_k):
     return _flash_fwd_impl(q3, k3, v3, causal, sm_scale, block_q, block_k)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, do, dlse=None):
     q3, k3, v3, o, lse = res
     bn, sq, d = q3.shape
     sk = k3.shape[1]
     sq_p, sk_p = _ceil_to(sq, block_q), _ceil_to(sk, block_k)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        # An lse cotangent folds exactly into delta: the lse output adds
+        # dlse_i * p_ij to ds_ij, and the kernels compute
+        # ds = p * (dp - delta), so delta -= dlse covers it for free.
+        delta = delta - dlse.astype(jnp.float32)
     qp, dop = _pad_seq(q3, sq_p), _pad_seq(do, sq_p)
     kp, vp = _pad_seq(k3, sk_p), _pad_seq(v3, sk_p)
     lse_p = jnp.pad(lse, ((0, 0), (0, sq_p - lse.shape[1])))[:, None]
@@ -310,3 +315,47 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     q3, k3, v3 = map(_flatten_heads, (q, k, v))
     o = _flash(q3, k3, v3, is_causal, sm_scale, block_q, block_k)
     return _unflatten_heads(o, b, n)
+
+
+# ---------------------------------------------------------------------------
+# (o, lse) variant — building block for cross-chip ring attention
+# ---------------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q3, k3, v3, causal, sm_scale, block_q, block_k):
+    o, (_, _, _, _, lse) = _flash_fwd_impl(q3, k3, v3, causal, sm_scale,
+                                           block_q, block_k)
+    return o, lse
+
+
+def _flash_lse_fwd(q3, k3, v3, causal, sm_scale, block_q, block_k):
+    o, res = _flash_fwd_impl(q3, k3, v3, causal, sm_scale, block_q, block_k)
+    return (o, res[4]), res
+
+
+def _flash_lse_bwd(causal, sm_scale, block_q, block_k, res, cts):
+    do, dlse = cts
+    # The lse cotangent is exact and free: it folds into the delta term of
+    # the standard flash backward (see _flash_bwd) — no extra passes, no
+    # materialized attention matrix.
+    return _flash_bwd(causal, sm_scale, block_q, block_k, res, do, dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        is_causal: bool = False,
+                        block_q: int = DEFAULT_BLOCK_Q,
+                        block_k: int = DEFAULT_BLOCK_K
+                        ) -> tuple[jax.Array, jax.Array]:
+    """Like `flash_attention` but also returns the per-row logsumexp
+    ``(B, N, S)`` so partial results over kv chunks can be merged exactly
+    (the ring-attention combine)."""
+    b, sq, n, d = q.shape
+    sm_scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, _ceil_to(sq, 128))
+    block_k = min(block_k, _ceil_to(k.shape[1], 128))
+    q3, k3, v3 = map(_flatten_heads, (q, k, v))
+    o3, lse3 = _flash_lse(q3, k3, v3, is_causal, sm_scale, block_q, block_k)
+    return _unflatten_heads(o3, b, n), lse3.reshape(b, n, sq)

@@ -40,6 +40,35 @@ def _block(q, k, v, mask):
     return m, l, pv
 
 
+def _ring_local_flash(q, k, v, *, axis_name: str):
+    """Ring step where each local (q x kv-chunk) product is the Pallas flash
+    kernel (`flash_attention_lse`); chunk results are merged by logsumexp
+    reweighting. Non-causal only (vision towers): a causal version needs a
+    per-chunk static mask switch, which the einsum path provides."""
+    from jimm_tpu.ops.flash_attention import flash_attention_lse
+
+    n_dev = jax.lax.axis_size(axis_name)
+    b, sq, n, d = q.shape
+
+    def step(carry, _):
+        k_cur, v_cur, lse, acc = carry
+        o_blk, lse_blk = flash_attention_lse(q, k_cur, v_cur)  # (B,Sq,N,D), (B,N,Sq)
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+        w_blk = jnp.exp(lse_blk - lse_new).transpose(0, 2, 1)[..., None]
+        acc_new = acc * w_old + o_blk.astype(jnp.float32) * w_blk
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, lse_new, acc_new), None
+
+    lse0 = jnp.full((b, n, sq), NEG_INF, jnp.float32)
+    acc0 = jnp.zeros((b, sq, n, d), jnp.float32)
+    (_, _, _, acc), _ = jax.lax.scan(step, (k, v, lse0, acc0),
+                                     jnp.arange(n_dev))
+    return acc.astype(q.dtype)
+
+
 def _ring_local(q, k, v, *, axis_name: str, causal: bool):
     n_dev = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -79,13 +108,27 @@ def _ring_local(q, k, v, *, axis_name: str, causal: bool):
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, mesh: Mesh,
-                   axis_name: str = "seq", is_causal: bool = False
-                   ) -> jax.Array:
+                   axis_name: str = "seq", is_causal: bool = False,
+                   impl: str = "einsum") -> jax.Array:
     """Exact attention over ``(B, S, N, D)`` q/k/v whose sequence dim is
     sharded over ``axis_name``. Equals full (unsharded) attention to fp32
-    accuracy."""
+    accuracy.
+
+    ``impl="flash"`` runs each local (q x kv-chunk) product through the
+    Pallas flash kernel and merges chunks by logsumexp reweighting — flash
+    blocks within the chip, the ring blocks across chips. Non-causal only.
+    """
+    if impl == "flash":
+        if is_causal:
+            raise ValueError("impl='flash' ring attention is non-causal only; "
+                             "use impl='einsum' for causal")
+        local = partial(_ring_local_flash, axis_name=axis_name)
+    elif impl == "einsum":
+        local = partial(_ring_local, axis_name=axis_name, causal=is_causal)
+    else:
+        raise ValueError(f"unknown ring attention impl {impl!r}")
     fn = shard_map(
-        partial(_ring_local, axis_name=axis_name, causal=is_causal),
+        local,
         mesh=mesh,
         in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
         out_specs=P(None, axis_name),
