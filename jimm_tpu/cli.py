@@ -109,6 +109,14 @@ def cmd_train(args: argparse.Namespace) -> int:
     cfg = preset(args.preset)
     if args.tiny:
         cfg = _tiny_override(cfg)
+    if args.attn_impl:
+        cfg = dataclasses.replace(
+            cfg, vision=dataclasses.replace(cfg.vision,
+                                            attn_impl=args.attn_impl))
+        if hasattr(cfg, "text"):
+            cfg = dataclasses.replace(
+                cfg, text=dataclasses.replace(cfg.text,
+                                              attn_impl=args.attn_impl))
     if fam == "vit":
         cfg = dataclasses.replace(cfg, num_classes=4)  # synthetic data classes
 
@@ -306,6 +314,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="sharding rules preset (requires --mesh)")
     sp.add_argument("--loss", default=None,
                     choices=[None, "clip", "siglip", "siglip_ring"])
+    sp.add_argument("--attn-impl", default=None,
+                    choices=[None, "auto", "xla", "flash", "ring"],
+                    help="attention kernel for both towers "
+                         "(ring = sequence-parallel, needs a seq mesh axis)")
     sp.add_argument("--ckpt-dir", default=None)
     sp.add_argument("--resume", action="store_true")
     sp.add_argument("--save-every", type=int, default=50)

@@ -17,7 +17,7 @@ from typing import Any, Literal
 
 Pooling = Literal["cls", "map", "last", "eot", "none"]
 Activation = Literal["gelu", "gelu_tanh", "quick_gelu"]
-AttnImpl = Literal["auto", "xla", "flash"]
+AttnImpl = Literal["auto", "xla", "flash", "ring"]
 
 
 def normalize_act(name: str | None, default: str = "gelu") -> str:

@@ -8,6 +8,9 @@ The reference locks attention to ``flax.nnx.MultiHeadAttention``'s einsum path
   vision/text sequences and for CPU tests).
 - ``"flash"`` — Pallas TPU flash attention (fwd + custom-vjp bwd), used for
   training and long sequences. See `jimm_tpu/ops/flash_attention.py`.
+- ``"ring"`` — sequence-parallel ring attention over the ambient mesh's
+  ``seq`` axis (long context across chips; flash within each chip on TPU).
+  See `jimm_tpu/parallel/ring_attention.py`.
 - ``"auto"`` — flash on TPU when shapes qualify, else XLA.
 """
 
@@ -52,6 +55,16 @@ def dot_product_attention(
                              "masks; use is_causal or impl='xla'")
         from jimm_tpu.ops.flash_attention import flash_attention
         return flash_attention(q, k, v, is_causal=is_causal)
+    if impl == "ring":
+        if mask is not None:
+            raise ValueError("ring attention does not support explicit "
+                             "masks; use is_causal or impl='xla'")
+        from jimm_tpu.parallel.ring_attention import ring_attention
+        from jimm_tpu.parallel.sharding import current_rules
+        rules = current_rules()
+        axis = (rules.seq if rules is not None and rules.seq else "seq")
+        return ring_attention(q, k, v, axis_name=axis, is_causal=is_causal,
+                              impl="auto")
     if impl == "xla":
         return jax.nn.dot_product_attention(q, k, v, mask=mask,
                                             is_causal=is_causal)

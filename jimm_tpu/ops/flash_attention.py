@@ -301,6 +301,16 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do, dlse=None):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _prologue(q, k, v, block_q, block_k):
+    """Shared head-flattening + scale/block selection for both entry points."""
+    d = q.shape[-1]
+    sm_scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, _ceil_to(q.shape[1], 128))
+    block_k = min(block_k, _ceil_to(k.shape[1], 128))
+    q3, k3, v3 = map(_flatten_heads, (q, k, v))
+    return q3, k3, v3, sm_scale, block_q, block_k
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     is_causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
@@ -308,11 +318,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Flash attention over ``(B, S, N, D)`` q/k/v. Scale is 1/sqrt(D) like
     `jax.nn.dot_product_attention`. Runs the Pallas interpreter off-TPU so
     CPU tests exercise the same code path."""
-    b, sq, n, d = q.shape
-    sm_scale = 1.0 / (d ** 0.5)
-    block_q = min(block_q, _ceil_to(sq, 128))
-    block_k = min(block_k, _ceil_to(k.shape[1], 128))
-    q3, k3, v3 = map(_flatten_heads, (q, k, v))
+    b, _, n, _ = q.shape
+    q3, k3, v3, sm_scale, block_q, block_k = _prologue(q, k, v, block_q,
+                                                       block_k)
     o = _flash(q3, k3, v3, is_causal, sm_scale, block_q, block_k)
     return _unflatten_heads(o, b, n)
 
@@ -352,10 +360,8 @@ def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Like `flash_attention` but also returns the per-row logsumexp
     ``(B, N, S)`` so partial results over kv chunks can be merged exactly
     (the ring-attention combine)."""
-    b, sq, n, d = q.shape
-    sm_scale = 1.0 / (d ** 0.5)
-    block_q = min(block_q, _ceil_to(sq, 128))
-    block_k = min(block_k, _ceil_to(k.shape[1], 128))
-    q3, k3, v3 = map(_flatten_heads, (q, k, v))
+    b, sq, n, _ = q.shape
+    q3, k3, v3, sm_scale, block_q, block_k = _prologue(q, k, v, block_q,
+                                                       block_k)
     o3, lse3 = _flash_lse(q3, k3, v3, is_causal, sm_scale, block_q, block_k)
     return _unflatten_heads(o3, b, n), lse3.reshape(b, n, sq)

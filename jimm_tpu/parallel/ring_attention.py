@@ -50,22 +50,28 @@ def _ring_local_flash(q, k, v, *, axis_name: str):
     n_dev = jax.lax.axis_size(axis_name)
     b, sq, n, d = q.shape
 
-    def step(carry, _):
-        k_cur, v_cur, lse, acc = carry
+    def combine(k_cur, v_cur, lse, acc):
         o_blk, lse_blk = flash_attention_lse(q, k_cur, v_cur)  # (B,Sq,N,D), (B,N,Sq)
         lse_new = jnp.logaddexp(lse, lse_blk)
         w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
         w_blk = jnp.exp(lse_blk - lse_new).transpose(0, 2, 1)[..., None]
-        acc_new = acc * w_old + o_blk.astype(jnp.float32) * w_blk
+        return lse_new, acc * w_old + o_blk.astype(jnp.float32) * w_blk
+
+    def step(carry, _):
+        k_cur, v_cur, lse, acc = carry
+        lse, acc = combine(k_cur, v_cur, lse, acc)
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, lse_new, acc_new), None
+        return (k_nxt, v_nxt, lse, acc), None
 
     lse0 = jnp.full((b, n, sq), NEG_INF, jnp.float32)
     acc0 = jnp.zeros((b, sq, n, d), jnp.float32)
-    (_, _, _, acc), _ = jax.lax.scan(step, (k, v, lse0, acc0),
-                                     jnp.arange(n_dev))
+    # n_dev-1 permuting steps, then the final chunk without the (wasted)
+    # last permute
+    (k, v, lse, acc), _ = jax.lax.scan(step, (k, v, lse0, acc0),
+                                       jnp.arange(n_dev - 1))
+    _, acc = combine(k, v, lse, acc)
     return acc.astype(q.dtype)
 
 
@@ -77,8 +83,7 @@ def _ring_local(q, k, v, *, axis_name: str, causal: bool):
 
     q_pos = idx * sq + jnp.arange(sq)
 
-    def step(carry, j):
-        k_cur, v_cur, m, l, acc = carry
+    def combine(j, k_cur, v_cur, m, l, acc):
         src = (idx - j) % n_dev  # ring owner of the current kv chunk
         k_pos = src * sk + jnp.arange(sk)
         mask = jnp.ones((sq, sk), bool)
@@ -92,32 +97,65 @@ def _ring_local(q, k, v, *, axis_name: str, causal: bool):
         l_new = l * c_old + l_blk * c_blk
         acc_new = (acc * c_old.transpose(0, 2, 1)[..., None]
                    + pv_blk * c_blk.transpose(0, 2, 1)[..., None])
+        return m_new, l_new, acc_new
+
+    def step(carry, j):
+        k_cur, v_cur, m, l, acc = carry
+        m, l, acc = combine(j, k_cur, v_cur, m, l, acc)
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+        return (k_nxt, v_nxt, m, l, acc), None
 
     m0 = jnp.full((b, n, sq), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, n, sq), jnp.float32)
     acc0 = jnp.zeros((b, sq, n, d), jnp.float32)
+    # n_dev-1 permuting steps, then the final chunk without the last permute
     (k, v, m, l, acc), _ = jax.lax.scan(step, (k, v, m0, l0, acc0),
-                                        jnp.arange(n_dev))
+                                        jnp.arange(n_dev - 1))
+    m, l, acc = combine(n_dev - 1, k, v, m, l, acc)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = acc / l_safe.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
 
-def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, mesh: Mesh,
-                   axis_name: str = "seq", is_causal: bool = False,
-                   impl: str = "einsum") -> jax.Array:
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   mesh: Mesh | None = None, axis_name: str = "seq",
+                   is_causal: bool = False, impl: str = "einsum") -> jax.Array:
     """Exact attention over ``(B, S, N, D)`` q/k/v whose sequence dim is
     sharded over ``axis_name``. Equals full (unsharded) attention to fp32
     accuracy.
 
+    ``mesh=None`` uses the ambient mesh installed by
+    ``jimm_tpu.parallel.use_sharding`` / ``jax.set_mesh``.
+
     ``impl="flash"`` runs each local (q x kv-chunk) product through the
     Pallas flash kernel and merges chunks by logsumexp reweighting — flash
     blocks within the chip, the ring blocks across chips. Non-causal only.
+    ``impl="auto"`` picks flash on TPU for non-causal, einsum otherwise.
     """
+    if mesh is None:
+        # Works both outside and inside jit: the abstract mesh mirrors the
+        # ambient concrete mesh installed by use_sharding/jax.set_mesh, and
+        # shard_map binds the concrete one itself when no mesh is passed.
+        ambient = jax.sharding.get_abstract_mesh()
+        if ambient is None or ambient.empty:
+            raise ValueError("ring_attention: no mesh given and no ambient "
+                             "mesh installed (use use_sharding(mesh, ...))")
+        if axis_name not in ambient.shape:
+            raise ValueError(f"ambient mesh {dict(ambient.shape)} has no "
+                             f"{axis_name!r} axis")
+    elif axis_name not in mesh.shape:
+        raise ValueError(f"mesh {dict(mesh.shape)} has no {axis_name!r} axis")
+    if impl == "auto":
+        # Same shape gate as dot_product_attention's auto path: the Pallas
+        # kernel is validated for head_dim 64/128/256 and per-chip chunks
+        # worth blocking; everything else takes the einsum path.
+        shape = dict((mesh or jax.sharding.get_abstract_mesh()).shape)
+        local_seq = q.shape[1] // shape[axis_name]
+        flash_ok = (not is_causal and jax.default_backend() == "tpu"
+                    and q.shape[-1] in (64, 128, 256) and local_seq >= 128)
+        impl = "flash" if flash_ok else "einsum"
     if impl == "flash":
         if is_causal:
             raise ValueError("impl='flash' ring attention is non-causal only; "
@@ -127,10 +165,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, mesh: Mesh,
         local = partial(_ring_local, axis_name=axis_name, causal=is_causal)
     else:
         raise ValueError(f"unknown ring attention impl {impl!r}")
+    kwargs = {} if mesh is None else {"mesh": mesh}  # None -> ambient mesh
     fn = shard_map(
         local,
-        mesh=mesh,
         in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
         out_specs=P(None, axis_name),
-        check_vma=False)
+        check_vma=False, **kwargs)
     return fn(q, k, v)

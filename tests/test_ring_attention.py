@@ -84,3 +84,29 @@ def test_flash_ring_rejects_causal(rng, mesh):
     q = jnp.zeros((1, 16, 2, 8))
     with pytest.raises(ValueError, match="non-causal"):
         ring_attention(q, q, q, mesh=mesh, is_causal=True, impl="flash")
+
+
+def test_transformer_ring_impl_matches_xla(rng, mesh):
+    """attn_impl='ring' inside a full encoder stack under a seq-sharded mesh
+    equals the single-device xla path."""
+    import jax.numpy as jnp
+    from flax import nnx
+    from jimm_tpu.configs import TransformerConfig
+    from jimm_tpu.nn.transformer import Transformer
+    from jimm_tpu.parallel import (SEQUENCE_PARALLEL, make_mesh, shard_batch,
+                                   use_sharding)
+
+    sp_mesh = make_mesh({"data": 1, "seq": 8})
+    x = rng.randn(2, 64, 32).astype(np.float32)
+
+    base = dict(width=32, depth=2, num_heads=2, mlp_dim=64)
+    plain = Transformer(TransformerConfig(**base, attn_impl="xla"),
+                        nnx.Rngs(0))
+    ref = np.asarray(plain(jnp.asarray(x)))
+
+    ringed = Transformer(TransformerConfig(**base, attn_impl="ring"),
+                         nnx.Rngs(0))
+    with use_sharding(sp_mesh, SEQUENCE_PARALLEL):
+        xs = shard_batch(x, sp_mesh, SEQUENCE_PARALLEL)
+        out = np.asarray(ringed(xs))
+    np.testing.assert_allclose(out, ref, atol=2e-5)
