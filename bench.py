@@ -16,9 +16,16 @@ jimm_tpu.utils.env.configure_platform()
 
 import argparse
 import json
+import pathlib
 import time
 
 import jax
+
+# persistent compile cache: repeated bench runs skip the ~minutes-long
+# SigLIP-train-step compile
+jax.config.update("jax_compilation_cache_dir",
+                  str(pathlib.Path(__file__).resolve().parent / ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import jax.numpy as jnp
 import numpy as np
 from flax import nnx

@@ -117,6 +117,13 @@ def cmd_train(args: argparse.Namespace) -> int:
             cfg = dataclasses.replace(
                 cfg, text=dataclasses.replace(cfg.text,
                                               attn_impl=args.attn_impl))
+    if args.pipeline_microbatches:
+        pp = dict(pipeline=True, pp_microbatches=args.pipeline_microbatches)
+        cfg = dataclasses.replace(
+            cfg, vision=dataclasses.replace(cfg.vision, **pp))
+        if hasattr(cfg, "text"):
+            cfg = dataclasses.replace(
+                cfg, text=dataclasses.replace(cfg.text, **pp))
     if fam == "vit":
         cfg = dataclasses.replace(cfg, num_classes=4)  # synthetic data classes
 
@@ -310,7 +317,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help='e.g. "data=4,model=2" (default: no mesh)')
     sp.add_argument("--rules", default=None,
                     choices=[None, "replicated", "dp", "tp", "fsdp",
-                             "fsdp_tp", "sp"],
+                             "fsdp_tp", "sp", "pp"],
                     help="sharding rules preset (requires --mesh)")
     sp.add_argument("--loss", default=None,
                     choices=[None, "clip", "siglip", "siglip_ring"])
@@ -318,6 +325,9 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=[None, "auto", "xla", "flash", "ring"],
                     help="attention kernel for both towers "
                          "(ring = sequence-parallel, needs a seq mesh axis)")
+    sp.add_argument("--pipeline-microbatches", type=int, default=0,
+                    help="enable pipeline parallelism with N microbatches "
+                         "(needs a 'stage' mesh axis and --rules pp)")
     sp.add_argument("--ckpt-dir", default=None)
     sp.add_argument("--resume", action="store_true")
     sp.add_argument("--save-every", type=int, default=50)

@@ -48,6 +48,12 @@ class TransformerConfig:
     dropout: float = 0.0
     causal: bool = False
     attn_impl: AttnImpl = "auto"
+    #: Pipeline-parallel forward: shard the stacked ``layers`` axis over a
+    #: ``stage`` mesh axis and circulate microbatches via ppermute
+    #: (`jimm_tpu/parallel/pipeline.py`). Requires depth % n_stages == 0 and
+    #: (local) batch % pp_microbatches == 0.
+    pipeline: bool = False
+    pp_microbatches: int = 4
     remat: bool = False
     #: What the backward pass may keep from the forward when ``remat`` is on:
     #: "none" recomputes everything (min memory, ~1/3 extra FLOPs); "dots"
@@ -85,6 +91,8 @@ class VisionConfig:
     pre_norm: bool = False
     patch_bias: bool = True
     attn_impl: AttnImpl = "auto"
+    pipeline: bool = False
+    pp_microbatches: int = 4
     remat: bool = False
     remat_policy: Literal["none", "dots"] = "none"
 
@@ -105,6 +113,7 @@ class VisionConfig:
             width=self.width, depth=self.depth, num_heads=self.num_heads,
             mlp_dim=self.mlp_dim, act=self.act, ln_eps=self.ln_eps,
             dropout=self.dropout, causal=False, attn_impl=self.attn_impl,
+            pipeline=self.pipeline, pp_microbatches=self.pp_microbatches,
             remat=self.remat, remat_policy=self.remat_policy,
         )
 
@@ -131,6 +140,8 @@ class TextConfig:
     # first occurrence (argmax-equivalent when EOT is the max id)
     eos_token_id: int | None = None
     attn_impl: AttnImpl = "auto"
+    pipeline: bool = False
+    pp_microbatches: int = 4
     remat: bool = False
     remat_policy: Literal["none", "dots"] = "none"
 
@@ -139,6 +150,7 @@ class TextConfig:
             width=self.width, depth=self.depth, num_heads=self.num_heads,
             mlp_dim=self.mlp_dim, act=self.act, ln_eps=self.ln_eps,
             dropout=self.dropout, causal=self.causal, attn_impl=self.attn_impl,
+            pipeline=self.pipeline, pp_microbatches=self.pp_microbatches,
             remat=self.remat, remat_policy=self.remat_policy,
         )
 

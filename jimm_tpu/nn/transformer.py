@@ -137,23 +137,67 @@ class Transformer(nnx.Module):
 
         self.blocks = create_block(rngs)
 
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def _remat_policy(self):
+        # "dots" keeps matmul outputs and recomputes only elementwise ops
+        # in the backward — far cheaper than full remat at slightly more
+        # memory; "none" is classic full rematerialization.
+        if self.cfg.remat_policy == "dots":
+            return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        if self.cfg.remat_policy == "none":
+            return None
+        raise ValueError(f"unknown remat_policy {self.cfg.remat_policy!r}; "
+                         "expected 'none' or 'dots'")
+
+    def _apply_stack(self, blocks: Block, x: jax.Array) -> jax.Array:
+        """Scan ``x`` through a stacked block module (all layers or one
+        pipeline stage's local slice)."""
         def body(block: Block, x: jax.Array) -> jax.Array:
             return block(x)
 
         if self.cfg.remat:
-            # "dots" keeps matmul outputs and recomputes only elementwise ops
-            # in the backward — far cheaper than full remat at slightly more
-            # memory; "none" is classic full rematerialization.
-            if self.cfg.remat_policy == "dots":
-                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            elif self.cfg.remat_policy == "none":
-                policy = None
-            else:
-                raise ValueError(
-                    f"unknown remat_policy {self.cfg.remat_policy!r}; "
-                    "expected 'none' or 'dots'")
-            body = nnx.remat(body, policy=policy)
+            body = nnx.remat(body, policy=self._remat_policy())
         scan = nnx.scan(body, in_axes=(0, nnx.Carry), out_axes=nnx.Carry,
                         transform_metadata={nnx.PARTITION_NAME: "layers"})
-        return scan(self.blocks, x)
+        return scan(blocks, x)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if not self.cfg.pipeline:
+            return self._apply_stack(self.blocks, x)
+
+        from jimm_tpu.parallel.pipeline import pipeline_forward
+        from jimm_tpu.parallel.sharding import current_rules
+
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or "stage" not in mesh.shape:
+            raise ValueError("pipeline=True needs an ambient mesh with a "
+                             "'stage' axis (use use_sharding(mesh, PIPELINE))")
+        n_stage = dict(mesh.shape)["stage"]
+        if self.cfg.depth % n_stage:
+            raise ValueError(f"depth {self.cfg.depth} not divisible by "
+                             f"{n_stage} pipeline stages")
+        if self.cfg.dropout > 0.0:
+            # the pipelined stage loop merges layers inside a plain lax.scan
+            # and discards rng-state mutations — dropout masks would repeat
+            raise NotImplementedError("pipeline=True does not support "
+                                      "dropout > 0 yet")
+        rules = current_rules()
+        batch_axis = rules.batch if rules is not None else None
+        if isinstance(batch_axis, str) and batch_axis not in mesh.shape:
+            batch_axis = None
+        graphdef, state = nnx.split(self.blocks)
+
+        def stage_apply(state_local, xm):
+            # plain lax.scan + per-layer merge (nnx.scan can't consume
+            # modules whose arrays were introduced at the enclosing
+            # shard_map trace level)
+            def body(h, layer_state):
+                return nnx.merge(graphdef, layer_state)(h), None
+
+            if self.cfg.remat:
+                body = jax.checkpoint(body, policy=self._remat_policy())
+            out, _ = jax.lax.scan(body, xm, state_local)
+            return out
+
+        return pipeline_forward(stage_apply, state, x,
+                                n_microbatches=self.cfg.pp_microbatches,
+                                batch_axis=batch_axis)

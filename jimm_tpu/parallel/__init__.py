@@ -1,6 +1,8 @@
 from jimm_tpu.parallel.mesh import make_hybrid_mesh, make_mesh
+from jimm_tpu.parallel.pipeline import pipeline_forward
+from jimm_tpu.parallel.ring_attention import ring_attention
 from jimm_tpu.parallel.sharding import (DATA_PARALLEL, FSDP, FSDP_TP,
-                                        PRESET_RULES, REPLICATED,
+                                        PIPELINE, PRESET_RULES, REPLICATED,
                                         SEQUENCE_PARALLEL, TENSOR_PARALLEL,
                                         ShardingRules, create_sharded,
                                         logical, logical_constraint,
@@ -9,6 +11,7 @@ from jimm_tpu.parallel.sharding import (DATA_PARALLEL, FSDP, FSDP_TP,
 __all__ = [
     "make_mesh", "make_hybrid_mesh", "ShardingRules", "use_sharding",
     "create_sharded", "shard_model", "shard_batch", "logical",
-    "logical_constraint", "REPLICATED", "DATA_PARALLEL", "TENSOR_PARALLEL",
-    "FSDP", "FSDP_TP", "SEQUENCE_PARALLEL", "PRESET_RULES",
+    "logical_constraint", "pipeline_forward", "ring_attention",
+    "REPLICATED", "DATA_PARALLEL", "TENSOR_PARALLEL",
+    "FSDP", "FSDP_TP", "SEQUENCE_PARALLEL", "PIPELINE", "PRESET_RULES",
 ]

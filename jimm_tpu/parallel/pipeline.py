@@ -1,0 +1,83 @@
+"""Pipeline parallelism: depth-sharded layer stacks with a microbatched
+collective-permute loop.
+
+Absent from the reference (its stack is a python ``nnx.Sequential``,
+ref `common/transformer.py:171-188` — SURVEY §2.3 marks PP absent). Here the
+encoder's parameters are already *stacked* with a leading ``layers`` axis, so
+pipelining is just another sharding of that axis: each device on the
+``stage`` mesh axis holds a contiguous block of layers, and microbatches
+circulate stage→stage over ICI via ``jax.lax.ppermute`` (the SPMD
+"pipelining via collective permute" pattern — no per-stage programs, one
+SPMD program).
+
+Schedule: GPipe-style fill-and-drain over ``M`` microbatches and ``S``
+stages: ``T = M + S - 1`` ticks; at tick ``t`` a device computes microbatch
+``t - stage`` (garbage outside the window — masked out at collection).
+Bubble fraction is ``(S-1)/T``; raise M to amortize. Differentiable
+end-to-end (`lax.scan` of `ppermute`), composes with remat inside each
+stage.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from jax import shard_map
+
+
+def pipeline_forward(stage_apply: Callable, stage_params, x: jax.Array, *,
+                     n_microbatches: int, axis_name: str = "stage",
+                     mesh: Mesh | None = None,
+                     batch_axis: str | None = None) -> jax.Array:
+    """Run ``x`` through a depth-stacked stack pipelined over ``axis_name``.
+
+    - ``stage_params``: pytree whose every leaf has a leading global
+      ``layers`` dim, sharded over ``axis_name`` (each device gets
+      ``layers / n_stages`` consecutive layers).
+    - ``stage_apply(local_params, xm)``: applies one device's local layers to
+      a microbatch (typically an ``nnx.merge`` + scan over the local stack).
+    - ``x``: ``(B, ...)`` activations; ``B`` must divide by
+      ``n_microbatches`` (times the ``batch_axis`` size if given).
+    - ``batch_axis``: optional mesh axis the batch dim is sharded over
+      (pipeline x data parallelism).
+    """
+    M = n_microbatches
+    x_spec = P(batch_axis) if batch_axis else P()
+
+    def local(params_local, x_local):
+        stage = jax.lax.axis_index(axis_name)
+        n_stage = jax.lax.axis_size(axis_name)
+        b = x_local.shape[0]
+        if b % M:
+            raise ValueError(f"local batch {b} not divisible by "
+                             f"{M} microbatches")
+        micro = x_local.reshape(M, b // M, *x_local.shape[1:])
+
+        def step(carry, t):
+            # stage 0 feeds fresh microbatches; later stages eat the ring
+            inp = jnp.where(stage == 0,
+                            micro[jnp.clip(t, 0, M - 1)], carry)
+            out = stage_apply(params_local, inp)
+            perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+            return jax.lax.ppermute(out, axis_name, perm), out
+
+        t_total = M + n_stage - 1
+        _, outs = jax.lax.scan(step, jnp.zeros_like(micro[0]),
+                               jnp.arange(t_total))
+        # the last stage emits microbatch m at tick m + n_stage - 1
+        window = outs[n_stage - 1:]  # (M, b/M, ...) static slice
+        window = jnp.where(stage == n_stage - 1, window,
+                           jnp.zeros_like(window))
+        result = jax.lax.psum(window, axis_name)
+        return result.reshape(b, *x_local.shape[1:])
+
+    kwargs = {} if mesh is None else {"mesh": mesh}
+    fn = shard_map(local,
+                   in_specs=(P(axis_name), x_spec),
+                   out_specs=x_spec,
+                   check_vma=False, **kwargs)
+    return fn(stage_params, x)

@@ -101,6 +101,12 @@ FSDP_TP = ShardingRules(
 #: activations sharded over the sequence axis.
 SEQUENCE_PARALLEL = ShardingRules(batch="data", seq="seq", pos="seq")
 
+#: Pipeline parallelism: the stacked ``layers`` axis sharded over "stage";
+#: forward runs the microbatched ppermute loop
+#: (`jimm_tpu/parallel/pipeline.py`, enabled by ``pipeline=True`` in the
+#: encoder config). Composes with data parallelism over "data".
+PIPELINE = ShardingRules(layers="stage", batch="data")
+
 PRESET_RULES: dict[str, ShardingRules] = {
     "replicated": REPLICATED,
     "dp": DATA_PARALLEL,
@@ -108,6 +114,7 @@ PRESET_RULES: dict[str, ShardingRules] = {
     "fsdp": FSDP,
     "fsdp_tp": FSDP_TP,
     "sp": SEQUENCE_PARALLEL,
+    "pp": PIPELINE,
 }
 
 
@@ -151,10 +158,13 @@ def logical(init: Callable, *names: str | None) -> Callable:
 
 
 def logical_constraint(x: jax.Array, *names: str | None) -> jax.Array:
-    """Constrain an activation to the ambient rules; no-op without context."""
+    """Constrain an activation to the ambient rules; no-op without context
+    (and inside ``shard_map``, where axes are Manual and arrays are local)."""
     rules = current_rules()
     mesh = jax.sharding.get_abstract_mesh()
     if rules is None or mesh is None or mesh.empty or not mesh.shape_tuple:
+        return x
+    if any(t == jax.sharding.AxisType.Manual for t in mesh.axis_types):
         return x
     spec = rules.spec(*names)
     if all(s is None for s in spec):

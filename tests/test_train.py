@@ -201,3 +201,39 @@ def test_checkpoint_save_restore_resume(tmp_path, rng):
                                float(expected["loss"]), rtol=1e-6)
     mgr.close()
     mgr2.close()
+
+
+def test_checkpoint_restores_across_topologies(tmp_path, rng, eight_devices):
+    """Elastic-recovery story (SURVEY §5 failure row): a checkpoint written
+    under one mesh/sharding restores onto a different topology — each param
+    lands in the new model's current sharding."""
+    from jimm_tpu.parallel import TENSOR_PARALLEL
+
+    def build(mesh, rules, seed=0):
+        cfg = ViTConfig(vision=VisionConfig(image_size=16, patch_size=8,
+                                            width=32, depth=2, num_heads=2,
+                                            mlp_dim=64, ln_eps=1e-12),
+                        num_classes=4)
+        return VisionTransformer(cfg, rngs=nnx.Rngs(seed), mesh=mesh,
+                                 rules=rules)
+
+    fsdp_mesh = make_mesh({"data": 8})
+    model = build(fsdp_mesh, FSDP)
+    images = jnp.asarray(rng.randn(4, 16, 16, 3).astype(np.float32))
+    ref = np.asarray(model(images))
+
+    mgr = CheckpointManager(tmp_path / "x")
+    assert mgr.save(0, model, force=True)
+    mgr.wait()
+    mgr.close()
+
+    # restore onto a (data=4, model=2) TP mesh
+    tp_mesh = make_mesh({"data": 4, "model": 2})
+    model2 = build(tp_mesh, TENSOR_PARALLEL, seed=99)
+    mgr2 = CheckpointManager(tmp_path / "x")
+    assert mgr2.restore(model2) == 0
+    mgr2.close()
+    np.testing.assert_allclose(np.asarray(model2(images)), ref, atol=1e-5)
+    # params really live on the TP mesh sharding
+    kernel = model2.vision.encoder.blocks.mlp.fc1.kernel
+    assert kernel.get_value().sharding.mesh.shape == dict(tp_mesh.shape)
