@@ -118,6 +118,11 @@ def cmd_train(args: argparse.Namespace) -> int:
                 cfg, text=dataclasses.replace(cfg.text,
                                               attn_impl=args.attn_impl))
     if args.pipeline_microbatches:
+        if args.pipeline_microbatches < 1:
+            raise SystemExit("--pipeline-microbatches must be >= 1")
+        if args.rules != "pp":
+            raise SystemExit("--pipeline-microbatches needs --rules pp "
+                             "(layers sharded over the 'stage' mesh axis)")
         pp = dict(pipeline=True, pp_microbatches=args.pipeline_microbatches)
         cfg = dataclasses.replace(
             cfg, vision=dataclasses.replace(cfg.vision, **pp))
@@ -167,6 +172,7 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     logger = MetricsLogger(path=args.metrics_file, print_every=args.log_every)
     timer = StepTimer()
+    profiler_ctx = None
 
     def place(batch):
         if mesh is None:
@@ -176,16 +182,34 @@ def cmd_train(args: argparse.Namespace) -> int:
     data = PrefetchIterator(data, mesh=mesh, rules=rules) \
         if mesh is not None else map(place, data)
 
-    with use_sharding(mesh, rules):
-        for step in range(start_step, args.steps):
-            batch = next(data)
-            timer.start()
-            metrics = step_fn(model, optimizer, *batch)
-            dt = timer.stop(metrics["loss"])
-            logger.log(step, step_time_s=dt,
-                       **{k: float(v) for k, v in metrics.items()})
-            if ckpt is not None:
-                ckpt.save(step, model, optimizer)
+    # profile steps start+2..start+4 (past compile), falling back to the
+    # whole run when it is shorter than that
+    profile_start = min(start_step + 2, max(args.steps - 1, start_step))
+    profile_stop = min(start_step + 4, args.steps - 1)
+    try:
+        with use_sharding(mesh, rules):
+            for step in range(start_step, args.steps):
+                if args.profile_dir and step == profile_start:
+                    from jimm_tpu.train.profile import trace
+                    profiler_ctx = trace(args.profile_dir)
+                    profiler_ctx.__enter__()
+                batch = next(data)
+                timer.start()
+                metrics = step_fn(model, optimizer, *batch)
+                dt = timer.stop(metrics["loss"])
+                if profiler_ctx is not None and step == profile_stop:
+                    profiler_ctx.__exit__(None, None, None)
+                    profiler_ctx = None
+                    print(f"profile trace written to {args.profile_dir}")
+                logger.log(step, step_time_s=dt,
+                           **{k: float(v) for k, v in metrics.items()})
+                if ckpt is not None:
+                    ckpt.save(step, model, optimizer)
+    finally:
+        if profiler_ctx is not None:
+            # crash mid-profile: still flush what was captured
+            profiler_ctx.__exit__(None, None, None)
+            print(f"profile trace written to {args.profile_dir}")
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()
@@ -334,6 +358,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--log-every", type=int, default=10)
     sp.add_argument("--metrics-file", default=None,
                     help="JSONL metrics output path")
+    sp.add_argument("--profile-dir", default=None,
+                    help="capture a jax.profiler trace of steps 2-4 here")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_train)
 

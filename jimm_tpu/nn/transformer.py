@@ -175,11 +175,11 @@ class Transformer(nnx.Module):
         if self.cfg.depth % n_stage:
             raise ValueError(f"depth {self.cfg.depth} not divisible by "
                              f"{n_stage} pipeline stages")
-        if self.cfg.dropout > 0.0:
+        if self.cfg.dropout > 0.0 and not self.blocks.dropout.deterministic:
             # the pipelined stage loop merges layers inside a plain lax.scan
             # and discards rng-state mutations — dropout masks would repeat
-            raise NotImplementedError("pipeline=True does not support "
-                                      "dropout > 0 yet")
+            raise NotImplementedError("pipeline=True does not support active "
+                                      "dropout yet (eval mode is fine)")
         rules = current_rules()
         batch_axis = rules.batch if rules is not None else None
         if isinstance(batch_axis, str) and batch_axis not in mesh.shape:

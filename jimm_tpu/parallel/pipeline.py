@@ -46,6 +46,8 @@ def pipeline_forward(stage_apply: Callable, stage_params, x: jax.Array, *,
       (pipeline x data parallelism).
     """
     M = n_microbatches
+    if M < 1:
+        raise ValueError(f"n_microbatches must be >= 1, got {M}")
     x_spec = P(batch_axis) if batch_axis else P()
 
     def local(params_local, x_local):

@@ -65,3 +65,11 @@ def test_bench_forward_tiny(capsys):
     assert main(["bench-forward", "--preset", "siglip-base-patch16-256",
                  "--tiny", "--batch-size", "4", "--steps", "2"]) == 0
     assert "images/sec" in capsys.readouterr().out
+
+
+def test_train_profile_capture(tmp_path, capsys):
+    assert main(["train", "--preset", "vit-base-patch16-224", "--tiny",
+                 "--steps", "6", "--batch-size", "8", "--log-every", "0",
+                 "--profile-dir", str(tmp_path / "prof")]) == 0
+    assert "profile trace written" in capsys.readouterr().out
+    assert (tmp_path / "prof" / "plugins" / "profile").is_dir()
