@@ -25,12 +25,8 @@ from typing import Any
 
 def _configure_backend(args: argparse.Namespace) -> None:
     import jimm_tpu.utils.env as env
-    import os
-    if getattr(args, "platform", None):
-        os.environ["JIMM_PLATFORM"] = args.platform
-    if getattr(args, "host_devices", None):
-        os.environ["JIMM_HOST_DEVICES"] = str(args.host_devices)
-    env.configure_platform()
+    env.configure_platform(platform=getattr(args, "platform", None),
+                           host_devices=getattr(args, "host_devices", None))
 
 
 def _parse_mesh(spec: str | None):
@@ -50,6 +46,22 @@ def _family(preset_name: str) -> str:
         if preset_name.startswith(fam):
             return fam
     raise SystemExit(f"cannot infer model family from preset {preset_name!r}")
+
+
+def _model_cls(fam: str):
+    from jimm_tpu import CLIP, SigLIP, VisionTransformer
+    return {"vit": VisionTransformer, "clip": CLIP, "siglip": SigLIP}[fam]
+
+
+def _replace_towers(cfg: Any, **fields: Any) -> Any:
+    """dataclasses.replace the same fields in the vision (and, if present,
+    text) tower config."""
+    cfg = dataclasses.replace(
+        cfg, vision=dataclasses.replace(cfg.vision, **fields))
+    if hasattr(cfg, "text"):
+        cfg = dataclasses.replace(
+            cfg, text=dataclasses.replace(cfg.text, **fields))
+    return cfg
 
 
 def _tiny_override(cfg: Any) -> Any:
@@ -96,7 +108,7 @@ def cmd_train(args: argparse.Namespace) -> int:
     import numpy as np
     from flax import nnx
 
-    from jimm_tpu import CLIP, SigLIP, VisionTransformer, preset
+    from jimm_tpu import preset
     from jimm_tpu.data import (PrefetchIterator, blob_classification,
                                contrastive_pairs)
     from jimm_tpu.parallel import PRESET_RULES, shard_batch, use_sharding
@@ -110,25 +122,15 @@ def cmd_train(args: argparse.Namespace) -> int:
     if args.tiny:
         cfg = _tiny_override(cfg)
     if args.attn_impl:
-        cfg = dataclasses.replace(
-            cfg, vision=dataclasses.replace(cfg.vision,
-                                            attn_impl=args.attn_impl))
-        if hasattr(cfg, "text"):
-            cfg = dataclasses.replace(
-                cfg, text=dataclasses.replace(cfg.text,
-                                              attn_impl=args.attn_impl))
+        cfg = _replace_towers(cfg, attn_impl=args.attn_impl)
     if args.pipeline_microbatches:
         if args.pipeline_microbatches < 1:
             raise SystemExit("--pipeline-microbatches must be >= 1")
         if args.rules != "pp":
             raise SystemExit("--pipeline-microbatches needs --rules pp "
                              "(layers sharded over the 'stage' mesh axis)")
-        pp = dict(pipeline=True, pp_microbatches=args.pipeline_microbatches)
-        cfg = dataclasses.replace(
-            cfg, vision=dataclasses.replace(cfg.vision, **pp))
-        if hasattr(cfg, "text"):
-            cfg = dataclasses.replace(
-                cfg, text=dataclasses.replace(cfg.text, **pp))
+        cfg = _replace_towers(cfg, pipeline=True,
+                              pp_microbatches=args.pipeline_microbatches)
     if fam == "vit":
         cfg = dataclasses.replace(cfg, num_classes=4)  # synthetic data classes
 
@@ -137,9 +139,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         PRESET_RULES["dp"] if mesh is not None else None)
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
 
-    model_cls = {"vit": VisionTransformer, "clip": CLIP, "siglip": SigLIP}[fam]
-    model = model_cls(cfg, rngs=nnx.Rngs(args.seed), mesh=mesh, rules=rules,
-                      dtype=dtype, param_dtype=dtype)
+    model = _model_cls(fam)(cfg, rngs=nnx.Rngs(args.seed), mesh=mesh,
+                            rules=rules, dtype=dtype, param_dtype=dtype)
     optimizer = make_optimizer(model, OptimizerConfig(
         learning_rate=args.lr, weight_decay=args.weight_decay,
         warmup_steps=args.warmup_steps, total_steps=args.steps))
@@ -221,13 +222,10 @@ def cmd_export(args: argparse.Namespace) -> int:
     _configure_backend(args)
     import jax.numpy as jnp
 
-    from jimm_tpu import CLIP, SigLIP, VisionTransformer
     from jimm_tpu.weights.export import save_pretrained
 
-    model_cls = {"vit": VisionTransformer, "clip": CLIP,
-                 "siglip": SigLIP}[args.model]
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
-    model = model_cls.from_pretrained(args.src, dtype=dtype)
+    model = _model_cls(args.model).from_pretrained(args.src, dtype=dtype)
     save_pretrained(model, args.out)
     print(f"exported {args.src} -> {args.out}")
     return 0
@@ -273,7 +271,7 @@ def cmd_bench_forward(args: argparse.Namespace) -> int:
     import numpy as np
     from flax import nnx
 
-    from jimm_tpu import CLIP, SigLIP, VisionTransformer, preset
+    from jimm_tpu import preset
     from jimm_tpu.utils import jit_forward
 
     fam = _family(args.preset)
@@ -281,8 +279,7 @@ def cmd_bench_forward(args: argparse.Namespace) -> int:
     if args.tiny:
         cfg = _tiny_override(cfg)
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
-    model_cls = {"vit": VisionTransformer, "clip": CLIP, "siglip": SigLIP}[fam]
-    model = model_cls(cfg, rngs=nnx.Rngs(0), dtype=dtype, param_dtype=dtype)
+    model = _model_cls(fam)(cfg, rngs=nnx.Rngs(0), dtype=dtype, param_dtype=dtype)
     fwd = jit_forward(model)
 
     rng = np.random.RandomState(0)

@@ -12,9 +12,12 @@ from __future__ import annotations
 import os
 
 
-def configure_platform() -> None:
-    plat = os.environ.get("JIMM_PLATFORM")
-    n = os.environ.get("JIMM_HOST_DEVICES")
+def configure_platform(platform: str | None = None,
+                       host_devices: int | None = None) -> None:
+    """Apply backend overrides from arguments, falling back to the
+    ``JIMM_PLATFORM`` / ``JIMM_HOST_DEVICES`` env vars."""
+    plat = platform or os.environ.get("JIMM_PLATFORM")
+    n = host_devices or os.environ.get("JIMM_HOST_DEVICES")
     if not plat and not n:
         return
     import jax
