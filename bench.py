@@ -7,37 +7,148 @@ Prints ONE JSON line:
 ``vs_baseline`` is measured MFU / 0.50 — the north-star target from
 `BASELINE.json` (the reference publishes no throughput numbers at all; 1.0
 means the 50%-MFU bar is met on this chip count).
+
+Outage-proofing: the TPU tunnel in this environment fails by HANGING (not
+erroring) — round 1 lost its perf datapoint to exactly that. So the actual
+benchmark runs in a child process killed after --timeout seconds; on
+failure/timeout the parent retries once, then still prints a parseable JSON
+line (with an "error" field) and exits 0. The child additionally arms a
+SIGALRM around backend init + a probe matmul to fail fast when the tunnel is
+down, rather than burning the whole timeout.
 """
 
 from __future__ import annotations
 
-import jimm_tpu.utils.env
-jimm_tpu.utils.env.configure_platform()
-
 import argparse
 import json
-import pathlib
+import os
+import signal
+import subprocess
+import sys
 import time
 
-import jax
 
-# persistent compile cache: repeated bench runs skip the ~minutes-long
-# SigLIP-train-step compile
-jax.config.update("jax_compilation_cache_dir",
-                  str(pathlib.Path(__file__).resolve().parent / ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-import jax.numpy as jnp
-import numpy as np
-from flax import nnx
-
-
-def main() -> None:
+def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser()
     p.add_argument("--batch-size", type=int, default=0,
                    help="0 = auto (TPU: 128, CPU: 8)")
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--warmup", type=int, default=3)
-    args = p.parse_args()
+    p.add_argument("--remat", choices=["none", "full", "dots"], default="dots",
+                   help="activation rematerialization inside the layer scan")
+    p.add_argument("--no-donate", action="store_true",
+                   help="disable model/optimizer buffer donation")
+    p.add_argument("--timeout", type=int,
+                   default=int(os.environ.get("BENCH_TIMEOUT_S", "1500")),
+                   help="watchdog: kill the child after this many seconds")
+    p.add_argument("--probe-timeout", type=int, default=150,
+                   help="child: SIGALRM around backend init + probe matmul")
+    p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    return p.parse_args(argv)
+
+
+# ---------------------------------------------------------------------------
+# Parent: watchdog + retry + guaranteed JSON
+# ---------------------------------------------------------------------------
+
+def emit_error(msg: str, detail: str = "") -> None:
+    print(json.dumps({
+        "metric": "siglip_b16_256_train_images_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "error": msg,
+        "detail": detail[-2000:],
+    }))
+
+
+def run_child(argv: list[str], timeout: int) -> tuple[int | None, str, str]:
+    """Returns (returncode | None on timeout, stdout, stderr)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"] + argv
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+        return proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or b""
+        err = e.stderr or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        return None, out, err
+
+
+def find_json_line(out: str) -> str | None:
+    for line in reversed(out.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        # only the benchmark result schema counts — a stray JSON-formatted
+        # log line or bare scalar must not masquerade as the datapoint
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return line
+    return None
+
+
+def parent_main(args: argparse.Namespace) -> int:
+    argv = sys.argv[1:]
+    last_detail = ""
+    for attempt in range(2):
+        rc, out, err = run_child(argv, args.timeout)
+        # scan stdout on EVERY outcome: a child that measured a result and
+        # then hung in backend teardown still produced the datapoint
+        line = find_json_line(out)
+        if line is not None:
+            print(line)
+            return 0
+        if rc == 0:
+            last_detail = f"child exited 0 without a JSON line; stdout={out!r}"
+        elif rc is None:
+            last_detail = (f"child hit the {args.timeout}s watchdog "
+                           f"(TPU tunnel hang?); stderr tail: {err[-500:]}")
+        else:
+            last_detail = f"child exited {rc}; stderr tail: {err[-1500:]}"
+        if attempt == 0:
+            time.sleep(5)
+    emit_error("benchmark did not complete (backend unreachable or hung); "
+               "see detail", last_detail)
+    return 0  # rc 0 semantics: the driver must always record the JSON line
+
+
+# ---------------------------------------------------------------------------
+# Child: the actual benchmark
+# ---------------------------------------------------------------------------
+
+def child_main(args: argparse.Namespace) -> int:
+    import jimm_tpu.utils.env
+    jimm_tpu.utils.env.configure_platform()
+
+    import pathlib
+
+    # fail fast when the tunnel hangs: SIGALRM can interrupt the blocked
+    # backend-init / first-execute syscall where a python-level timeout can't
+    def on_alarm(signum, frame):
+        print(f"probe watchdog: backend unresponsive after "
+              f"{args.probe_timeout}s", file=sys.stderr)
+        os._exit(17)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(args.probe_timeout)
+
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      str(pathlib.Path(__file__).resolve().parent
+                          / ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    probe = (jnp.ones((1024, 1024)) @ jnp.ones((1024, 1024)))
+    float(probe[0, 0])  # forces backend init + one real execute round-trip
+    signal.alarm(0)
 
     from jimm_tpu import SigLIP, preset
     from jimm_tpu.configs import SigLIPConfig, TextConfig, VisionConfig
@@ -51,12 +162,18 @@ def main() -> None:
     if on_tpu:
         cfg = preset("siglip-base-patch16-256")
         # remat: without it the scan saves every layer's activations and a
-        # 256-batch training step overflows one chip's 16G HBM
+        # big-batch training step overflows one chip's 16G HBM. Policy
+        # "dots" keeps matmul outputs and recomputes only elementwise ops —
+        # far cheaper than full recompute (VERDICT r1 weak #1).
+        remat = args.remat != "none"
+        policy = "dots" if args.remat == "dots" else "none"
         cfg = dataclasses.replace(
             cfg,
-            vision=dataclasses.replace(cfg.vision, remat=True,
+            vision=dataclasses.replace(cfg.vision, remat=remat,
+                                       remat_policy=policy,
                                        attn_impl="flash"),
-            text=dataclasses.replace(cfg.text, remat=True))
+            text=dataclasses.replace(cfg.text, remat=remat,
+                                     remat_policy=policy))
     else:  # smoke-test shape so the script runs anywhere
         cfg = SigLIPConfig(
             vision=VisionConfig(image_size=32, patch_size=16, width=64,
@@ -72,7 +189,7 @@ def main() -> None:
     optimizer = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
 
     from jimm_tpu.train import make_contrastive_train_step
-    step_fn = make_contrastive_train_step("siglip")
+    step_fn = make_contrastive_train_step("siglip", donate=not args.no_donate)
 
     rng = np.random.RandomState(0)
     images = jnp.asarray(rng.randn(batch, cfg.vision.image_size,
@@ -118,13 +235,25 @@ def main() -> None:
         "step_time_ms": round(dt * 1e3, 2),
         "batch_size": batch,
         "steps_timed": args.steps,
+        "remat": args.remat,
+        "donate": not args.no_donate,
         "device": jax.devices()[0].device_kind,
     }
     if achieved_mfu > 0.95:
         result["warning"] = ("implied MFU exceeds physical plausibility — "
                              "timing artifact, rerun with more --steps")
-    print(json.dumps(result))
+    # flush: the parent reads this through a pipe, and a post-print teardown
+    # hang must not strand the datapoint in the stdio buffer
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+def main() -> int:
+    args = parse_args()
+    if args.child:
+        return child_main(args)
+    return parent_main(args)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -131,8 +131,16 @@ def cmd_train(args: argparse.Namespace) -> int:
                              "(layers sharded over the 'stage' mesh axis)")
         cfg = _replace_towers(cfg, pipeline=True,
                               pp_microbatches=args.pipeline_microbatches)
+    elif args.rules == "pp":
+        # --rules pp without the flag: default to the config's microbatch
+        # count rather than silently running the unpipelined scan with
+        # stage-sharded params (correct but all-gathers every layer)
+        cfg = _replace_towers(cfg, pipeline=True)
     if fam == "vit":
-        cfg = dataclasses.replace(cfg, num_classes=4)  # synthetic data classes
+        if args.num_classes:
+            cfg = dataclasses.replace(cfg, num_classes=args.num_classes)
+        elif not args.data:
+            cfg = dataclasses.replace(cfg, num_classes=4)  # synthetic classes
 
     mesh = _parse_mesh(args.mesh)
     rules = PRESET_RULES[args.rules] if args.rules else (
@@ -145,21 +153,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         learning_rate=args.lr, weight_decay=args.weight_decay,
         warmup_steps=args.warmup_steps, total_steps=args.steps))
 
-    if fam == "vit":
-        step_fn = make_classifier_train_step()
-        data = blob_classification(args.batch_size,
-                                   image_size=cfg.vision.image_size,
-                                   num_classes=cfg.num_classes, seed=args.seed)
-    else:
-        loss_kind = args.loss or ("clip" if fam == "clip" else
-                                  ("siglip_ring" if mesh is not None
-                                   else "siglip"))
-        step_fn = make_contrastive_train_step(loss_kind, mesh=mesh)
-        data = contrastive_pairs(args.batch_size,
-                                 image_size=cfg.vision.image_size,
-                                 vocab_size=cfg.text.vocab_size,
-                                 seq_len=cfg.text.context_length,
-                                 seed=args.seed)
+    import jax
 
     ckpt = CheckpointManager(args.ckpt_dir, save_interval_steps=args.save_every) \
         if args.ckpt_dir else None
@@ -170,6 +164,46 @@ def cmd_train(args: argparse.Namespace) -> int:
             print(f"resumed from step {start_step - 1}")
         except FileNotFoundError:
             pass
+
+    # deterministic resume: resumed step N sees the same batch it would have
+    # in the uninterrupted run. File pipelines fast-forward the raw example
+    # stream (no image decode); synthetic generators just skip batches.
+    data_kw = dict(shard_index=jax.process_index(),
+                   shard_count=jax.process_count(),
+                   shuffle_buffer=args.shuffle_buffer, seed=args.seed,
+                   skip_examples=start_step * args.batch_size)
+    if fam == "vit":
+        step_fn = make_classifier_train_step()
+        if args.data:
+            from jimm_tpu.data.records import classification_batches
+            data = classification_batches(
+                args.data, args.batch_size,
+                image_size=cfg.vision.image_size, **data_kw)
+        else:
+            data = blob_classification(args.batch_size,
+                                       image_size=cfg.vision.image_size,
+                                       num_classes=cfg.num_classes,
+                                       seed=args.seed)
+    else:
+        loss_kind = args.loss or ("clip" if fam == "clip" else
+                                  ("siglip_ring" if mesh is not None
+                                   else "siglip"))
+        step_fn = make_contrastive_train_step(loss_kind, mesh=mesh)
+        if args.data:
+            from jimm_tpu.data.records import image_text_batches
+            data = image_text_batches(
+                args.data, args.batch_size,
+                image_size=cfg.vision.image_size,
+                seq_len=cfg.text.context_length, **data_kw)
+        else:
+            data = contrastive_pairs(args.batch_size,
+                                     image_size=cfg.vision.image_size,
+                                     vocab_size=cfg.text.vocab_size,
+                                     seq_len=cfg.text.context_length,
+                                     seed=args.seed)
+    if not args.data:
+        for _ in range(start_step):
+            next(data)
 
     logger = MetricsLogger(path=args.metrics_file, print_every=args.log_every)
     timer = StepTimer()
@@ -206,6 +240,16 @@ def cmd_train(args: argparse.Namespace) -> int:
                            **{k: float(v) for k, v in metrics.items()})
                 if ckpt is not None:
                     ckpt.save(step, model, optimizer)
+                if args.fake_failure_at_step is not None \
+                        and step == args.fake_failure_at_step:
+                    # failure-injection drill (SURVEY §5 failure-detection
+                    # row): simulate a mid-run crash AFTER the checkpoint
+                    # write so a --resume rerun must restore and continue
+                    if ckpt is not None:
+                        ckpt.wait()
+                    raise RuntimeError(
+                        f"injected failure at step {step} "
+                        "(--fake-failure-at-step drill; rerun with --resume)")
     finally:
         if profiler_ctx is not None:
             # crash mid-profile: still flush what was captured
@@ -329,6 +373,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="shrink the preset to CPU-demo size")
     sp.add_argument("--steps", type=int, default=100)
     sp.add_argument("--batch-size", type=int, default=32)
+    sp.add_argument("--data", default=None,
+                    help="tfrecord file/dir/glob with image+label (vit) or "
+                         "image+tokens (clip/siglip) examples; default: "
+                         "procedural synthetic data")
+    sp.add_argument("--shuffle-buffer", type=int, default=256,
+                    help="example shuffle-buffer size for --data")
+    sp.add_argument("--num-classes", type=int, default=None,
+                    help="override classifier width (vit + --data)")
     sp.add_argument("--lr", type=float, default=1e-3)
     sp.add_argument("--weight-decay", type=float, default=1e-4)
     sp.add_argument("--warmup-steps", type=int, default=0)
@@ -337,13 +389,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--mesh", default=None,
                     help='e.g. "data=4,model=2" (default: no mesh)')
     sp.add_argument("--rules", default=None,
-                    choices=[None, "replicated", "dp", "tp", "fsdp",
+                    choices=["replicated", "dp", "tp", "fsdp",
                              "fsdp_tp", "sp", "pp"],
                     help="sharding rules preset (requires --mesh)")
     sp.add_argument("--loss", default=None,
-                    choices=[None, "clip", "siglip", "siglip_ring"])
+                    choices=["clip", "siglip", "siglip_ring"])
     sp.add_argument("--attn-impl", default=None,
-                    choices=[None, "auto", "xla", "flash", "ring"],
+                    choices=["auto", "xla", "flash", "ring"],
                     help="attention kernel for both towers "
                          "(ring = sequence-parallel, needs a seq mesh axis)")
     sp.add_argument("--pipeline-microbatches", type=int, default=0,
@@ -351,6 +403,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "(needs a 'stage' mesh axis and --rules pp)")
     sp.add_argument("--ckpt-dir", default=None)
     sp.add_argument("--resume", action="store_true")
+    sp.add_argument("--fake-failure-at-step", type=int, default=None,
+                    help="failure drill: crash after checkpointing this step "
+                         "(recover with --resume)")
     sp.add_argument("--save-every", type=int, default=50)
     sp.add_argument("--log-every", type=int, default=10)
     sp.add_argument("--metrics-file", default=None,

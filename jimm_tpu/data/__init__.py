@@ -4,11 +4,24 @@ from jimm_tpu.data.preprocess import (CLIP_MEAN, CLIP_STD, IMAGENET_MEAN,
                                       center_crop, native_available,
                                       preprocess_batch, resize_bilinear,
                                       to_float_normalized)
+from jimm_tpu.data.records import (classification_batches, decode_image,
+                                   image_text_batches, iter_examples,
+                                   resolve_paths,
+                                   write_classification_records,
+                                   write_image_text_records)
 from jimm_tpu.data.synthetic import blob_classification, contrastive_pairs
+from jimm_tpu.data.tfrecord import (TFRecordWriter, crc32c, decode_example,
+                                    encode_example, masked_crc32c,
+                                    read_tfrecord, write_tfrecord)
 
 __all__ = [
     "PrefetchIterator", "blob_classification", "contrastive_pairs",
     "preprocess_batch", "to_float_normalized", "resize_bilinear",
     "center_crop", "native_available", "IMAGENET_MEAN", "IMAGENET_STD",
     "CLIP_MEAN", "CLIP_STD", "SIGLIP_MEAN", "SIGLIP_STD",
+    "TFRecordWriter", "write_tfrecord", "read_tfrecord", "crc32c",
+    "masked_crc32c", "encode_example", "decode_example",
+    "image_text_batches", "classification_batches", "iter_examples",
+    "decode_image", "resolve_paths", "write_image_text_records",
+    "write_classification_records",
 ]

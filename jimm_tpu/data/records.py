@@ -1,0 +1,220 @@
+"""Training input pipeline over TFRecord files on disk.
+
+Feeds real data (image-classification or image-text contrastive) from
+``*.tfrecord`` shards into the trainer: decode (PIL or raw) -> native
+multithreaded resize/normalize (`jimm_tpu.data.preprocess`) -> fixed-shape
+numpy batches -> `PrefetchIterator` for host/device overlap. Replaces the
+reference's network-bound tfds path (ref `examples/vit_training.py:205-212`)
+with an offline, multi-host-shardable loader built on the zero-dependency
+codec in `jimm_tpu.data.tfrecord`.
+
+Record schema (standard TF conventions):
+- ``image``: one PNG/JPEG-encoded image, OR raw uint8 bytes with an
+  accompanying ``shape`` int64 feature [h, w, c]
+- ``tokens``: pre-tokenized int64 caption ids (contrastive pairs)
+- ``label``: int64 class id (classification)
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import io
+import random
+from pathlib import Path
+from typing import Any, Iterator, Sequence
+
+import numpy as np
+
+from jimm_tpu.data.preprocess import (SIGLIP_MEAN, SIGLIP_STD,
+                                      resize_bilinear, to_float_normalized)
+from jimm_tpu.data.tfrecord import (TFRecordWriter, decode_example,
+                                    encode_example, read_tfrecord)
+
+_PNG_MAGIC = b"\x89PNG"
+_JPEG_MAGIC = b"\xff\xd8"
+
+
+def resolve_paths(data: str | Sequence[str | Path]) -> list[str]:
+    """A glob pattern, directory, single file, or explicit list -> file list."""
+    if isinstance(data, (str, Path)):
+        p = Path(data)
+        if p.is_dir():
+            paths = sorted(str(q) for q in p.glob("*.tfrecord*"))
+        elif any(ch in str(data) for ch in "*?["):
+            paths = sorted(_glob.glob(str(data)))
+        else:
+            paths = [str(p)]
+    else:
+        paths = [str(p) for p in data]
+    if not paths:
+        raise FileNotFoundError(f"no tfrecord files match {data!r}")
+    return paths
+
+
+def decode_image(value: bytes, shape: Sequence[int] | None = None
+                 ) -> np.ndarray:
+    """Encoded (PNG/JPEG) or raw-uint8 image bytes -> uint8 [H, W, C].
+
+    An explicit ``shape`` wins over magic-number sniffing: raw pixel data can
+    legitimately begin with the JPEG/PNG magic bytes (e.g. a white-ish
+    top-left pixel gives ``\\xff\\xd8``), and records written with
+    ``encoding="raw"`` always carry ``shape``."""
+    if shape:
+        h, w, c = (int(s) for s in shape)
+        return np.frombuffer(value, np.uint8).reshape(h, w, c)
+    if value[:4] == _PNG_MAGIC or value[:2] == _JPEG_MAGIC:
+        from PIL import Image
+        return np.asarray(Image.open(io.BytesIO(value)).convert("RGB"))
+    raise ValueError("image bytes are neither PNG/JPEG nor raw-with-'shape'")
+
+
+def iter_examples(paths: Sequence[str], *, repeat: bool = True,
+                  shuffle_buffer: int = 0, seed: int = 0,
+                  shard_index: int = 0, shard_count: int = 1,
+                  verify: bool = False) -> Iterator[dict[str, list]]:
+    """Decoded examples, optionally epoch-repeating and buffer-shuffled.
+    Multi-host sharding takes every ``shard_count``-th example (matching
+    per-process data loading: pass ``jax.process_index()/count()``)."""
+    rng = random.Random(seed)
+    buf: list[dict[str, list]] = []
+    epoch = 0
+    while True:
+        files = list(paths)
+        if shuffle_buffer:
+            rng.shuffle(files)
+        idx = 0
+        for path in files:
+            for record in read_tfrecord(path, verify=verify):
+                idx += 1
+                if (idx - 1) % shard_count != shard_index:
+                    continue
+                ex = decode_example(record)
+                if shuffle_buffer:
+                    buf.append(ex)
+                    if len(buf) >= shuffle_buffer:
+                        yield buf.pop(rng.randrange(len(buf)))
+                else:
+                    yield ex
+        epoch += 1
+        if not repeat:
+            break
+    while buf:
+        yield buf.pop(rng.randrange(len(buf)))
+
+
+def _image_batch(examples: list[dict[str, list]], image_size: int,
+                 mean, std) -> np.ndarray:
+    images = []
+    for ex in examples:
+        img = decode_image(ex["image"][0], ex.get("shape"))
+        if img.shape[:2] != (image_size, image_size):
+            img = resize_bilinear(
+                img[None].astype(np.float32) / 255.0,
+                (image_size, image_size))[0]
+            images.append(img)
+        else:
+            images.append(img.astype(np.float32) / 255.0)
+    batch = np.stack(images)
+    return to_float_normalized(batch, mean, std)
+
+
+def _skip(examples: Iterator, n: int) -> None:
+    """Fast-forward the raw example stream (protobuf parse only — no image
+    decode/resize) for deterministic resume at step N."""
+    for _ in range(n):
+        next(examples, None)
+
+
+def image_text_batches(data: str | Sequence[str], batch_size: int, *,
+                       image_size: int, seq_len: int, pad_id: int = 0,
+                       mean=SIGLIP_MEAN, std=SIGLIP_STD,
+                       shuffle_buffer: int = 0, seed: int = 0,
+                       repeat: bool = True, shard_index: int = 0,
+                       shard_count: int = 1, skip_examples: int = 0,
+                       ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """(images f32 [B,S,S,3] normalized, tokens i32 [B,L]) batches for
+    CLIP/SigLIP contrastive training. Tokens pad/truncate to ``seq_len``."""
+    examples = iter_examples(resolve_paths(data), repeat=repeat,
+                             shuffle_buffer=shuffle_buffer, seed=seed,
+                             shard_index=shard_index, shard_count=shard_count)
+    _skip(examples, skip_examples)
+    while True:
+        chunk = []
+        for ex in examples:
+            chunk.append(ex)
+            if len(chunk) == batch_size:
+                break
+        if len(chunk) < batch_size:
+            return  # non-repeating stream exhausted
+        images = _image_batch(chunk, image_size, mean, std)
+        tokens = np.full((batch_size, seq_len), pad_id, np.int32)
+        for i, ex in enumerate(chunk):
+            t = ex["tokens"][:seq_len]
+            tokens[i, :len(t)] = t
+        yield images, tokens
+
+
+def classification_batches(data: str | Sequence[str], batch_size: int, *,
+                           image_size: int, mean=SIGLIP_MEAN, std=SIGLIP_STD,
+                           shuffle_buffer: int = 0, seed: int = 0,
+                           repeat: bool = True, shard_index: int = 0,
+                           shard_count: int = 1, skip_examples: int = 0,
+                           ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """(images f32 [B,S,S,3] normalized, labels i32 [B]) batches."""
+    examples = iter_examples(resolve_paths(data), repeat=repeat,
+                             shuffle_buffer=shuffle_buffer, seed=seed,
+                             shard_index=shard_index, shard_count=shard_count)
+    _skip(examples, skip_examples)
+    while True:
+        chunk = []
+        for ex in examples:
+            chunk.append(ex)
+            if len(chunk) == batch_size:
+                break
+        if len(chunk) < batch_size:
+            return
+        images = _image_batch(chunk, image_size, mean, std)
+        labels = np.asarray([int(ex["label"][0]) for ex in chunk], np.int32)
+        yield images, labels
+
+
+# ---------------------------------------------------------------------------
+# Writing (dataset preparation tooling)
+# ---------------------------------------------------------------------------
+
+def encode_image_feature(image: np.ndarray | bytes, *, encoding: str = "png"
+                         ) -> dict[str, Any]:
+    """uint8 [H,W,C] array (or already-encoded bytes) -> feature dict."""
+    if isinstance(image, bytes):
+        return {"image": image}
+    image = np.ascontiguousarray(image, np.uint8)
+    if encoding == "raw":
+        return {"image": image.tobytes(), "shape": list(image.shape)}
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(image).save(buf, format=encoding.upper())
+    return {"image": buf.getvalue()}
+
+
+def write_image_text_records(path: str | Path,
+                             pairs: Sequence[tuple[Any, Sequence[int]]], *,
+                             encoding: str = "png") -> int:
+    """[(image, token-ids), ...] -> one tfrecord shard. Returns count."""
+    with TFRecordWriter(path) as w:
+        for image, tokens in pairs:
+            feats = encode_image_feature(image, encoding=encoding)
+            feats["tokens"] = [int(t) for t in tokens]
+            w.write(encode_example(feats))
+    return len(pairs)
+
+
+def write_classification_records(path: str | Path,
+                                 pairs: Sequence[tuple[Any, int]], *,
+                                 encoding: str = "png") -> int:
+    """[(image, label), ...] -> one tfrecord shard. Returns count."""
+    with TFRecordWriter(path) as w:
+        for image, label in pairs:
+            feats = encode_image_feature(image, encoding=encoding)
+            feats["label"] = int(label)
+            w.write(encode_example(feats))
+    return len(pairs)

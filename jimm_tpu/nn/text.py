@@ -49,7 +49,16 @@ class TextTower(nnx.Module):
     def pool(self, hidden: jax.Array, text: jax.Array) -> jax.Array:
         """Pool final hidden states per the configured strategy."""
         if self.cfg.pooling == "eot":
-            eot = jnp.argmax(text, axis=-1)
+            if self.cfg.eos_token_id in (None, 2):
+                # HF CLIPTextTransformer's LEGACY path: configs carrying the
+                # historical bogus eos_token_id=2 (all original OpenAI CLIP
+                # checkpoints) pool at argmax(ids) — EOT is the max vocab id
+                eot = jnp.argmax(text, axis=-1)
+            else:
+                # modern HF configs: FIRST occurrence of the real EOS id.
+                # argmax over the boolean mask returns the first True (or 0
+                # when the row has no EOS — same as HF).
+                eot = jnp.argmax(text == self.cfg.eos_token_id, axis=-1)
             return hidden[jnp.arange(hidden.shape[0]), eot]
         if self.cfg.pooling == "last":
             return hidden[:, -1]

@@ -1,17 +1,22 @@
-from jimm_tpu.parallel.mesh import make_hybrid_mesh, make_mesh
+from jimm_tpu.parallel.mesh import (TOPOLOGIES, initialize_distributed,
+                                    make_hybrid_mesh, make_mesh,
+                                    make_topology)
 from jimm_tpu.parallel.pipeline import pipeline_forward
 from jimm_tpu.parallel.ring_attention import ring_attention
 from jimm_tpu.parallel.sharding import (DATA_PARALLEL, FSDP, FSDP_TP,
-                                        PIPELINE, PRESET_RULES, REPLICATED,
+                                        HYBRID_FSDP_TP, PIPELINE,
+                                        PRESET_RULES, REPLICATED,
                                         SEQUENCE_PARALLEL, TENSOR_PARALLEL,
                                         ShardingRules, create_sharded,
                                         logical, logical_constraint,
                                         shard_batch, shard_model, use_sharding)
 
 __all__ = [
-    "make_mesh", "make_hybrid_mesh", "ShardingRules", "use_sharding",
+    "make_mesh", "make_hybrid_mesh", "make_topology", "TOPOLOGIES",
+    "initialize_distributed", "ShardingRules", "use_sharding",
     "create_sharded", "shard_model", "shard_batch", "logical",
     "logical_constraint", "pipeline_forward", "ring_attention",
     "REPLICATED", "DATA_PARALLEL", "TENSOR_PARALLEL",
-    "FSDP", "FSDP_TP", "SEQUENCE_PARALLEL", "PIPELINE", "PRESET_RULES",
+    "FSDP", "FSDP_TP", "HYBRID_FSDP_TP", "SEQUENCE_PARALLEL", "PIPELINE",
+    "PRESET_RULES",
 ]

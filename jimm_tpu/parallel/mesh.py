@@ -48,10 +48,15 @@ def make_mesh(axes: Mapping[str, int] | None = None,
     return Mesh(dev_array, tuple(axes.keys()))
 
 
-def make_hybrid_mesh(ici: Mapping[str, int], dcn: Mapping[str, int]) -> Mesh:
+def make_hybrid_mesh(ici: Mapping[str, int], dcn: Mapping[str, int],
+                     devices: list | None = None) -> Mesh:
     """Multi-slice mesh: ``dcn`` axes span slices (data-parallel over DCN),
     ``ici`` axes live inside a slice. E.g. v5e-64 = 4 slices of 16:
-    ``make_hybrid_mesh(ici={"data": 4, "model": 4}, dcn={"replica": 4})``."""
+    ``make_hybrid_mesh(ici={"data": 4, "model": 4}, dcn={"replica": 4})``.
+
+    ``devices`` defaults to ``jax.devices()``; they must carry a
+    ``slice_index`` attribute (real multi-slice TPUs do; tests pass mocks).
+    """
     ici = OrderedDict(ici)
     dcn = OrderedDict(dcn)
     # create_hybrid_device_mesh multiplies same-rank shapes elementwise, so
@@ -60,20 +65,64 @@ def make_hybrid_mesh(ici: Mapping[str, int], dcn: Mapping[str, int]) -> Mesh:
     dcn_shape = tuple(dcn.values()) + (1,) * len(ici)
     dev_array = mesh_utils.create_hybrid_device_mesh(
         mesh_shape=mesh_shape, dcn_mesh_shape=dcn_shape,
-        devices=jax.devices())
+        devices=devices if devices is not None else jax.devices())
     return Mesh(dev_array, tuple(dcn.keys()) + tuple(ici.keys()))
+
+
+#: Named pod topologies for the BASELINE.json tracked configs: mesh recipe +
+#: sharding-rules preset + the ring axis for the sigmoid loss. "hybrid"
+#: entries build a DCN x ICI mesh (multi-slice); others a single-slice mesh.
+TOPOLOGIES: dict[str, dict] = {
+    # BASELINE config #3: ViT-L/16-384 fine-tune, FSDP over one v5e-16 slice
+    "v5e-16-fsdp": {"axes": {"data": 16}, "rules": "fsdp",
+                    "ring_axis": "data"},
+    # BASELINE config #4: SigLIP-B/16-256 ring-loss training on one slice
+    "v5e-16-dp": {"axes": {"data": 16}, "rules": "dp", "ring_axis": "data"},
+    # BASELINE config #5: SigLIP2-L/16-512 pod-scale — 4 slices of 16 chips,
+    # FSDP(data) x TP(model) inside each slice, pure DP across DCN
+    "v5e-64-fsdp-tp": {"ici": {"data": 4, "model": 4},
+                       "dcn": {"replica": 4}, "rules": "hybrid_fsdp_tp",
+                       "ring_axis": ("replica", "data")},
+}
+
+
+def make_topology(name: str, devices: list | None = None):
+    """Build ``(mesh, rules_name, ring_axis)`` for a named pod topology."""
+    spec = TOPOLOGIES[name]
+    if "ici" in spec:
+        mesh = make_hybrid_mesh(spec["ici"], spec["dcn"], devices=devices)
+    else:
+        mesh = make_mesh(spec["axes"], devices=devices)
+    return mesh, spec["rules"], spec["ring_axis"]
 
 
 def initialize_distributed(coordinator_address: str | None = None,
                            num_processes: int | None = None,
                            process_id: int | None = None) -> None:
     """Multi-host bootstrap. On Cloud TPU the arguments are auto-detected from
-    the metadata server; pass them explicitly elsewhere. Safe to call twice."""
+    the metadata server; pass them explicitly elsewhere. Safe to call twice.
+
+    Errors are surfaced, not swallowed: when the caller passed explicit
+    coordinator arguments a failure means a real multi-host misconfiguration,
+    and degrading to single-process would train silently wrong. Only the
+    argument-free auto-detect path downgrades to a warning (it legitimately
+    fails on non-pod environments).
+    """
     if jax.process_count() > 1:
         return  # already initialized
+    explicit = (coordinator_address is not None or num_processes is not None
+                or process_id is not None)
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
-    except (RuntimeError, ValueError):
-        pass  # single-process environment
+    except (RuntimeError, ValueError) as e:
+        # jax phrases double-init as "should only be called once"
+        msg = str(e).lower()
+        if "already" in msg or "only be called once" in msg:
+            return
+        if explicit:
+            raise
+        import warnings
+        warnings.warn(f"jax.distributed.initialize auto-detect failed "
+                      f"({e}); continuing single-process", RuntimeWarning)

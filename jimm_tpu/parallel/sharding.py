@@ -92,10 +92,18 @@ TENSOR_PARALLEL = ShardingRules(
 #: map two dims onto the same mesh axis.)
 FSDP = ShardingRules(embed="data", batch="data", mlp=None, heads=None)
 
-#: 2-D FSDP ("data") x TP ("model") — the v5e-64 training layout.
+#: 2-D FSDP ("data") x TP ("model") — the single-slice training layout.
 FSDP_TP = ShardingRules(
     embed="data", heads="model", mlp="model", vocab="model", proj="model",
     classes="model", batch="data")
+
+#: Multi-slice pod layout (BASELINE config #5, v5e-64 = 4 slices x 16):
+#: FSDP over the intra-slice "data" axis, TP over the intra-slice "model"
+#: axis, pure data parallelism over the cross-slice DCN "replica" axis —
+#: parameters replicate across slices so only gradient all-reduce rides DCN.
+HYBRID_FSDP_TP = ShardingRules(
+    embed="data", heads="model", mlp="model", vocab="model", proj="model",
+    classes="model", batch=("replica", "data"))
 
 #: Context/sequence parallelism for long sequences (ring attention):
 #: activations sharded over the sequence axis.
@@ -113,6 +121,7 @@ PRESET_RULES: dict[str, ShardingRules] = {
     "tp": TENSOR_PARALLEL,
     "fsdp": FSDP,
     "fsdp_tp": FSDP_TP,
+    "hybrid_fsdp_tp": HYBRID_FSDP_TP,
     "sp": SEQUENCE_PARALLEL,
     "pp": PIPELINE,
 }

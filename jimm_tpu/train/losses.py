@@ -56,10 +56,12 @@ def sigmoid_pairwise_loss(img: jax.Array, txt: jax.Array,
 
 
 def _ring_sigmoid_local(img: jax.Array, txt: jax.Array, scale: jax.Array,
-                        bias: jax.Array, *, axis_name: str) -> jax.Array:
-    """Per-device body: local images stay put; text chunks ride the ring."""
+                        bias: jax.Array, *, axis_name) -> jax.Array:
+    """Per-device body: local images stay put; text chunks ride the ring.
+    ``axis_name`` may be a tuple of mesh axes (e.g. ``("replica", "data")``
+    on a hybrid DCN x ICI mesh) — the ring then runs over the linearized
+    product axis."""
     n_dev = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
     b = img.shape[0]
     img = img / jnp.linalg.norm(img, axis=-1, keepdims=True)
     txt = txt / jnp.linalg.norm(txt, axis=-1, keepdims=True)
@@ -70,18 +72,19 @@ def _ring_sigmoid_local(img: jax.Array, txt: jax.Array, scale: jax.Array,
         z = jnp.where(positives, 1.0, -1.0).astype(logits.dtype)
         return -jnp.sum(jax.nn.log_sigmoid(z * logits))
 
-    def step(carry, j):
+    def step(carry, _):
         txt_chunk, acc = carry
-        # chunk j originated on device (idx - j) mod n_dev; positives only
-        # for our own chunk (j == 0)
-        eye = jnp.eye(b, dtype=bool)
-        positives = jnp.where(j == 0, eye, jnp.zeros_like(eye))
-        acc = acc + chunk_loss(txt_chunk, positives)
+        # traveling chunks are all negatives (positives live in chunk 0,
+        # handled outside the scan)
         txt_chunk = jax.lax.ppermute(txt_chunk, axis_name, perm)
+        acc = acc + chunk_loss(txt_chunk, jnp.zeros((b, b), bool))
         return (txt_chunk, acc), None
 
-    (_, total), _ = jax.lax.scan(step, (txt, jnp.zeros((), img.dtype)),
-                                 jnp.arange(n_dev))
+    # own chunk first (diagonal positives), then n_dev-1 permute+accumulate
+    # steps — no wasted final ppermute (same shape as ring_attention.py:72-75)
+    total0 = chunk_loss(txt, jnp.eye(b, dtype=bool))
+    (_, total), _ = jax.lax.scan(step, (txt, total0),
+                                 jnp.arange(n_dev - 1))
     # average over the *global* batch like the dense reference
     total = jax.lax.psum(total, axis_name)
     return total / (b * n_dev)
@@ -89,7 +92,7 @@ def _ring_sigmoid_local(img: jax.Array, txt: jax.Array, scale: jax.Array,
 
 def ring_sigmoid_loss(img: jax.Array, txt: jax.Array, logit_scale: jax.Array,
                       logit_bias: jax.Array, *, mesh: Mesh,
-                      axis_name: str = "data") -> jax.Array:
+                      axis_name: str | tuple[str, ...] = "data") -> jax.Array:
     """SigLIP sigmoid loss over a batch sharded on ``axis_name``, computed as
     a ``ppermute`` ring so no device ever holds the global text batch or the
     full logit matrix. Differentiable end-to-end (``ppermute``'s transpose is

@@ -16,8 +16,10 @@ def configure_platform(platform: str | None = None,
                        host_devices: int | None = None) -> None:
     """Apply backend overrides from arguments, falling back to the
     ``JIMM_PLATFORM`` / ``JIMM_HOST_DEVICES`` env vars."""
-    plat = platform or os.environ.get("JIMM_PLATFORM")
-    n = host_devices or os.environ.get("JIMM_HOST_DEVICES")
+    # `is None` (not truthiness): an explicit empty/zero argument must be
+    # able to override a JIMM_PLATFORM/JIMM_HOST_DEVICES env setting
+    plat = os.environ.get("JIMM_PLATFORM") if platform is None else platform
+    n = os.environ.get("JIMM_HOST_DEVICES") if host_devices is None else host_devices
     if not plat and not n:
         return
     import jax

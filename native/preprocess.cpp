@@ -44,6 +44,42 @@ void parallel_for_batch(int64_t batch, int threads,
 
 inline float lerp(float a, float b, float w) { return a + (b - a) * w; }
 
+// CRC32C (Castagnoli, reflected poly 0x82F63B78), slice-by-8: the checksum
+// of the TFRecord framing format. Software table version — portable, and at
+// ~1-2 GB/s far from the input-pipeline bottleneck.
+struct Crc32cTables {
+  uint32_t t[8][256];
+  Crc32cTables() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int s = 1; s < 8; ++s)
+        t[s][i] = (t[s - 1][i] >> 8) ^ t[0][t[s - 1][i] & 0xFF];
+  }
+};
+const Crc32cTables kCrc;
+
+uint32_t crc32c_impl(const uint8_t* p, int64_t n, uint32_t crc) {
+  crc = ~crc;
+  while (n >= 8) {
+    uint64_t word;
+    std::memcpy(&word, p, 8);
+    crc ^= static_cast<uint32_t>(word);
+    uint32_t hi = static_cast<uint32_t>(word >> 32);
+    crc = kCrc.t[7][crc & 0xFF] ^ kCrc.t[6][(crc >> 8) & 0xFF] ^
+          kCrc.t[5][(crc >> 16) & 0xFF] ^ kCrc.t[4][crc >> 24] ^
+          kCrc.t[3][hi & 0xFF] ^ kCrc.t[2][(hi >> 8) & 0xFF] ^
+          kCrc.t[1][(hi >> 16) & 0xFF] ^ kCrc.t[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n-- > 0) crc = kCrc.t[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
 // Bilinear sample of one output row for all channels.
 void resize_row(const float* src, int64_t sh, int64_t sw, int64_t c,
                 float* dst, int64_t dw, float sy, float scale_x) {
@@ -141,6 +177,11 @@ void jimm_center_crop_f32(const float* in, float* out, int64_t batch,
       std::memcpy(dst + y * cw * c, src + y * w * c,
                   sizeof(float) * cw * c);
   });
+}
+
+// CRC32C of a byte buffer (TFRecord framing checksum).
+uint32_t jimm_crc32c(const uint8_t* data, int64_t n) {
+  return crc32c_impl(data, n, 0);
 }
 
 }  // extern "C"

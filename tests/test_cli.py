@@ -45,6 +45,7 @@ def test_train_resume(tmp_path):
     assert [r["step"] for r in records] == [2, 3]
 
 
+@pytest.mark.slow
 def test_train_sharded_ring_loss(tmp_path, eight_devices, capsys):
     assert main(["train", "--preset", "siglip-base-patch16-256", "--tiny",
                  "--steps", "2", "--batch-size", "8",

@@ -70,3 +70,50 @@ def test_shape_inference_without_config(clip_ckpt, tmp_path, rng):
     assert model.config.projection_dim == 32
     out = model(jnp.asarray(sample_image(rng)), jnp.asarray(sample_text(rng)))
     assert out.shape == (2, 2)
+
+
+@pytest.fixture(scope="module")
+def clip_modern_eos_ckpt(tmp_path_factory):
+    """HF config with a REAL eos_token_id (not the legacy 2): HF pools at the
+    first EOS occurrence, not argmax(ids)."""
+    import hf_util
+    text = dict(hf_util.TINY_TEXT, eos_token_id=5)
+    from transformers import CLIPConfig, CLIPModel
+    cfg = CLIPConfig(text_config=text,
+                     vision_config=dict(hf_util.TINY_VISION),
+                     projection_dim=32)
+    path = tmp_path_factory.mktemp("clip_eos")
+    CLIPModel(cfg).eval().save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+def test_modern_eos_first_occurrence_parity(clip_modern_eos_ckpt, rng):
+    """First-EOS pooling (modern HF configs) vs torch oracle: tokens where
+    argmax(ids) and first-EOS positions DIFFER, so the legacy path would
+    fail this test."""
+    import torch
+    from transformers import CLIPModel
+    oracle = CLIPModel.from_pretrained(clip_modern_eos_ckpt).eval()
+    model = CLIP.from_pretrained(clip_modern_eos_ckpt)
+    assert model.config.text.eos_token_id == 5
+    txt = rng.randint(10, 90, size=(2, 16))  # ids all > eos, none maximal-at-eos
+    txt[0, 7] = 5
+    txt[1, 3] = 5
+    txt[1, 12] = 5  # first occurrence wins
+    with torch.no_grad():
+        ref = oracle.get_text_features(torch.tensor(txt)).numpy()
+    ours = np.asarray(model.encode_text(jnp.asarray(txt)))
+    np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+
+def test_legacy_eos_token_id_2_uses_argmax(clip_ckpt, rng):
+    """eos_token_id=2 (every original OpenAI checkpoint) must select HF's
+    legacy argmax-of-ids pooling, NOT first-occurrence-of-2."""
+    import dataclasses
+    model = CLIP.from_pretrained(clip_ckpt)
+    object.__setattr__(model.text.cfg, "eos_token_id", None)
+    txt = sample_text(rng)
+    legacy_none = np.asarray(model.encode_text(jnp.asarray(txt)))
+    object.__setattr__(model.text.cfg, "eos_token_id", 2)
+    legacy_two = np.asarray(model.encode_text(jnp.asarray(txt)))
+    np.testing.assert_allclose(legacy_two, legacy_none, atol=1e-6)

@@ -13,6 +13,7 @@ def _load_graft():
     return __graft_entry__
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8(eight_devices):
     g = _load_graft()
     g.dryrun_multichip(8)

@@ -58,6 +58,7 @@ def test_transformer_pipeline_matches_plain(rng, pp_mesh):
     np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_transformer_pipeline_gradients_match(rng, pp_mesh):
     x = jnp.asarray(rng.randn(8, 12, 32).astype(np.float32))
 
@@ -97,6 +98,7 @@ def test_pipeline_requires_stage_axis(rng, eight_devices):
             pp(x)
 
 
+@pytest.mark.slow
 def test_pipelined_vit_training_step(rng, pp_mesh):
     """End-to-end: a pipelined ViT classifier trains (loss decreases)."""
     from jimm_tpu import VisionTransformer, ViTConfig, VisionConfig

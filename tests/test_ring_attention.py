@@ -38,6 +38,7 @@ def test_sharded_inputs_under_jit(rng, mesh):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_gradients_match_full_attention(rng, mesh, causal):
     q, k, v = (jnp.asarray(rng.randn(1, 32, 2, 8).astype(np.float32) * 0.5)
                for _ in range(3))
@@ -64,6 +65,7 @@ def test_flash_ring_matches_full_attention(rng, mesh):
     np.testing.assert_allclose(out, ref, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_ring_gradients_match(rng, mesh):
     q, k, v = (jnp.asarray(rng.randn(1, 32, 2, 8).astype(np.float32) * 0.5)
                for _ in range(3))

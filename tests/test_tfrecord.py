@@ -1,0 +1,159 @@
+"""TFRecord codec + file-based input pipeline tests.
+
+Format compatibility is pinned against real tensorflow (installed in the dev
+image, never imported by library code): records we write must parse with
+``tf.data`` / ``tf.train.Example``, and vice versa. The end-to-end test
+trains the CLI from tfrecord files on disk (VERDICT r1 item #5)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from jimm_tpu.data.tfrecord import (TFRecordWriter, _crc32c_py, crc32c,
+                                    decode_example, encode_example,
+                                    masked_crc32c, read_tfrecord,
+                                    write_tfrecord)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 / iSCSI test vectors
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert _crc32c_py(b"123456789") == 0xE3069283
+    assert _crc32c_py(bytes(range(32))) == crc32c(bytes(range(32)))
+
+
+def test_tfrecord_roundtrip(tmp_path):
+    path = tmp_path / "x.tfrecord"
+    records = [b"one", b"", b"three" * 1000]
+    assert write_tfrecord(path, records) == 3
+    assert list(read_tfrecord(path)) == records
+
+
+def test_tfrecord_detects_corruption(tmp_path):
+    path = tmp_path / "x.tfrecord"
+    write_tfrecord(path, [b"payload-bytes"])
+    raw = bytearray(path.read_bytes())
+    raw[14] ^= 0xFF  # flip a payload byte
+    path.write_bytes(bytes(raw))
+    with pytest.raises(ValueError, match="corrupt record crc"):
+        list(read_tfrecord(path))
+    assert list(read_tfrecord(path, verify=False))  # opt-out still reads
+
+
+def test_example_roundtrip():
+    feats = {"image": b"\x89PNGxxxx", "tokens": [3, 1, 4, -1, 5],
+             "score": [0.5, 2.25], "name": "caption"}
+    dec = decode_example(encode_example(feats))
+    assert dec["image"] == [b"\x89PNGxxxx"]
+    assert dec["tokens"] == [3, 1, 4, -1, 5]
+    assert dec["score"] == [0.5, 2.25]
+    assert dec["name"] == [b"caption"]
+
+
+tf = pytest.importorskip("tensorflow")
+
+
+def test_example_parses_with_tensorflow():
+    buf = encode_example({"tokens": [7, -9, 1 << 40], "img": b"ab",
+                          "w": [1.5]})
+    ex = tf.train.Example.FromString(buf)
+    f = ex.features.feature
+    assert list(f["tokens"].int64_list.value) == [7, -9, 1 << 40]
+    assert f["img"].bytes_list.value[0] == b"ab"
+    assert abs(f["w"].float_list.value[0] - 1.5) < 1e-6
+
+
+def test_decode_tensorflow_serialized_example():
+    ex = tf.train.Example(features=tf.train.Features(feature={
+        "a": tf.train.Feature(int64_list=tf.train.Int64List(value=[7, -9])),
+        "b": tf.train.Feature(bytes_list=tf.train.BytesList(value=[b"xy"])),
+        "c": tf.train.Feature(float_list=tf.train.FloatList(value=[2.5])),
+    }))
+    dec = decode_example(ex.SerializeToString())
+    assert dec["a"] == [7, -9]
+    assert dec["b"] == [b"xy"]
+    assert dec["c"] == [2.5]
+
+
+def test_tensorflow_reads_our_tfrecord(tmp_path):
+    path = str(tmp_path / "ours.tfrecord")
+    records = [b"alpha", encode_example({"x": [1]}), b"z" * 999]
+    write_tfrecord(path, records)
+    got = [r.numpy() for r in tf.data.TFRecordDataset(path)]
+    assert got == records
+
+
+def test_we_read_tensorflow_tfrecord_with_crc(tmp_path):
+    path = str(tmp_path / "tfs.tfrecord")
+    with tf.io.TFRecordWriter(path) as w:
+        for r in [b"alpha", b"beta" * 77]:
+            w.write(r)
+    assert list(read_tfrecord(path, verify=True)) == [b"alpha", b"beta" * 77]
+
+
+# ---------------------------------------------------------------------------
+# File-based batch pipeline
+# ---------------------------------------------------------------------------
+
+def _write_pairs(path, n, image_size=20, seq_len=6, seed=0):
+    from jimm_tpu.data.records import write_image_text_records
+    rng = np.random.RandomState(seed)
+    pairs = [(rng.randint(0, 255, size=(image_size, image_size, 3),
+                          dtype=np.uint8).astype(np.uint8),
+              rng.randint(1, 60, size=rng.randint(2, seq_len + 3)))
+             for _ in range(n)]
+    write_image_text_records(path, pairs, encoding="png")
+    return pairs
+
+
+def test_image_text_batches_from_png_records(tmp_path):
+    from jimm_tpu.data.records import image_text_batches
+    pairs = _write_pairs(tmp_path / "a.tfrecord", 10)
+    it = image_text_batches(str(tmp_path / "a.tfrecord"), 4, image_size=16,
+                            seq_len=8, repeat=False)
+    batches = list(it)
+    assert len(batches) == 2  # 10 examples -> two full batches of 4
+    images, tokens = batches[0]
+    assert images.shape == (4, 16, 16, 3) and images.dtype == np.float32
+    assert tokens.shape == (4, 8) and tokens.dtype == np.int32
+    # first example's tokens survive the pad/truncate round trip
+    t0 = np.asarray(pairs[0][1][:8])
+    assert (tokens[0, :len(t0)] == t0).all()
+
+
+def test_classification_batches_sharded(tmp_path):
+    from jimm_tpu.data.records import (classification_batches,
+                                       write_classification_records)
+    rng = np.random.RandomState(1)
+    pairs = [(rng.randint(0, 255, size=(12, 12, 3), dtype=np.uint8), i % 4)
+             for i in range(12)]
+    write_classification_records(tmp_path / "c.tfrecord", pairs,
+                                 encoding="raw")
+    # two shards must partition the label stream disjointly
+    seen = []
+    for shard in (0, 1):
+        for _, labels in classification_batches(
+                str(tmp_path / "c.tfrecord"), 2, image_size=12, repeat=False,
+                shard_index=shard, shard_count=2):
+            seen.extend(labels.tolist())
+    assert sorted(seen) == sorted(p[1] for p in pairs)
+
+
+def test_cli_train_from_tfrecord(tmp_path):
+    """End-to-end: training runs from files on disk through the CLI."""
+    from jimm_tpu.cli import main
+    _write_pairs(tmp_path / "train.tfrecord", 24, image_size=32, seq_len=8,
+                 seed=3)
+    metrics = tmp_path / "m.jsonl"
+    rc = main(["train", "--preset", "siglip-base-patch16-256", "--tiny",
+               "--data", str(tmp_path / "train.tfrecord"),
+               "--batch-size", "4", "--steps", "3", "--log-every", "0",
+               "--shuffle-buffer", "8", "--metrics-file", str(metrics)])
+    assert rc == 0
+    with open(metrics) as f:
+        recs = [json.loads(line) for line in f]
+    assert [r["step"] for r in recs] == [0, 1, 2]
+    assert all(np.isfinite(r["loss"]) for r in recs)

@@ -94,6 +94,7 @@ def test_classifier_train_step_decreases_loss(rng):
     assert float(metrics["accuracy"]) >= 0.5
 
 
+@pytest.mark.slow
 def test_contrastive_ring_train_step(rng, eight_devices):
     """SigLIP ring-loss training on a DP mesh must run and reduce loss."""
     mesh = make_mesh({"data": 8})
@@ -110,30 +111,17 @@ def test_contrastive_ring_train_step(rng, eight_devices):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_ring_equals_dense_train_step(rng, eight_devices):
-    """One optimizer step with the ring loss == one step with the dense loss
-    (same init, same batch)."""
+    """Ring-loss model gradients == dense-loss model gradients (same init,
+    same batch). Gradient equality implies identical optimizer steps, so the
+    full dense-vs-ring train-step pair isn't traced separately (it cost 2
+    more 8-device compiles for no extra coverage; post-Adam params can also
+    drift — the normalized update amplifies fp32 reduction-order noise)."""
     mesh = make_mesh({"data": 8})
     images = rng.randn(8, 16, 16, 3).astype(np.float32)
     text = rng.randint(1, 64, size=(8, 8))
 
-    m_dense = tiny_siglip()
-    o_dense = make_optimizer(m_dense, OptimizerConfig(learning_rate=1e-3))
-    dense_step = make_contrastive_train_step("siglip")
-    dense_loss = dense_step(m_dense, o_dense, jnp.asarray(images),
-                            jnp.asarray(text))["loss"]
-
-    m_ring = tiny_siglip()
-    o_ring = make_optimizer(m_ring, OptimizerConfig(learning_rate=1e-3))
-    ring_step = make_contrastive_train_step("siglip_ring", mesh=mesh)
-    with use_sharding(mesh, DATA_PARALLEL):
-        ring_loss = ring_step(m_ring, o_ring,
-                              shard_batch(images, mesh, DATA_PARALLEL),
-                              shard_batch(text, mesh, DATA_PARALLEL))["loss"]
-    np.testing.assert_allclose(float(ring_loss), float(dense_loss), rtol=1e-5)
-    # model-parameter gradients must match across the two loss paths
-    # (post-Adam params can drift: the normalized update amplifies fp32
-    # reduction-order noise, so compare grads, not params)
     from jimm_tpu.train import contrastive_loss_fn
     m = tiny_siglip()
     gd = nnx.grad(lambda mm: contrastive_loss_fn(
@@ -150,6 +138,7 @@ def test_ring_equals_dense_train_step(rng, eight_devices):
                                    err_msg=str(kd))
 
 
+@pytest.mark.slow
 def test_fsdp_training_runs(rng, eight_devices):
     mesh = make_mesh({"data": 8})
     model = VisionTransformer(
