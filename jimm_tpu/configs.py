@@ -54,6 +54,12 @@ class TransformerConfig:
     #: (local) batch % pp_microbatches == 0.
     pipeline: bool = False
     pp_microbatches: int = 4
+    #: Interleaved pipeline schedule: each stage holds this many
+    #: NON-contiguous layer chunks (circular placement) and microbatches lap
+    #: the ring pp_virtual times — bubble shrinks ~pp_virtual-fold
+    #: (`jimm_tpu/parallel/pipeline.py`). Needs depth % (stages*virtual) == 0
+    #: and (for >1) pp_microbatches % stages == 0.
+    pp_virtual: int = 1
     remat: bool = False
     #: What the backward pass may keep from the forward when ``remat`` is on:
     #: "none" recomputes everything (min memory, ~1/3 extra FLOPs); "dots"
@@ -93,6 +99,7 @@ class VisionConfig:
     attn_impl: AttnImpl = "auto"
     pipeline: bool = False
     pp_microbatches: int = 4
+    pp_virtual: int = 1
     remat: bool = False
     remat_policy: Literal["none", "dots"] = "none"
 
@@ -114,6 +121,7 @@ class VisionConfig:
             mlp_dim=self.mlp_dim, act=self.act, ln_eps=self.ln_eps,
             dropout=self.dropout, causal=False, attn_impl=self.attn_impl,
             pipeline=self.pipeline, pp_microbatches=self.pp_microbatches,
+            pp_virtual=self.pp_virtual,
             remat=self.remat, remat_policy=self.remat_policy,
         )
 
@@ -142,6 +150,7 @@ class TextConfig:
     attn_impl: AttnImpl = "auto"
     pipeline: bool = False
     pp_microbatches: int = 4
+    pp_virtual: int = 1
     remat: bool = False
     remat_policy: Literal["none", "dots"] = "none"
 
@@ -151,6 +160,7 @@ class TextConfig:
             mlp_dim=self.mlp_dim, act=self.act, ln_eps=self.ln_eps,
             dropout=self.dropout, causal=self.causal, attn_impl=self.attn_impl,
             pipeline=self.pipeline, pp_microbatches=self.pp_microbatches,
+            pp_virtual=self.pp_virtual,
             remat=self.remat, remat_policy=self.remat_policy,
         )
 

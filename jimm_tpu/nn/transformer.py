@@ -136,6 +136,11 @@ class Transformer(nnx.Module):
             return Block(cfg, rngs, dtype=dtype, param_dtype=param_dtype)
 
         self.blocks = create_block(rngs)
+        if cfg.pipeline and cfg.dropout > 0.0:
+            # persistent schedule-tick counter: offsets the per-tick dropout
+            # rng folding so masks differ across training steps (pipelined
+            # path only — rng mutations inside shard_map don't propagate)
+            self.pp_tick = nnx.Variable(jnp.zeros((), jnp.uint32))
 
     def _remat_policy(self):
         # "dots" keeps matmul outputs and recomputes only elementwise ops
@@ -164,7 +169,8 @@ class Transformer(nnx.Module):
         if not self.cfg.pipeline:
             return self._apply_stack(self.blocks, x)
 
-        from jimm_tpu.parallel.pipeline import pipeline_forward
+        from jimm_tpu.parallel.pipeline import (circular_layer_order,
+                                                pipeline_forward)
         from jimm_tpu.parallel.sharding import current_rules
 
         mesh = jax.sharding.get_abstract_mesh()
@@ -172,32 +178,64 @@ class Transformer(nnx.Module):
             raise ValueError("pipeline=True needs an ambient mesh with a "
                              "'stage' axis (use use_sharding(mesh, PIPELINE))")
         n_stage = dict(mesh.shape)["stage"]
-        if self.cfg.depth % n_stage:
+        n_virtual = self.cfg.pp_virtual
+        if self.cfg.depth % (n_stage * n_virtual):
             raise ValueError(f"depth {self.cfg.depth} not divisible by "
-                             f"{n_stage} pipeline stages")
-        if self.cfg.dropout > 0.0 and not self.blocks.dropout.deterministic:
-            # the pipelined stage loop merges layers inside a plain lax.scan
-            # and discards rng-state mutations — dropout masks would repeat
-            raise NotImplementedError("pipeline=True does not support active "
-                                      "dropout yet (eval mode is fine)")
+                             f"{n_stage} stages x {n_virtual} virtual chunks")
         rules = current_rules()
         batch_axis = rules.batch if rules is not None else None
         if isinstance(batch_axis, str) and batch_axis not in mesh.shape:
             batch_axis = None
         graphdef, state = nnx.split(self.blocks)
+        if n_virtual > 1:
+            # circular placement: device d's contiguous P("stage") shard must
+            # hold the interleaved blocks {v*n_stage + d}
+            order = circular_layer_order(self.cfg.depth, n_stage, n_virtual)
+            state = jax.tree.map(lambda p: p[order], state)
 
-        def stage_apply(state_local, xm):
+        dropout_active = (self.cfg.dropout > 0.0
+                          and not self.blocks.dropout.deterministic)
+        tick_offset = 0
+        if dropout_active:
+            # rng mutations inside shard_map/scan are discarded, so dropout
+            # draws fold the schedule tick into each layer's OWN key via the
+            # RngCount slot; the persistent step counter advances the offset
+            # so masks differ across training steps too.
+            t_total = self._pp_ticks(n_stage)
+            tick_offset = self.pp_tick[...]
+            self.pp_tick[...] = tick_offset + jnp.uint32(t_total)
+
+        def stage_apply(state_chunk, xm, tick):
             # plain lax.scan + per-layer merge (nnx.scan can't consume
             # modules whose arrays were introduced at the enclosing
             # shard_map trace level)
             def body(h, layer_state):
+                if dropout_active:
+                    layer_state = _set_rng_counts(layer_state, tick)
                 return nnx.merge(graphdef, layer_state)(h), None
 
             if self.cfg.remat:
                 body = jax.checkpoint(body, policy=self._remat_policy())
-            out, _ = jax.lax.scan(body, xm, state_local)
+            out, _ = jax.lax.scan(body, xm, state_chunk)
             return out
 
         return pipeline_forward(stage_apply, state, x,
                                 n_microbatches=self.cfg.pp_microbatches,
-                                batch_axis=batch_axis)
+                                n_virtual=n_virtual,
+                                batch_axis=batch_axis,
+                                tick_offset=tick_offset)
+
+    def _pp_ticks(self, n_stage: int) -> int:
+        m, v = self.cfg.pp_microbatches, self.cfg.pp_virtual
+        if v == 1:
+            return m + n_stage - 1
+        return (m // n_stage - 1) * v * n_stage + (v + 1) * n_stage - 1
+
+
+def _set_rng_counts(state, value) -> nnx.State:
+    """Functionally pin every RngCount in ``state`` to ``value`` — each
+    (layer key, tick) pair then draws a unique, deterministic dropout mask."""
+    flat = nnx.to_flat_state(state)
+    new = [(p, l.replace(jnp.asarray(value, jnp.uint32))
+            if isinstance(l, nnx.RngCount) else l) for p, l in flat]
+    return nnx.from_flat_state(new)

@@ -1,21 +1,35 @@
 """Pipeline parallelism: depth-sharded layer stacks with a microbatched
-collective-permute loop.
+collective-permute loop, GPipe or interleaved (circular-placement) schedule.
 
 Absent from the reference (its stack is a python ``nnx.Sequential``,
-ref `common/transformer.py:171-188` — SURVEY §2.3 marks PP absent). Here the
+ref `common/transformer.py:171-188` — SURVEY §2.3 marks PP absent). The
 encoder's parameters are already *stacked* with a leading ``layers`` axis, so
 pipelining is just another sharding of that axis: each device on the
-``stage`` mesh axis holds a contiguous block of layers, and microbatches
-circulate stage→stage over ICI via ``jax.lax.ppermute`` (the SPMD
-"pipelining via collective permute" pattern — no per-stage programs, one
-SPMD program).
+``stage`` mesh axis holds layer blocks, and microbatches circulate
+stage→stage over ICI via ``jax.lax.ppermute`` (the SPMD "pipelining via
+collective permute" pattern — no per-stage programs, one SPMD program).
 
-Schedule: GPipe-style fill-and-drain over ``M`` microbatches and ``S``
-stages: ``T = M + S - 1`` ticks; at tick ``t`` a device computes microbatch
-``t - stage`` (garbage outside the window — masked out at collection).
-Bubble fraction is ``(S-1)/T``; raise M to amortize. Differentiable
-end-to-end (`lax.scan` of `ppermute`), composes with remat inside each
-stage.
+Schedules (``n_virtual = V``):
+
+- ``V=1`` (GPipe fill-and-drain): device ``d`` holds layers
+  ``[d*L/S, (d+1)*L/S)``; ``T = M + S - 1`` ticks; bubble ``(S-1)/T``.
+- ``V>1`` (interleaved / circular placement, Megatron-style): device ``d``
+  holds the V NON-contiguous blocks ``{v*S + d}``, and each microbatch makes
+  V laps around the ring. Fill/drain cost stays one ring traversal while
+  compute per microbatch is spread over ``V*S`` ticks, so the bubble shrinks
+  to ``(S-1) / (V*M + (V+1)*S/V ...)`` ≈ ``(S-1)/(V*M)`` — V=2 roughly
+  halves it. Requires ``M % S == 0``.
+
+Scheduling identity (V>1): microbatch ``m = g*S + r`` is processed by device
+``d`` on lap ``v`` at tick ``t = g*V*S + v*S + r + d``. Given ``(t, d)`` the
+base-S/base-V decomposition of ``t - d`` recovers a unique ``(g, v, r)``, so
+every device computes at most one (microbatch, lap) per tick — the property
+that makes the whole schedule one ``lax.scan``.
+
+Each tick is passed to ``stage_apply`` so dropout can fold the tick into its
+rng stream (fresh masks per microbatch — see `nn/transformer.py`).
+Differentiable end-to-end (`lax.scan` of `ppermute`), composes with remat
+inside each stage.
 """
 
 from __future__ import annotations
@@ -24,56 +38,101 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from jax import shard_map
 
 
+def circular_layer_order(n_layers: int, n_stages: int, n_virtual: int
+                         ) -> np.ndarray:
+    """Permutation of the stacked ``layers`` axis that realizes circular
+    placement under contiguous ``P("stage")`` sharding: device ``d``'s
+    contiguous shard contains global blocks ``{v*n_stages + d}``."""
+    if n_layers % (n_stages * n_virtual):
+        raise ValueError(f"{n_layers} layers not divisible by "
+                         f"{n_stages} stages x {n_virtual} virtual chunks")
+    chunk = n_layers // (n_stages * n_virtual)
+    idx = []
+    for d in range(n_stages):
+        for v in range(n_virtual):
+            block = v * n_stages + d
+            idx.extend(range(block * chunk, (block + 1) * chunk))
+    return np.asarray(idx)
+
+
 def pipeline_forward(stage_apply: Callable, stage_params, x: jax.Array, *,
-                     n_microbatches: int, axis_name: str = "stage",
-                     mesh: Mesh | None = None,
-                     batch_axis: str | None = None) -> jax.Array:
+                     n_microbatches: int, n_virtual: int = 1,
+                     axis_name: str = "stage", mesh: Mesh | None = None,
+                     batch_axis: str | None = None,
+                     tick_offset: jax.Array | int = 0) -> jax.Array:
     """Run ``x`` through a depth-stacked stack pipelined over ``axis_name``.
 
     - ``stage_params``: pytree whose every leaf has a leading global
-      ``layers`` dim, sharded over ``axis_name`` (each device gets
-      ``layers / n_stages`` consecutive layers).
-    - ``stage_apply(local_params, xm)``: applies one device's local layers to
-      a microbatch (typically an ``nnx.merge`` + scan over the local stack).
+      ``layers`` dim, sharded over ``axis_name``. For ``n_virtual > 1`` the
+      layers must already be permuted by :func:`circular_layer_order`.
+    - ``stage_apply(chunk_params, xm, tick)``: applies one virtual chunk's
+      layers to a microbatch (typically an ``nnx.merge`` + scan over the
+      chunk); ``tick`` is the traced schedule tick (plus ``tick_offset``,
+      which callers advance per training step) for dropout rng folding.
     - ``x``: ``(B, ...)`` activations; ``B`` must divide by
       ``n_microbatches`` (times the ``batch_axis`` size if given).
     - ``batch_axis``: optional mesh axis the batch dim is sharded over
       (pipeline x data parallelism).
     """
-    M = n_microbatches
+    M, V = n_microbatches, n_virtual
     if M < 1:
         raise ValueError(f"n_microbatches must be >= 1, got {M}")
+    if V < 1:
+        raise ValueError(f"n_virtual must be >= 1, got {V}")
     x_spec = P(batch_axis) if batch_axis else P()
 
     def local(params_local, x_local):
         stage = jax.lax.axis_index(axis_name)
-        n_stage = jax.lax.axis_size(axis_name)
+        S = jax.lax.axis_size(axis_name)
         b = x_local.shape[0]
         if b % M:
             raise ValueError(f"local batch {b} not divisible by "
                              f"{M} microbatches")
+        if V > 1 and M % S:
+            raise ValueError(f"interleaved schedule needs microbatches {M} "
+                             f"divisible by {S} stages")
         micro = x_local.reshape(M, b // M, *x_local.shape[1:])
+        # chunked params: leading dim (V * layers_per_chunk) -> (V, chunk)
+        params_v = jax.tree.map(
+            lambda p: p.reshape(V, p.shape[0] // V, *p.shape[1:]),
+            params_local)
+
+        if V == 1:
+            t_total = M + S - 1
+            out_ticks = np.arange(M) + S - 1  # microbatch m exits at m+S-1
+        else:
+            k = M // S
+            t_total = (k - 1) * V * S + (V + 1) * S - 1
+            g, r = np.arange(M) // S, np.arange(M) % S
+            out_ticks = g * V * S + (V - 1) * S + r + S - 1
 
         def step(carry, t):
-            # stage 0 feeds fresh microbatches; later stages eat the ring
-            inp = jnp.where(stage == 0,
-                            micro[jnp.clip(t, 0, M - 1)], carry)
-            out = stage_apply(params_local, inp)
-            perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+            td = t - stage
+            q = jnp.floor_divide(td, S)
+            r = td - q * S  # in [0, S)
+            qc = jnp.maximum(q, 0)
+            v = jnp.remainder(qc, V)
+            g = jnp.floor_divide(qc, V)
+            # stage 0 injects microbatch g*S + r at the start of lap 0
+            m_inj = jnp.clip(g * S + r, 0, M - 1)
+            inject = (stage == 0) & (v == 0)
+            inp = jnp.where(inject, micro[m_inj], carry)
+            chunk = jax.tree.map(lambda p: p[v], params_v)
+            out = stage_apply(chunk, inp, t + tick_offset)
+            perm = [(i, (i + 1) % S) for i in range(S)]
             return jax.lax.ppermute(out, axis_name, perm), out
 
-        t_total = M + n_stage - 1
         _, outs = jax.lax.scan(step, jnp.zeros_like(micro[0]),
                                jnp.arange(t_total))
-        # the last stage emits microbatch m at tick m + n_stage - 1
-        window = outs[n_stage - 1:]  # (M, b/M, ...) static slice
-        window = jnp.where(stage == n_stage - 1, window,
-                           jnp.zeros_like(window))
+        # the last stage holds microbatch m's final output at out_ticks[m]
+        window = outs[jnp.asarray(out_ticks)]  # (M, b/M, ...)
+        window = jnp.where(stage == S - 1, window, jnp.zeros_like(window))
         result = jax.lax.psum(window, axis_name)
         return result.reshape(b, *x_local.shape[1:])
 

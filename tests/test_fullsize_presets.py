@@ -1,0 +1,139 @@
+"""Full-size preset load proofs (VERDICT r1 item #3).
+
+Round-1 parity evidence used tiny random-init oracles only; these build the
+REAL-dimension checkpoints for each family's largest/oddest preset offline
+(random init — no network), then prove the full surface:
+
+    HF torch checkpoint -> from_pretrained -> forward parity (fp32)
+      -> save_pretrained -> reload -> identical forward
+
+Covered presets (reference anchor: the ref's tests load real ViT-L/14,
+`tests/test_clip.py:10`):
+- clip-vit-large-patch14-336 (the ref's tested scale, at 336px)
+- siglip-so400m-patch14-384  (non-4x MLP 1152->4304 — unloadable in the ref,
+  SURVEY §2.4)
+- siglip2-large-patch16-512  (256k-token Gemma vocab, 1024-patch grid)
+
+Marked slow: each builds a multi-GB checkpoint and runs a full-size forward
+on CPU. Memory/disk stay bounded by one family at a time (function-scoped
+tmp dirs).
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jimm_tpu import CLIP, SigLIP, preset
+
+from hf_util import torch_image
+
+pytestmark = pytest.mark.slow
+
+ATOL = 2e-3  # fp32 end-to-end at depth 24-27 / seq up to 1025
+
+
+def _check_roundtrip(model_cls, src_dir, out_dir, ours, inputs):
+    """save_pretrained -> reload -> bitwise-close forward."""
+    ours.save_pretrained(out_dir)
+    again = model_cls.from_pretrained(str(out_dir), dtype=jnp.float32)
+    a = np.asarray(ours(*inputs))
+    b = np.asarray(again(*inputs))
+    np.testing.assert_allclose(b, a, atol=1e-5)
+
+
+def test_clip_vit_large_patch14_336(tmp_path, rng):
+    import torch
+    from transformers import CLIPConfig, CLIPModel
+
+    ref_cfg = preset("clip-vit-large-patch14-336")
+    hf = CLIPConfig(
+        vision_config=dict(hidden_size=1024, num_hidden_layers=24,
+                           num_attention_heads=16, intermediate_size=4096,
+                           image_size=336, patch_size=14),
+        text_config=dict(hidden_size=768, num_hidden_layers=12,
+                         num_attention_heads=12, intermediate_size=3072,
+                         vocab_size=49408, max_position_embeddings=77,
+                         eos_token_id=2),  # legacy id, like the real ckpt
+        projection_dim=768)
+    oracle = CLIPModel(hf).eval()
+    oracle.save_pretrained(tmp_path / "src", safe_serialization=True)
+
+    model = CLIP.from_pretrained(str(tmp_path / "src"), dtype=jnp.float32)
+    # config inference must reproduce the preset's dimensions
+    assert model.config.vision == dataclasses.replace(
+        ref_cfg.vision, attn_impl=model.config.vision.attn_impl)
+    img = rng.randn(1, 336, 336, 3).astype(np.float32)
+    txt = rng.randint(1, 49000, size=(1, 77))
+    txt[0, 60] = 49407  # EOT = max id (legacy argmax pooling)
+    with torch.no_grad():
+        ref = oracle(input_ids=torch.tensor(txt),
+                     pixel_values=torch_image(img)).logits_per_image.numpy()
+    got = np.asarray(model(jnp.asarray(img), jnp.asarray(txt)))
+    np.testing.assert_allclose(got, ref, atol=ATOL)
+    del oracle
+    _check_roundtrip(CLIP, tmp_path / "src", tmp_path / "out", model,
+                     (jnp.asarray(img), jnp.asarray(txt)))
+
+
+def test_siglip_so400m_patch14_384(tmp_path, rng):
+    import torch
+    from transformers import SiglipConfig, SiglipModel
+
+    ref_cfg = preset("siglip-so400m-patch14-384")
+    hf = SiglipConfig(
+        vision_config=dict(hidden_size=1152, num_hidden_layers=27,
+                           num_attention_heads=16, intermediate_size=4304,
+                           image_size=384, patch_size=14),
+        text_config=dict(hidden_size=1152, num_hidden_layers=27,
+                         num_attention_heads=16, intermediate_size=4304,
+                         vocab_size=32000, max_position_embeddings=64))
+    oracle = SiglipModel(hf).eval()
+    oracle.save_pretrained(tmp_path / "src", safe_serialization=True)
+
+    model = SigLIP.from_pretrained(str(tmp_path / "src"), dtype=jnp.float32)
+    assert model.config.vision.mlp_dim == 4304  # the non-4x ratio loads
+    assert model.config.vision == dataclasses.replace(
+        ref_cfg.vision, attn_impl=model.config.vision.attn_impl)
+    img = rng.randn(1, 384, 384, 3).astype(np.float32)
+    txt = rng.randint(1, 32000, size=(1, 64))
+    with torch.no_grad():
+        ref = oracle(input_ids=torch.tensor(txt),
+                     pixel_values=torch_image(img)).logits_per_image.numpy()
+    got = np.asarray(model(jnp.asarray(img), jnp.asarray(txt)))
+    np.testing.assert_allclose(got, ref, atol=ATOL)
+    del oracle
+    _check_roundtrip(SigLIP, tmp_path / "src", tmp_path / "out", model,
+                     (jnp.asarray(img), jnp.asarray(txt)))
+
+
+def test_siglip2_large_patch16_512(tmp_path, rng):
+    import torch
+    from transformers import SiglipConfig, SiglipModel
+
+    ref_cfg = preset("siglip2-large-patch16-512")
+    hf = SiglipConfig(
+        vision_config=dict(hidden_size=1024, num_hidden_layers=24,
+                           num_attention_heads=16, intermediate_size=4096,
+                           image_size=512, patch_size=16),
+        text_config=dict(hidden_size=1024, num_hidden_layers=24,
+                         num_attention_heads=16, intermediate_size=4096,
+                         vocab_size=256000, max_position_embeddings=64))
+    oracle = SiglipModel(hf).eval()
+    oracle.save_pretrained(tmp_path / "src", safe_serialization=True)
+
+    model = SigLIP.from_pretrained(str(tmp_path / "src"), dtype=jnp.float32)
+    assert model.config.text.vocab_size == 256000
+    assert model.config.vision == dataclasses.replace(
+        ref_cfg.vision, attn_impl=model.config.vision.attn_impl)
+    img = rng.randn(1, 512, 512, 3).astype(np.float32)
+    txt = rng.randint(1, 256000, size=(1, 64))
+    with torch.no_grad():
+        ref = oracle(input_ids=torch.tensor(txt),
+                     pixel_values=torch_image(img)).logits_per_image.numpy()
+    got = np.asarray(model(jnp.asarray(img), jnp.asarray(txt)))
+    np.testing.assert_allclose(got, ref, atol=ATOL)
+    del oracle
+    _check_roundtrip(SigLIP, tmp_path / "src", tmp_path / "out", model,
+                     (jnp.asarray(img), jnp.asarray(txt)))

@@ -28,7 +28,7 @@ def test_functional_core_matches_sequential(rng, pp_mesh):
             x = jnp.tanh(x @ w[i])
         return x
 
-    def stage_apply(w_local, xm):
+    def stage_apply(w_local, xm, tick):
         return jax.lax.scan(lambda h, wi: (jnp.tanh(h @ wi), None),
                             xm, w_local)[0]
 
@@ -43,10 +43,78 @@ def test_functional_core_matches_sequential(rng, pp_mesh):
     np.testing.assert_allclose(gp, gr, atol=1e-5)
 
 
-def _towers(pipeline: bool):
+@pytest.mark.parametrize("n_virtual,n_micro", [(2, 4), (2, 8), (4, 4)])
+def test_functional_core_interleaved_matches_sequential(rng, pp_mesh,
+                                                        n_virtual, n_micro):
+    """Circular-placement (interleaved) schedule == plain sequential stack,
+    values and gradients, across virtual-chunk/microbatch shapes."""
+    from jimm_tpu.parallel.pipeline import circular_layer_order
+    S, L, H, B = 4, 16, 16, 16
+    w = jnp.asarray(rng.randn(L, H, H).astype(np.float32) * 0.2)
+    x = jnp.asarray(rng.randn(B, H).astype(np.float32))
+
+    def ref(w, x):
+        for i in range(L):
+            x = jnp.tanh(x @ w[i])
+        return x
+
+    def stage_apply(w_local, xm, tick):
+        return jax.lax.scan(lambda h, wi: (jnp.tanh(h @ wi), None),
+                            xm, w_local)[0]
+
+    order = circular_layer_order(L, S, n_virtual)
+
+    def run(w):
+        return pipeline_forward(stage_apply, w[order], x,
+                                n_microbatches=n_micro, n_virtual=n_virtual,
+                                batch_axis="data")
+
+    with jax.set_mesh(pp_mesh):
+        out = run(w)
+        gp = jax.grad(lambda w: (run(w) ** 2).mean())(w)
+    np.testing.assert_allclose(out, ref(w, x), atol=1e-5)
+    gr = jax.grad(lambda w: (ref(w, x) ** 2).mean())(w)
+    np.testing.assert_allclose(gp, gr, atol=1e-5)
+
+
+def _towers(pipeline: bool, **kw):
     cfg = TransformerConfig(width=32, depth=8, num_heads=2, mlp_dim=64,
-                            pipeline=pipeline, pp_microbatches=2)
+                            pipeline=pipeline, pp_microbatches=2, **kw)
     return Transformer(cfg, nnx.Rngs(0))
+
+
+def test_transformer_interleaved_matches_plain(rng, pp_mesh):
+    """pp_virtual=2 over 4 stages: circular placement at the module level."""
+    x = jnp.asarray(rng.randn(8, 12, 32).astype(np.float32))
+    ref = np.asarray(_towers(False)(x))
+    pp = _towers(True, pp_virtual=2, pp_microbatches=4)
+    with use_sharding(pp_mesh, PIPELINE):
+        out = np.asarray(pp(x))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_transformer_pipeline_dropout(rng, pp_mesh):
+    """Active dropout in the pipelined path: fresh masks per microbatch and
+    per step (VERDICT r1: PP was eval-biased)."""
+    x = jnp.asarray(rng.randn(8, 12, 32).astype(np.float32))
+    cfg = TransformerConfig(width=32, depth=8, num_heads=2, mlp_dim=64,
+                            dropout=0.5, pipeline=True, pp_microbatches=2)
+    pp = Transformer(cfg, nnx.Rngs(0))
+    pp.blocks.dropout.deterministic = False
+    with use_sharding(pp_mesh, PIPELINE):
+        a = np.asarray(pp(x))
+        b = np.asarray(pp(x))
+    # dropout is active (output differs from eval) and re-randomizes per call
+    pp.blocks.dropout.deterministic = True
+    with use_sharding(pp_mesh, PIPELINE):
+        ev = np.asarray(pp(x))
+    assert np.abs(a - ev).max() > 1e-3
+    assert np.abs(a - b).max() > 1e-3, "masks must differ across steps"
+    assert np.isfinite(a).all() and np.isfinite(b).all()
+    # microbatches must not share masks: batch rows land in different
+    # microbatches, so per-row deviation from eval must not be identical
+    dev = np.abs(a - ev).mean(axis=(1, 2))
+    assert dev.std() > 1e-5
 
 
 def test_transformer_pipeline_matches_plain(rng, pp_mesh):
