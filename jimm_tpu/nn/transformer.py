@@ -100,6 +100,11 @@ class Mlp(nnx.Module):
         return self.fc2(self.act(self.fc1(x)))
 
 
+#: dropout-stream draws per Block.__call__ (attn residual + mlp residual);
+#: the pipelined path strides its pinned RngCounts by this
+_BLOCK_DROPOUT_DRAWS = 2
+
+
 class Block(nnx.Module):
     """Pre-LN residual block (ref `common/transformer.py:116-132`)."""
 
@@ -143,11 +148,15 @@ class Transformer(nnx.Module):
             self.pp_tick = nnx.Variable(jnp.zeros((), jnp.uint32))
 
     def _remat_policy(self):
-        # "dots" keeps matmul outputs and recomputes only elementwise ops
-        # in the backward — far cheaper than full remat at slightly more
-        # memory; "none" is classic full rematerialization.
+        # "dots" keeps weight-matmul outputs (NOT the batched qk/pv dots —
+        # saving S^2 attention probabilities is pure HBM waste) plus the
+        # flash kernel's o/lse residuals, so the backward recomputes only
+        # elementwise ops; "none" is classic full rematerialization.
         if self.cfg.remat_policy == "dots":
-            return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            return jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_o", "flash_lse"))
         if self.cfg.remat_policy == "none":
             return None
         raise ValueError(f"unknown remat_policy {self.cfg.remat_policy!r}; "
@@ -201,7 +210,8 @@ class Transformer(nnx.Module):
             # draws fold the schedule tick into each layer's OWN key via the
             # RngCount slot; the persistent step counter advances the offset
             # so masks differ across training steps too.
-            t_total = self._pp_ticks(n_stage)
+            from jimm_tpu.parallel.pipeline import num_ticks
+            t_total = num_ticks(self.cfg.pp_microbatches, n_stage, n_virtual)
             tick_offset = self.pp_tick[...]
             self.pp_tick[...] = tick_offset + jnp.uint32(t_total)
 
@@ -211,7 +221,11 @@ class Transformer(nnx.Module):
             # shard_map trace level)
             def body(h, layer_state):
                 if dropout_active:
-                    layer_state = _set_rng_counts(layer_state, tick)
+                    # a Block consumes _BLOCK_DROPOUT_DRAWS counts per call,
+                    # so stride the pinned count — otherwise tick t's last
+                    # draw equals tick t+1's first and masks repeat shifted
+                    layer_state = _set_rng_counts(
+                        layer_state, tick * _BLOCK_DROPOUT_DRAWS)
                 return nnx.merge(graphdef, layer_state)(h), None
 
             if self.cfg.remat:
@@ -224,12 +238,6 @@ class Transformer(nnx.Module):
                                 n_virtual=n_virtual,
                                 batch_axis=batch_axis,
                                 tick_offset=tick_offset)
-
-    def _pp_ticks(self, n_stage: int) -> int:
-        m, v = self.cfg.pp_microbatches, self.cfg.pp_virtual
-        if v == 1:
-            return m + n_stage - 1
-        return (m // n_stage - 1) * v * n_stage + (v + 1) * n_stage - 1
 
 
 def _set_rng_counts(state, value) -> nnx.State:

@@ -33,6 +33,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -74,11 +75,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         acc_scr[...] = jnp.zeros((bq, d), jnp.float32)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale  # (bq, d)
-        k = k_ref[0].astype(jnp.float32)             # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
+        # q/k stay in their storage dtype (bf16) so the MXU runs at full
+        # bf16 rate with fp32 accumulation; the softmax scale is applied to
+        # the fp32 logits AFTER the dot (pre-scaling q in bf16 would round)
+        q = q_ref[0]                                 # (bq, d)
+        k = k_ref[0]                                 # (bk, d)
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * sm_scale
         k_pos = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1)
         mask = k_pos < sk_real
@@ -94,7 +98,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=1)
         acc_scr[...] = acc_scr[...] * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = _bcast_lanes(m_new)
         l_scr[...] = _bcast_lanes(l_new)
@@ -135,14 +139,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[...] = jnp.zeros((bq, d), jnp.float32)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0, :]
         delta = delta_ref[0, 0, :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * sm_scale
         k_pos = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1)
         mask = k_pos < sk_real
@@ -155,8 +159,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        dq_scr[...] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                           preferred_element_type=jnp.float32)
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     if causal:
         pl.when(kj * block_k <= (qi + 1) * bq - 1)(compute)
@@ -181,14 +186,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[...] = jnp.zeros((bk, d), jnp.float32)
 
     def compute():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        do = do_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0, :]
         delta = delta_ref[0, 0, :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * sm_scale
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, bk), 0)
         mask = q_pos < sq_real
@@ -198,15 +203,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             mask = mask & (k_pos <= q_pos)
         s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv_scr[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                           preferred_element_type=jnp.float32)
+        # dv's MXU input is a rounded copy; ds keeps the fp32 p (matching
+        # the dq kernel) so dk isn't computed from a double-rounded p
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        # q was pre-scaled by sm_scale, so ds.T @ q already carries the
-        # chain-rule factor for dk — no extra scaling at finalize
-        dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                           preferred_element_type=jnp.float32)
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     if causal:
         # q blocks whose last row is left of this kv block never land
@@ -216,7 +223,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi == n_q - 1)
     def _finalize():
-        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        # ds was accumulated unscaled; the chain-rule sm_scale lands here
+        dk_ref[0] = (dk_scr[...] * sm_scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
@@ -284,7 +292,13 @@ def _flash_fwd_impl(q3, k3, v3, causal, sm_scale, block_q, block_k):
         compiler_params=_SEMANTICS,
         interpret=_interpret(),
     )(qp, kp, vp)
-    return o[:, :sq], (q3, k3, v3, o[:, :sq], lse[:, 0, :sq])
+    # the names make o/lse saveable by remat policies (`"dots"` in
+    # `Transformer._remat_policy` saves them): jax.checkpoint traces through
+    # custom_vjp fwd rules, and without a saveable mark the whole forward
+    # kernel would re-run inside the backward pass of a remat'd layer
+    o = checkpoint_name(o[:, :sq], "flash_o")
+    lse = checkpoint_name(lse[:, 0, :sq], "flash_lse")
+    return o, (q3, k3, v3, o, lse)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

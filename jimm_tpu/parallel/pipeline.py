@@ -61,6 +61,15 @@ def circular_layer_order(n_layers: int, n_stages: int, n_virtual: int
     return np.asarray(idx)
 
 
+def num_ticks(n_microbatches: int, n_stages: int, n_virtual: int = 1) -> int:
+    """Schedule length in ticks — the single source of truth shared by the
+    scan below and the dropout tick counter (`Transformer._pp_ticks`)."""
+    m, s, v = n_microbatches, n_stages, n_virtual
+    if v == 1:
+        return m + s - 1
+    return (m // s - 1) * v * s + (v + 1) * s - 1
+
+
 def pipeline_forward(stage_apply: Callable, stage_params, x: jax.Array, *,
                      n_microbatches: int, n_virtual: int = 1,
                      axis_name: str = "stage", mesh: Mesh | None = None,
@@ -103,12 +112,10 @@ def pipeline_forward(stage_apply: Callable, stage_params, x: jax.Array, *,
             lambda p: p.reshape(V, p.shape[0] // V, *p.shape[1:]),
             params_local)
 
+        t_total = num_ticks(M, S, V)
         if V == 1:
-            t_total = M + S - 1
             out_ticks = np.arange(M) + S - 1  # microbatch m exits at m+S-1
         else:
-            k = M // S
-            t_total = (k - 1) * V * S + (V + 1) * S - 1
             g, r = np.arange(M) // S, np.arange(M) % S
             out_ticks = g * V * S + (V - 1) * S + r + S - 1
 

@@ -1,0 +1,136 @@
+"""Microbenchmarks for the SigLIP-B/16-256 training hot path on one chip.
+
+Times each candidate attention implementation (fwd+bwd) at the bench shapes,
+and the full train step under each remat policy, to locate where the MFU
+gap vs the 50% target comes from. Not part of the test suite — a tuning tool.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters=20, warmup=3):
+    # chain iterations through a data dependency and end with a host
+    # materialization: on the tunneled TPU platform block_until_ready can
+    # return before execution, so independent calls overlap/under-measure
+    def chained(args, n):
+        def body(args, _):
+            out = fn(*args)
+            q = args[0] + 1e-6 * out[0].astype(args[0].dtype)
+            return (q,) + tuple(args[1:]), None
+        args, _ = jax.lax.scan(body, args, None, length=n)
+        return args
+
+    chained = jax.jit(chained, static_argnums=1)
+    # warm up with the SAME n — static_argnums means a different scan length
+    # is a different executable, and compile time would pollute the timing
+    float(jnp.sum(chained(args, iters)[0].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    float(jnp.sum(chained(args, iters)[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_attention():
+    from jimm_tpu.ops.attention import reference_attention
+    from jimm_tpu.ops.flash_attention import flash_attention
+
+    B, S, N, D = 128, 256, 12, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S, N, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, S, N, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, S, N, D), jnp.bfloat16)
+
+    # fwd+bwd FLOPs for attention proper: fwd 4*B*N*S^2*D, bwd ~2.5x
+    flops = 3.5 * 4 * B * N * S * S * D
+
+    def loss_of(attn, **kw):
+        def f(q, k, v):
+            return jnp.sum(attn(q, k, v, **kw).astype(jnp.float32))
+        return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+    impls = {
+        "flash(bq=128,bk=128)": loss_of(flash_attention),
+        "flash(bq=256,bk=256)": loss_of(flash_attention, block_q=256,
+                                        block_k=256),
+        "xla_dpa": loss_of(
+            lambda q, k, v: jax.nn.dot_product_attention(q, k, v)),
+        "reference": loss_of(reference_attention),
+    }
+    for name, fn in impls.items():
+        dt = timeit(fn, q, k, v)
+        print(f"  attn fwd+bwd {name:24s} {dt*1e3:8.2f} ms  "
+              f"{flops/dt/1e12:6.2f} TF/s")
+
+
+def bench_train_step(remat: str, attn_impl: str, batch: int = 128):
+    import dataclasses
+
+    from flax import nnx
+
+    from jimm_tpu import SigLIP, preset
+    from jimm_tpu.train import (OptimizerConfig, make_contrastive_train_step,
+                                make_optimizer, mfu)
+    from jimm_tpu.train.metrics import train_step_flops
+
+    cfg = preset("siglip-base-patch16-256")
+    do_remat = remat != "none"
+    policy = remat if remat in ("dots", "none") else "none"
+    if remat == "full":
+        policy = "none"
+    cfg = dataclasses.replace(
+        cfg,
+        vision=dataclasses.replace(cfg.vision, remat=do_remat,
+                                   remat_policy=policy if do_remat else "none",
+                                   attn_impl=attn_impl),
+        text=dataclasses.replace(cfg.text, remat=do_remat,
+                                 remat_policy=policy if do_remat else "none",
+                                 attn_impl=attn_impl))
+    model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
+                   param_dtype=jnp.bfloat16)
+    optimizer = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
+    step_fn = make_contrastive_train_step("siglip", donate=True)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(batch, 256, 256, 3), jnp.bfloat16)
+    text = jnp.asarray(rng.randint(1, cfg.text.vocab_size, size=(batch, 64)),
+                       jnp.int32)
+    for _ in range(3):
+        m = step_fn(model, optimizer, images, text)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    steps = 20
+    for _ in range(steps):
+        m = step_fn(model, optimizer, images, text)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    flops = train_step_flops(cfg, batch)
+    print(f"  train remat={remat:5s} attn={attn_impl:9s} b={batch:4d} "
+          f"{dt*1e3:8.2f} ms  {batch/dt:7.1f} img/s  mfu={mfu(flops, dt, 1):.3f}")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", default="all",
+                   choices=["all", "attn", "train"])
+    p.add_argument("--remat", default=None)
+    p.add_argument("--attn", default=None)
+    p.add_argument("--batch", type=int, default=128)
+    args = p.parse_args()
+    print("backend:", jax.default_backend(), jax.devices()[0].device_kind)
+    if args.mode in ("all", "attn"):
+        bench_attention()
+    if args.mode in ("all", "train"):
+        remats = [args.remat] if args.remat else ["dots", "none", "full"]
+        attns = [args.attn] if args.attn else ["flash", "xla"]
+        for r in remats:
+            for a in attns:
+                bench_train_step(r, a, args.batch)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Capture a jax.profiler trace of the SigLIP train step on TPU and print the
+top ops by self-time (via tensorboard_plugin_profile's xplane converter).
+
+Usage: python -m scripts.profile_step [--attn xla] [--remat dots] [--top 25]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import glob
+import json
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--attn", default="xla")
+    p.add_argument("--remat", default="dots")
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--top", type=int, default=25)
+    p.add_argument("--dir", default="/tmp/jimm_profile")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import SigLIP, preset
+    from jimm_tpu.train import (OptimizerConfig, make_contrastive_train_step,
+                                make_optimizer)
+
+    cfg = preset("siglip-base-patch16-256")
+    do_remat = args.remat != "none"
+    policy = "dots" if args.remat == "dots" else "none"
+    cfg = dataclasses.replace(
+        cfg,
+        vision=dataclasses.replace(cfg.vision, remat=do_remat,
+                                   remat_policy=policy, attn_impl=args.attn),
+        text=dataclasses.replace(cfg.text, remat=do_remat,
+                                 remat_policy=policy, attn_impl=args.attn))
+    model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
+                   param_dtype=jnp.bfloat16)
+    optimizer = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
+    step_fn = make_contrastive_train_step("siglip", donate=True)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(args.batch, 256, 256, 3), jnp.bfloat16)
+    text = jnp.asarray(rng.randint(1, cfg.text.vocab_size,
+                                   size=(args.batch, 64)), jnp.int32)
+    for _ in range(3):
+        m = step_fn(model, optimizer, images, text)
+    float(m["loss"])
+
+    jax.profiler.start_trace(args.dir)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        m = step_fn(model, optimizer, images, text)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / args.steps
+    jax.profiler.stop_trace()
+    print(f"step time {dt*1e3:.1f} ms ({args.batch/dt:.0f} img/s)")
+
+    analyze(args.dir, args.top)
+
+
+def analyze(log_dir: str, top: int) -> None:
+    from tensorboard_plugin_profile.convert import raw_to_tool_data
+
+    xplanes = sorted(glob.glob(
+        f"{log_dir}/**/*.xplane.pb", recursive=True))
+    xplane = xplanes[-1]
+    data, _ = raw_to_tool_data.xspace_to_tool_data(
+        [xplane], "framework_op_stats", params={})
+    if isinstance(data, bytes):
+        data = data.decode()
+    stats = json.loads(data)
+    # gviz table: first entry has cols/rows
+    table = stats[0]
+    cols = [c["label"] for c in table["cols"]]
+    rows = [[c["v"] for c in r["c"]] for r in table["rows"]]
+    i_name = cols.index("Operation")
+    i_self = cols.index("Total self time (us)")
+    i_occ = cols.index("#Occurrences")
+    i_type = cols.index("Type")
+    rows.sort(key=lambda r: -float(r[i_self]))
+    total = sum(float(r[i_self]) for r in rows)
+    print(f"\ntotal device self time: {total/1e3:.1f} ms; top {top} ops:")
+    print(f"{'%':>6s} {'ms':>9s} {'n':>5s}  {'type':22s} name")
+    for r in rows[:top]:
+        pct = 100 * float(r[i_self]) / total
+        print(f"{pct:6.2f} {float(r[i_self])/1e3:9.2f} {int(r[i_occ]):5d}  "
+              f"{str(r[i_type])[:22]:22s} {str(r[i_name])[:90]}")
+
+
+if __name__ == "__main__":
+    main()

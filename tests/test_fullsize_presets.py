@@ -34,7 +34,7 @@ pytestmark = pytest.mark.slow
 ATOL = 2e-3  # fp32 end-to-end at depth 24-27 / seq up to 1025
 
 
-def _check_roundtrip(model_cls, src_dir, out_dir, ours, inputs):
+def _check_roundtrip(model_cls, out_dir, ours, inputs):
     """save_pretrained -> reload -> bitwise-close forward."""
     ours.save_pretrained(out_dir)
     again = model_cls.from_pretrained(str(out_dir), dtype=jnp.float32)
@@ -73,7 +73,7 @@ def test_clip_vit_large_patch14_336(tmp_path, rng):
     got = np.asarray(model(jnp.asarray(img), jnp.asarray(txt)))
     np.testing.assert_allclose(got, ref, atol=ATOL)
     del oracle
-    _check_roundtrip(CLIP, tmp_path / "src", tmp_path / "out", model,
+    _check_roundtrip(CLIP, tmp_path / "out", model,
                      (jnp.asarray(img), jnp.asarray(txt)))
 
 
@@ -104,7 +104,7 @@ def test_siglip_so400m_patch14_384(tmp_path, rng):
     got = np.asarray(model(jnp.asarray(img), jnp.asarray(txt)))
     np.testing.assert_allclose(got, ref, atol=ATOL)
     del oracle
-    _check_roundtrip(SigLIP, tmp_path / "src", tmp_path / "out", model,
+    _check_roundtrip(SigLIP, tmp_path / "out", model,
                      (jnp.asarray(img), jnp.asarray(txt)))
 
 
@@ -135,5 +135,5 @@ def test_siglip2_large_patch16_512(tmp_path, rng):
     got = np.asarray(model(jnp.asarray(img), jnp.asarray(txt)))
     np.testing.assert_allclose(got, ref, atol=ATOL)
     del oracle
-    _check_roundtrip(SigLIP, tmp_path / "src", tmp_path / "out", model,
+    _check_roundtrip(SigLIP, tmp_path / "out", model,
                      (jnp.asarray(img), jnp.asarray(txt)))

@@ -78,8 +78,9 @@ def test_functional_core_interleaved_matches_sequential(rng, pp_mesh,
 
 
 def _towers(pipeline: bool, **kw):
+    kw.setdefault("pp_microbatches", 2)
     cfg = TransformerConfig(width=32, depth=8, num_heads=2, mlp_dim=64,
-                            pipeline=pipeline, pp_microbatches=2, **kw)
+                            pipeline=pipeline, **kw)
     return Transformer(cfg, nnx.Rngs(0))
 
 
