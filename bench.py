@@ -36,6 +36,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--remat", choices=["none", "full", "dots"], default="dots",
                    help="activation rematerialization inside the layer scan")
+    p.add_argument("--unroll", type=int, default=12,
+                   help="layer-scan unroll factor (12 = full for ViT-B: XLA "
+                        "fuses the stacked-grad updates, ~+5 MFU points)")
     p.add_argument("--no-donate", action="store_true",
                    help="disable model/optimizer buffer donation")
     p.add_argument("--timeout", type=int,
@@ -171,9 +174,11 @@ def child_main(args: argparse.Namespace) -> int:
             cfg,
             vision=dataclasses.replace(cfg.vision, remat=remat,
                                        remat_policy=policy,
-                                       attn_impl="flash"),
+                                       attn_impl="auto",
+                                       scan_unroll=args.unroll),
             text=dataclasses.replace(cfg.text, remat=remat,
-                                     remat_policy=policy))
+                                     remat_policy=policy,
+                                     scan_unroll=args.unroll))
     else:  # smoke-test shape so the script runs anywhere
         cfg = SigLIPConfig(
             vision=VisionConfig(image_size=32, patch_size=16, width=64,

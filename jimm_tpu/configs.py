@@ -60,12 +60,22 @@ class TransformerConfig:
     #: (`jimm_tpu/parallel/pipeline.py`). Needs depth % (stages*virtual) == 0
     #: and (for >1) pp_microbatches % stages == 0.
     pp_virtual: int = 1
+    #: Known pipeline-stage count. With ``pp_virtual > 1`` and this set, the
+    #: stacked blocks are STORED in circular schedule order from
+    #: construction (loaders/exporters reorder at the stacking edge), so the
+    #: forward avoids re-permuting — a cross-stage all-to-all — every step.
+    #: 0 = unknown: the forward permutes per call (correct, slower).
+    pp_stages: int = 0
     remat: bool = False
     #: What the backward pass may keep from the forward when ``remat`` is on:
     #: "none" recomputes everything (min memory, ~1/3 extra FLOPs); "dots"
     #: saves matmul outputs and recomputes only cheap elementwise ops
     #: (ln/act/softmax) — the usual best MFU/memory trade on TPU.
     remat_policy: Literal["none", "dots"] = "none"
+    #: `lax.scan` unroll factor for the layer loop. >1 trades compile time
+    #: for schedule freedom: XLA turns the per-layer stacked-gradient
+    #: dynamic-update-slices into statically-indexed updates it can fuse.
+    scan_unroll: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -100,8 +110,10 @@ class VisionConfig:
     pipeline: bool = False
     pp_microbatches: int = 4
     pp_virtual: int = 1
+    pp_stages: int = 0
     remat: bool = False
     remat_policy: Literal["none", "dots"] = "none"
+    scan_unroll: int = 1
 
     @property
     def grid(self) -> int:
@@ -121,8 +133,9 @@ class VisionConfig:
             mlp_dim=self.mlp_dim, act=self.act, ln_eps=self.ln_eps,
             dropout=self.dropout, causal=False, attn_impl=self.attn_impl,
             pipeline=self.pipeline, pp_microbatches=self.pp_microbatches,
-            pp_virtual=self.pp_virtual,
+            pp_virtual=self.pp_virtual, pp_stages=self.pp_stages,
             remat=self.remat, remat_policy=self.remat_policy,
+            scan_unroll=self.scan_unroll,
         )
 
 
@@ -151,8 +164,10 @@ class TextConfig:
     pipeline: bool = False
     pp_microbatches: int = 4
     pp_virtual: int = 1
+    pp_stages: int = 0
     remat: bool = False
     remat_policy: Literal["none", "dots"] = "none"
+    scan_unroll: int = 1
 
     def encoder(self) -> TransformerConfig:
         return TransformerConfig(
@@ -160,8 +175,9 @@ class TextConfig:
             mlp_dim=self.mlp_dim, act=self.act, ln_eps=self.ln_eps,
             dropout=self.dropout, causal=self.causal, attn_impl=self.attn_impl,
             pipeline=self.pipeline, pp_microbatches=self.pp_microbatches,
-            pp_virtual=self.pp_virtual,
+            pp_virtual=self.pp_virtual, pp_stages=self.pp_stages,
             remat=self.remat, remat_policy=self.remat_policy,
+            scan_unroll=self.scan_unroll,
         )
 
 

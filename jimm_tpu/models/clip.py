@@ -21,7 +21,8 @@ from jimm_tpu.nn.text import TextTower
 from jimm_tpu.nn.vision import VisionTower
 from jimm_tpu.parallel.sharding import (ShardingRules, TENSOR_PARALLEL,
                                         logical, shard_model)
-from jimm_tpu.weights.loader import M, T, apply_mapping
+from jimm_tpu.weights.loader import (M, T, apply_mapping,
+                                    layer_orders)
 from jimm_tpu.weights.resolve import resolve_checkpoint
 
 
@@ -203,7 +204,7 @@ class CLIP(nnx.Module):
         apply_mapping(model, weights, cls.hf_mapping(cfg),
                       num_layers=cfg.vision.depth,
                       num_layers_by_prefix={"text.": cfg.text.depth},
-                      param_dtype=param_dtype)
+                      param_dtype=param_dtype, layer_order=layer_orders(cfg))
         return model
 
     # ------------------------------------------------------------------

@@ -21,7 +21,8 @@ from jimm_tpu.configs import VisionConfig, ViTConfig, act_to_hf, normalize_act
 from jimm_tpu.nn.vision import VisionTower
 from jimm_tpu.parallel.sharding import (ShardingRules, TENSOR_PARALLEL, logical,
                                         shard_model)
-from jimm_tpu.weights.loader import M, T, apply_mapping
+from jimm_tpu.weights.loader import (M, T, apply_mapping,
+                                    layer_orders)
 from jimm_tpu.weights.resolve import resolve_checkpoint
 
 
@@ -160,7 +161,8 @@ class VisionTransformer(nnx.Module):
         model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,
                     param_dtype=param_dtype)
         apply_mapping(model, weights, cls.hf_mapping(cfg),
-                      num_layers=cfg.vision.depth, param_dtype=param_dtype)
+                      num_layers=cfg.vision.depth, param_dtype=param_dtype,
+                      layer_order=layer_orders(cfg))
         return model
 
     # ------------------------------------------------------------------

@@ -141,6 +141,17 @@ class Transformer(nnx.Module):
             return Block(cfg, rngs, dtype=dtype, param_dtype=param_dtype)
 
         self.blocks = create_block(rngs)
+        if cfg.pipeline and cfg.pp_virtual > 1 and cfg.pp_stages:
+            # circular placement is baked into STORAGE order once at
+            # construction (stored row j = canonical layer order[j]), so the
+            # pipelined forward needs no per-step cross-stage all-to-all;
+            # loaders/exporters reorder at their stacking edge to match
+            from jimm_tpu.parallel.pipeline import circular_layer_order
+            order = circular_layer_order(cfg.depth, cfg.pp_stages,
+                                         cfg.pp_virtual)
+            state = nnx.state(self.blocks)
+            nnx.update(self.blocks,
+                       jax.tree.map(lambda p: p[order], state))
         if cfg.pipeline and cfg.dropout > 0.0:
             # persistent schedule-tick counter: offsets the per-tick dropout
             # rng folding so masks differ across training steps (pipelined
@@ -171,6 +182,7 @@ class Transformer(nnx.Module):
         if self.cfg.remat:
             body = nnx.remat(body, policy=self._remat_policy())
         scan = nnx.scan(body, in_axes=(0, nnx.Carry), out_axes=nnx.Carry,
+                        unroll=self.cfg.scan_unroll,
                         transform_metadata={nnx.PARTITION_NAME: "layers"})
         return scan(blocks, x)
 
@@ -196,9 +208,14 @@ class Transformer(nnx.Module):
         if isinstance(batch_axis, str) and batch_axis not in mesh.shape:
             batch_axis = None
         graphdef, state = nnx.split(self.blocks)
-        if n_virtual > 1:
-            # circular placement: device d's contiguous P("stage") shard must
-            # hold the interleaved blocks {v*n_stage + d}
+        if n_virtual > 1 and self.cfg.pp_stages != n_stage:
+            if self.cfg.pp_stages:
+                raise ValueError(
+                    f"model was built for pp_stages={self.cfg.pp_stages} "
+                    f"but the mesh has {n_stage} stages")
+            # pp_stages unknown at construction: fall back to permuting per
+            # call — correct, but a cross-stage all-to-all each step; set
+            # cfg.pp_stages to bake the placement into storage instead
             order = circular_layer_order(self.cfg.depth, n_stage, n_virtual)
             state = jax.tree.map(lambda p: p[order], state)
 

@@ -28,9 +28,11 @@ def _default_backend() -> str:
 
 
 def _flash_eligible(q: jax.Array, k: jax.Array) -> bool:
-    # flash kernel wants seq lengths it can block; q_len==1 (MAP probe) or
-    # tiny sequences gain nothing.
-    return (q.shape[1] >= 128 and k.shape[1] >= 128
+    # measured crossover on v5e (scripts/attn_crossover.py): XLA's fused
+    # attention wins below seq 512 (grid-step overhead dominates the Pallas
+    # kernel at small tiles); flash wins from 512 up and scales to long
+    # context where XLA's materialized S^2 probabilities drown in HBM traffic
+    return (q.shape[1] >= 512 and k.shape[1] >= 512
             and q.shape[-1] in (64, 128, 256))
 
 

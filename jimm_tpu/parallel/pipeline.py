@@ -63,7 +63,8 @@ def circular_layer_order(n_layers: int, n_stages: int, n_virtual: int
 
 def num_ticks(n_microbatches: int, n_stages: int, n_virtual: int = 1) -> int:
     """Schedule length in ticks — the single source of truth shared by the
-    scan below and the dropout tick counter (`Transformer._pp_ticks`)."""
+    scan below and the dropout tick-offset bookkeeping in
+    `Transformer.__call__` (jimm_tpu/nn/transformer.py)."""
     m, s, v = n_microbatches, n_stages, n_virtual
     if v == 1:
         return m + s - 1
@@ -113,13 +114,9 @@ def pipeline_forward(stage_apply: Callable, stage_params, x: jax.Array, *,
             params_local)
 
         t_total = num_ticks(M, S, V)
-        if V == 1:
-            out_ticks = np.arange(M) + S - 1  # microbatch m exits at m+S-1
-        else:
-            g, r = np.arange(M) // S, np.arange(M) % S
-            out_ticks = g * V * S + (V - 1) * S + r + S - 1
 
         def step(carry, t):
+            ring, acc = carry
             td = t - stage
             q = jnp.floor_divide(td, S)
             r = td - q * S  # in [0, S)
@@ -127,20 +124,30 @@ def pipeline_forward(stage_apply: Callable, stage_params, x: jax.Array, *,
             v = jnp.remainder(qc, V)
             g = jnp.floor_divide(qc, V)
             # stage 0 injects microbatch g*S + r at the start of lap 0
-            m_inj = jnp.clip(g * S + r, 0, M - 1)
+            m_cur = g * S + r  # the microbatch this tick works on
+            m_inj = jnp.clip(m_cur, 0, M - 1)
             inject = (stage == 0) & (v == 0)
-            inp = jnp.where(inject, micro[m_inj], carry)
+            inp = jnp.where(inject, micro[m_inj], ring)
             chunk = jax.tree.map(lambda p: p[v], params_v)
             out = stage_apply(chunk, inp, t + tick_offset)
+            # collect finished microbatches into an M-slot accumulator as
+            # they drain (NOT a (t_total, ...) stack — at V>1 that would
+            # hold ~V*M outputs live through the backward for M results):
+            # microbatch m finishes when the LAST stage completes lap V-1
+            done = ((stage == S - 1) & (v == V - 1) & (td >= 0)
+                    & (m_cur < M))
+            upd = jnp.where(done, out, jax.lax.dynamic_index_in_dim(
+                acc, m_inj, keepdims=False))
+            acc = jax.lax.dynamic_update_index_in_dim(acc, upd, m_inj, 0)
             perm = [(i, (i + 1) % S) for i in range(S)]
-            return jax.lax.ppermute(out, axis_name, perm), out
+            return (jax.lax.ppermute(out, axis_name, perm), acc), None
 
-        _, outs = jax.lax.scan(step, jnp.zeros_like(micro[0]),
-                               jnp.arange(t_total))
-        # the last stage holds microbatch m's final output at out_ticks[m]
-        window = outs[jnp.asarray(out_ticks)]  # (M, b/M, ...)
-        window = jnp.where(stage == S - 1, window, jnp.zeros_like(window))
-        result = jax.lax.psum(window, axis_name)
+        acc0 = jnp.zeros_like(micro)
+        (_, acc), _ = jax.lax.scan(step, (jnp.zeros_like(micro[0]), acc0),
+                                   jnp.arange(t_total))
+        # only the last stage wrote real outputs; broadcast them to all
+        result = jax.lax.psum(
+            jnp.where(stage == S - 1, acc, jnp.zeros_like(acc)), axis_name)
         return result.reshape(b, *x_local.shape[1:])
 
     kwargs = {} if mesh is None else {"mesh": mesh}

@@ -19,7 +19,7 @@ from typing import Any
 import numpy as np
 from flax import nnx
 
-from jimm_tpu.weights.loader import Chunk, M
+from jimm_tpu.weights.loader import Chunk, M, order_for
 from jimm_tpu.weights.safetensors_io import save_file
 
 
@@ -28,8 +28,12 @@ def _to_numpy(value) -> np.ndarray:
 
 
 def to_hf_state_dict(model: nnx.Module, entries: list[M], *, num_layers: int,
-                     num_layers_by_prefix: dict[str, int] | None = None
+                     num_layers_by_prefix: dict[str, int] | None = None,
+                     layer_order: dict[str, np.ndarray] | None = None
                      ) -> dict[str, np.ndarray]:
+    """``layer_order`` mirrors `loader.apply_mapping`: stored row j holds
+    canonical layer order[j], so the export emits row j under the canonical
+    HF index order[j]."""
     params = dict(nnx.to_flat_state(nnx.state(model, nnx.Param)))
     flat = {".".join(map(str, k)): _to_numpy(v.get_value())
             for k, v in params.items()}
@@ -49,8 +53,14 @@ def to_hf_state_dict(model: nnx.Module, entries: list[M], *, num_layers: int,
             raise KeyError(f"model has no parameter {e.dst!r}")
         arr = flat[e.dst]
         per_layer = "{i}" in e.src
-        layers = ([(e.src.format(i=i), arr[i]) for i in range(layer_count(e.dst))]
-                  if per_layer else [(e.src, arr)])
+        if per_layer:
+            order = order_for(e.dst, layer_order)
+            canon = (order if order is not None
+                     else range(layer_count(e.dst)))
+            layers = [(e.src.format(i=ci), arr[j])
+                      for j, ci in enumerate(canon)]
+        else:
+            layers = [(e.src, arr)]
         for key, a in layers:
             if isinstance(e.transform, Chunk):
                 fused.setdefault(key, []).append(
@@ -79,8 +89,12 @@ def save_pretrained(model: nnx.Module, save_dir: str | os.PathLike) -> None:
 
 
 def _layer_kwargs(model) -> dict[str, Any]:
+    from jimm_tpu.weights.loader import layer_orders
+
     cfg = model.config
     if hasattr(cfg, "text"):
         return {"num_layers": cfg.vision.depth,
-                "num_layers_by_prefix": {"text.": cfg.text.depth}}
-    return {"num_layers": cfg.vision.depth}
+                "num_layers_by_prefix": {"text.": cfg.text.depth},
+                "layer_order": layer_orders(cfg)}
+    return {"num_layers": cfg.vision.depth,
+            "layer_order": layer_orders(cfg)}

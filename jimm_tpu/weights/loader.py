@@ -95,11 +95,50 @@ class MappingError(ValueError):
     pass
 
 
+def order_for(dst: str, layer_order: dict[str, np.ndarray] | None
+              ) -> np.ndarray | None:
+    """Longest-matching-prefix lookup into a {dst-prefix: permutation} map
+    ("" matches all). Shared by the loader and the HF exporter so load and
+    export can never disagree on layer ordering."""
+    best = None
+    for prefix, order in (layer_order or {}).items():
+        if dst.startswith(prefix) and (
+                best is None or len(prefix) > len(best[0])):
+            best = (prefix, order)
+    return None if best is None else best[1]
+
+
+def layer_orders(cfg) -> dict[str, np.ndarray] | None:
+    """{dst-prefix: permutation} for model configs whose towers bake
+    pipeline circular placement into storage (``pp_stages`` set with
+    ``pp_virtual > 1`` — see `nn/transformer.py`). None when canonical."""
+    def tower(t):
+        if (t is not None and getattr(t, "pipeline", False)
+                and t.pp_virtual > 1 and t.pp_stages):
+            from jimm_tpu.parallel.pipeline import circular_layer_order
+            return circular_layer_order(t.depth, t.pp_stages, t.pp_virtual)
+        return None
+
+    orders = {}
+    v = tower(getattr(cfg, "vision", None))
+    if v is not None:
+        orders["vision."] = v
+    t = tower(getattr(cfg, "text", None))
+    if t is not None:
+        orders["text."] = t
+    return orders or None
+
+
 def apply_mapping(model: nnx.Module, weights: dict[str, np.ndarray],
                   entries: list[M], *, num_layers: int,
                   num_layers_by_prefix: dict[str, int] | None = None,
                   allowed_unused: tuple[str, ...] = ("position_ids",),
-                  param_dtype=None) -> None:
+                  param_dtype=None,
+                  layer_order: dict[str, np.ndarray] | None = None) -> None:
+    """``layer_order``: optional {dst-prefix: permutation} applied after
+    stacking — stored row j receives canonical layer order[j] (models whose
+    towers bake pipeline circular placement into storage,
+    `nn/transformer.py`). Longest matching prefix wins; "" matches all."""
     def layer_count(dst: str) -> int:
         for prefix, n in (num_layers_by_prefix or {}).items():
             if dst.startswith(prefix):
@@ -135,6 +174,9 @@ def apply_mapping(model: nnx.Module, weights: dict[str, np.ndarray],
             if missing:
                 continue
             arr = np.stack(per_layer)
+            order = order_for(e.dst, layer_order)
+            if order is not None:
+                arr = arr[order]
         else:
             arr = take(e.src, e.optional)
             if arr is None:

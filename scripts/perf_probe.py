@@ -55,9 +55,9 @@ def bench_attention():
         return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
 
     impls = {
-        "flash(bq=128,bk=128)": loss_of(flash_attention),
-        "flash(bq=256,bk=256)": loss_of(flash_attention, block_q=256,
-                                        block_k=256),
+        "flash(bq=128,bk=128)": loss_of(flash_attention, block_q=128,
+                                        block_k=128),
+        "flash(default blocks)": loss_of(flash_attention),
         "xla_dpa": loss_of(
             lambda q, k, v: jax.nn.dot_product_attention(q, k, v)),
         "reference": loss_of(reference_attention),

@@ -19,6 +19,7 @@ def main():
     p.add_argument("--remat", default="dots")
     p.add_argument("--batch", type=int, default=128)
     p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--unroll", type=int, default=1)
     p.add_argument("--top", type=int, default=25)
     p.add_argument("--dir", default="/tmp/jimm_profile")
     args = p.parse_args()
@@ -38,9 +39,11 @@ def main():
     cfg = dataclasses.replace(
         cfg,
         vision=dataclasses.replace(cfg.vision, remat=do_remat,
-                                   remat_policy=policy, attn_impl=args.attn),
+                                   remat_policy=policy, attn_impl=args.attn,
+                                   scan_unroll=args.unroll),
         text=dataclasses.replace(cfg.text, remat=do_remat,
-                                 remat_policy=policy, attn_impl=args.attn))
+                                 remat_policy=policy, attn_impl=args.attn,
+                                 scan_unroll=args.unroll))
     model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
                    param_dtype=jnp.bfloat16)
     optimizer = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))

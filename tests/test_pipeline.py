@@ -94,6 +94,71 @@ def test_transformer_interleaved_matches_plain(rng, pp_mesh):
     np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
+def test_transformer_prebaked_placement_matches_plain(rng, pp_mesh):
+    """cfg.pp_stages bakes circular placement into storage at construction
+    (no per-step cross-stage all-to-all); semantics must be unchanged."""
+    x = jnp.asarray(rng.randn(8, 12, 32).astype(np.float32))
+    ref = np.asarray(_towers(False)(x))
+    pp = _towers(True, pp_virtual=2, pp_microbatches=4, pp_stages=4)
+    with use_sharding(pp_mesh, PIPELINE):
+        out = np.asarray(pp(x))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    # a mesh whose stage count contradicts the baked placement must raise
+    bad = make_mesh({"data": 4, "stage": 2})
+    with use_sharding(bad, PIPELINE), pytest.raises(ValueError,
+                                                    match="pp_stages"):
+        pp(x)
+
+
+def test_prebaked_placement_checkpoint_roundtrip(rng, tmp_path, pp_mesh):
+    """Canonical HF checkpoint -> permuted (pp_stages) storage via the
+    loader's layer_order -> identical forward -> canonical re-export."""
+    import dataclasses
+
+    from transformers import SiglipConfig, SiglipModel
+
+    from jimm_tpu import SigLIP
+    from jimm_tpu.weights.export import save_pretrained
+    from jimm_tpu.weights.loader import apply_mapping, layer_orders
+    from jimm_tpu.weights.resolve import resolve_checkpoint
+
+    tower = dict(hidden_size=64, intermediate_size=128, num_hidden_layers=8,
+                 num_attention_heads=2, image_size=32, patch_size=16)
+    hf = SiglipConfig(vision_config=dict(tower),
+                      text_config=dict(hidden_size=64, intermediate_size=128,
+                                       num_hidden_layers=8,
+                                       num_attention_heads=2))
+    SiglipModel(hf).eval().save_pretrained(tmp_path / "src",
+                                           safe_serialization=True)
+
+    plain = SigLIP.from_pretrained(str(tmp_path / "src"))
+    cfg = plain.config
+    pcfg = dataclasses.replace(
+        cfg,
+        vision=dataclasses.replace(cfg.vision, pipeline=True, pp_virtual=2,
+                                   pp_stages=4, pp_microbatches=4),
+        text=dataclasses.replace(cfg.text, pipeline=True, pp_virtual=2,
+                                 pp_stages=4, pp_microbatches=4))
+    piped = SigLIP(pcfg, rngs=nnx.Rngs(0), mesh=pp_mesh, rules=PIPELINE)
+    weights, _ = resolve_checkpoint(str(tmp_path / "src"))
+    apply_mapping(piped, weights, SigLIP.hf_mapping(pcfg),
+                  num_layers=pcfg.vision.depth,
+                  num_layers_by_prefix={"text.": pcfg.text.depth},
+                  layer_order=layer_orders(pcfg))
+
+    img = jnp.asarray(rng.randn(8, 32, 32, 3).astype(np.float32))
+    txt = jnp.asarray(rng.randint(1, 99, size=(8, 16)), jnp.int32)
+    ref = np.asarray(plain(img, txt))
+    with use_sharding(pp_mesh, PIPELINE):
+        out = np.asarray(piped(img, txt))
+    np.testing.assert_allclose(out, ref, atol=2e-4)
+
+    # export from permuted storage must be canonical again
+    save_pretrained(piped, tmp_path / "out")
+    again = SigLIP.from_pretrained(str(tmp_path / "out"))
+    np.testing.assert_allclose(np.asarray(again(img, txt)), ref, atol=2e-4)
+
+
 def test_transformer_pipeline_dropout(rng, pp_mesh):
     """Active dropout in the pipelined path: fresh masks per microbatch and
     per step (VERDICT r1: PP was eval-biased)."""
