@@ -68,13 +68,15 @@ def _tiny_override(cfg: Any) -> Any:
     """Shrink any preset to CPU-demo size, keeping its architecture class."""
     from jimm_tpu.configs import CLIPConfig, SigLIPConfig, ViTConfig
 
+    # depth 4 (not 2) so tiny runs can still exercise pipeline stages x
+    # virtual-chunk splits (depth % (stages * virtual) == 0 for 2x2)
     def shrink_vision(v):
         return dataclasses.replace(v, image_size=32, patch_size=16, width=64,
-                                   depth=2, num_heads=2, mlp_dim=128)
+                                   depth=4, num_heads=2, mlp_dim=128)
 
     def shrink_text(t):
         return dataclasses.replace(t, vocab_size=64, context_length=8,
-                                   width=64, depth=2, num_heads=2, mlp_dim=128)
+                                   width=64, depth=4, num_heads=2, mlp_dim=128)
 
     if isinstance(cfg, ViTConfig):
         return dataclasses.replace(cfg, vision=shrink_vision(cfg.vision))
@@ -123,26 +125,39 @@ def cmd_train(args: argparse.Namespace) -> int:
         cfg = _tiny_override(cfg)
     if args.attn_impl:
         cfg = _replace_towers(cfg, attn_impl=args.attn_impl)
+    mesh = _parse_mesh(args.mesh)
+    pp_extra = {}
+    if args.pipeline_virtual > 1:
+        if args.rules != "pp":
+            raise SystemExit("--pipeline-virtual needs --rules pp")
+        # bake circular placement into storage when the stage count is
+        # known from --mesh (avoids a per-step cross-stage all-to-all)
+        stages = dict(mesh.shape).get("stage", 0) if mesh is not None else 0
+        pp_extra = dict(pp_virtual=args.pipeline_virtual, pp_stages=stages)
     if args.pipeline_microbatches:
         if args.pipeline_microbatches < 1:
             raise SystemExit("--pipeline-microbatches must be >= 1")
         if args.rules != "pp":
             raise SystemExit("--pipeline-microbatches needs --rules pp "
                              "(layers sharded over the 'stage' mesh axis)")
-        cfg = _replace_towers(cfg, pipeline=True,
+        cfg = _replace_towers(cfg, pipeline=True, **pp_extra,
                               pp_microbatches=args.pipeline_microbatches)
     elif args.rules == "pp":
         # --rules pp without the flag: default to the config's microbatch
         # count rather than silently running the unpipelined scan with
         # stage-sharded params (correct but all-gathers every layer)
-        cfg = _replace_towers(cfg, pipeline=True)
+        cfg = _replace_towers(cfg, pipeline=True, **pp_extra)
+    if args.scan_unroll != 1:
+        import jax as _jax
+        unroll = args.scan_unroll or (
+            cfg.vision.depth if _jax.default_backend() == "tpu" else 1)
+        cfg = _replace_towers(cfg, scan_unroll=unroll)
     if fam == "vit":
         if args.num_classes:
             cfg = dataclasses.replace(cfg, num_classes=args.num_classes)
         elif not args.data:
             cfg = dataclasses.replace(cfg, num_classes=4)  # synthetic classes
 
-    mesh = _parse_mesh(args.mesh)
     rules = PRESET_RULES[args.rules] if args.rules else (
         PRESET_RULES["dp"] if mesh is not None else None)
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
@@ -401,6 +416,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--pipeline-microbatches", type=int, default=0,
                     help="enable pipeline parallelism with N microbatches "
                          "(needs a 'stage' mesh axis and --rules pp)")
+    sp.add_argument("--pipeline-virtual", type=int, default=1,
+                    help="interleaved PP: virtual chunks per stage "
+                         "(circular placement; shrinks the bubble ~Vx)")
+    sp.add_argument("--scan-unroll", type=int, default=0,
+                    help="layer-scan unroll factor (0 = auto: full unroll "
+                         "on TPU for better XLA scheduling, 1 on CPU)")
     sp.add_argument("--ckpt-dir", default=None)
     sp.add_argument("--resume", action="store_true")
     sp.add_argument("--fake-failure-at-step", type=int, default=None,

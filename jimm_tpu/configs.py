@@ -72,6 +72,9 @@ class TransformerConfig:
     #: saves matmul outputs and recomputes only cheap elementwise ops
     #: (ln/act/softmax) — the usual best MFU/memory trade on TPU.
     remat_policy: Literal["none", "dots"] = "none"
+    #: LayerNorm kernel: "xla" (nnx.LayerNorm) or "fused" (one-pass Pallas
+    #: fwd/bwd, `jimm_tpu/ops/layer_norm.py`).
+    ln_impl: Literal["xla", "fused"] = "xla"
     #: `lax.scan` unroll factor for the layer loop. >1 trades compile time
     #: for schedule freedom: XLA turns the per-layer stacked-gradient
     #: dynamic-update-slices into statically-indexed updates it can fuse.
@@ -113,6 +116,7 @@ class VisionConfig:
     pp_stages: int = 0
     remat: bool = False
     remat_policy: Literal["none", "dots"] = "none"
+    ln_impl: Literal["xla", "fused"] = "xla"
     scan_unroll: int = 1
 
     @property
@@ -135,7 +139,7 @@ class VisionConfig:
             pipeline=self.pipeline, pp_microbatches=self.pp_microbatches,
             pp_virtual=self.pp_virtual, pp_stages=self.pp_stages,
             remat=self.remat, remat_policy=self.remat_policy,
-            scan_unroll=self.scan_unroll,
+            ln_impl=self.ln_impl, scan_unroll=self.scan_unroll,
         )
 
 
@@ -167,6 +171,7 @@ class TextConfig:
     pp_stages: int = 0
     remat: bool = False
     remat_policy: Literal["none", "dots"] = "none"
+    ln_impl: Literal["xla", "fused"] = "xla"
     scan_unroll: int = 1
 
     def encoder(self) -> TransformerConfig:
@@ -177,7 +182,7 @@ class TextConfig:
             pipeline=self.pipeline, pp_microbatches=self.pp_microbatches,
             pp_virtual=self.pp_virtual, pp_stages=self.pp_stages,
             remat=self.remat, remat_policy=self.remat_policy,
-            scan_unroll=self.scan_unroll,
+            ln_impl=self.ln_impl, scan_unroll=self.scan_unroll,
         )
 
 

@@ -48,7 +48,11 @@ def _linear(din: int, dout: int, names: tuple, rngs: nnx.Rngs, *,
 
 
 def _layernorm(dim: int, eps: float, rngs: nnx.Rngs, *, dtype: Dtype,
-               param_dtype) -> nnx.LayerNorm:
+               param_dtype, impl: str = "xla") -> nnx.Module:
+    if impl == "fused":
+        from jimm_tpu.nn.norm import FusedLayerNorm
+        return FusedLayerNorm(dim, epsilon=eps, dtype=dtype,
+                              param_dtype=param_dtype, rngs=rngs)
     return nnx.LayerNorm(
         dim, epsilon=eps, dtype=dtype, param_dtype=param_dtype,
         scale_init=logical(nnx.initializers.ones_init(), "embed"),
@@ -111,12 +115,12 @@ class Block(nnx.Module):
     def __init__(self, cfg: TransformerConfig, rngs: nnx.Rngs, *,
                  dtype: Dtype = None, param_dtype=jnp.float32):
         self.ln1 = _layernorm(cfg.width, cfg.ln_eps, rngs, dtype=dtype,
-                              param_dtype=param_dtype)
+                              param_dtype=param_dtype, impl=cfg.ln_impl)
         self.attn = Attention(cfg.width, cfg.num_heads, rngs,
                               is_causal=cfg.causal, impl=cfg.attn_impl,
                               dtype=dtype, param_dtype=param_dtype)
         self.ln2 = _layernorm(cfg.width, cfg.ln_eps, rngs, dtype=dtype,
-                              param_dtype=param_dtype)
+                              param_dtype=param_dtype, impl=cfg.ln_impl)
         self.mlp = Mlp(cfg.width, cfg.mlp_dim, cfg.act, rngs, dtype=dtype,
                        param_dtype=param_dtype)
         self.dropout = nnx.Dropout(cfg.dropout, rngs=rngs)

@@ -419,12 +419,29 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do, dlse=None):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _pick_block(seq: int, requested: int) -> int:
+    """Largest block (<= requested) that minimizes padded-sequence length:
+    dead-tile work grows with ceil_to(seq, block)^2, so e.g. seq 577 takes
+    block 128 (pad to 640) over 512 (pad to 1024), while exact multiples
+    keep the biggest tile."""
+    best = None
+    for b in (512, 256, 128):
+        if b > requested:
+            continue
+        padded = _ceil_to(seq, b)
+        if best is None or padded < best[0]:
+            best = (padded, b)
+    return best[1] if best else min(requested, _ceil_to(seq, 128))
+
+
 def _prologue(q, k, v, block_q, block_k):
     """Shared head-flattening + scale/block selection for both entry points."""
     d = q.shape[-1]
     sm_scale = 1.0 / (d ** 0.5)
-    block_q = min(block_q, _ceil_to(q.shape[1], 128))
-    block_k = min(block_k, _ceil_to(k.shape[1], 128))
+    block_q = min(_pick_block(q.shape[1], block_q),
+                  _ceil_to(q.shape[1], 128))
+    block_k = min(_pick_block(k.shape[1], block_k),
+                  _ceil_to(k.shape[1], 128))
     q3, k3, v3 = map(_flatten_heads, (q, k, v))
     return q3, k3, v3, sm_scale, block_q, block_k
 

@@ -1,0 +1,57 @@
+"""Fused Pallas LayerNorm vs XLA LayerNorm, fwd+bwd, train-step shapes."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jimm_tpu.ops.layer_norm import layer_norm
+
+
+def timeit(fn, *args, iters=50):
+    def chained(args, n):
+        def body(args, _):
+            out = fn(*args)
+            x = args[0] + 1e-6 * out[0].astype(args[0].dtype)
+            return (x,) + tuple(args[1:]), None
+        args, _ = jax.lax.scan(body, args, None, length=n)
+        return args
+
+    chained = jax.jit(chained, static_argnums=1)
+    float(jnp.sum(chained(args, iters)[0].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    float(jnp.sum(chained(args, iters)[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    print("backend:", jax.default_backend())
+    rng = np.random.RandomState(0)
+    R, F = 128 * 256, 768  # vision tower LN shape at bench batch
+    x = jnp.asarray(rng.randn(R, F), jnp.bfloat16)
+    s = jnp.asarray(rng.randn(F), jnp.bfloat16)
+    b = jnp.asarray(rng.randn(F), jnp.bfloat16)
+    eps = 1e-6
+
+    def xla_ln(x, s, b):
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + eps) * s + b).astype(x.dtype)
+
+    nbytes = (R * F * 2) * 4  # read x + write y, fwd+bwd ballpark
+    for name, f in (("xla", xla_ln),
+                    ("fused", lambda x, s, b: layer_norm(x, s, b, eps))):
+        g = jax.jit(jax.grad(
+            lambda x, s, b: jnp.sum(f(x, s, b).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2)))
+        dt = timeit(g, x, s, b)
+        print(f"  ln fwd+bwd {name:6s} {dt*1e3:7.3f} ms  "
+              f"~{nbytes/dt/1e9:5.0f} GB/s eff")
+
+
+if __name__ == "__main__":
+    main()

@@ -68,7 +68,8 @@ def bench_attention():
               f"{flops/dt/1e12:6.2f} TF/s")
 
 
-def bench_train_step(remat: str, attn_impl: str, batch: int = 128):
+def bench_train_step(remat: str, attn_impl: str, batch: int = 128,
+                     ln_impl: str = "xla", unroll: int = 1):
     import dataclasses
 
     from flax import nnx
@@ -87,10 +88,12 @@ def bench_train_step(remat: str, attn_impl: str, batch: int = 128):
         cfg,
         vision=dataclasses.replace(cfg.vision, remat=do_remat,
                                    remat_policy=policy if do_remat else "none",
-                                   attn_impl=attn_impl),
+                                   attn_impl=attn_impl, ln_impl=ln_impl,
+                                   scan_unroll=unroll),
         text=dataclasses.replace(cfg.text, remat=do_remat,
                                  remat_policy=policy if do_remat else "none",
-                                 attn_impl=attn_impl))
+                                 attn_impl=attn_impl, ln_impl=ln_impl,
+                                 scan_unroll=unroll))
     model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
                    param_dtype=jnp.bfloat16)
     optimizer = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
@@ -109,7 +112,8 @@ def bench_train_step(remat: str, attn_impl: str, batch: int = 128):
     float(m["loss"])
     dt = (time.perf_counter() - t0) / steps
     flops = train_step_flops(cfg, batch)
-    print(f"  train remat={remat:5s} attn={attn_impl:9s} b={batch:4d} "
+    print(f"  train remat={remat:5s} attn={attn_impl:9s} ln={ln_impl:5s} "
+          f"unroll={unroll:2d} b={batch:4d} "
           f"{dt*1e3:8.2f} ms  {batch/dt:7.1f} img/s  mfu={mfu(flops, dt, 1):.3f}")
 
 
@@ -120,6 +124,8 @@ def main():
     p.add_argument("--remat", default=None)
     p.add_argument("--attn", default=None)
     p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--ln", default=None)
+    p.add_argument("--unroll", type=int, default=1)
     args = p.parse_args()
     print("backend:", jax.default_backend(), jax.devices()[0].device_kind)
     if args.mode in ("all", "attn"):
@@ -127,9 +133,11 @@ def main():
     if args.mode in ("all", "train"):
         remats = [args.remat] if args.remat else ["dots", "none", "full"]
         attns = [args.attn] if args.attn else ["flash", "xla"]
+        lns = [args.ln] if args.ln else ["xla"]
         for r in remats:
             for a in attns:
-                bench_train_step(r, a, args.batch)
+                for ln in lns:
+                    bench_train_step(r, a, args.batch, ln, args.unroll)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Wait for the TPU tunnel to come back, then run the queued measurements
+# serially (the single chip must never be shared between processes).
+cd /root/repo
+for i in $(seq 1 90); do
+  if timeout 90 python -c "
+import jax
+x = (jax.numpy.ones((256,256)) @ jax.numpy.ones((256,256)))
+assert float(x[0,0]) == 256.0" 2>/dev/null; then
+    echo "TPU alive after $i probes"
+    break
+  fi
+  echo "probe $i: tunnel down, sleeping 120s"
+  sleep 120
+done
+
+echo "=== 1. attention microbench (head-blocked kernels) ==="
+timeout 600 python -m scripts.perf_probe --mode attn 2>&1 | grep -v WARNING | tail -6
+echo "=== 2. crossover sweep ==="
+timeout 600 python -m scripts.attn_crossover 2>&1 | grep -v WARNING | tail -8
+echo "=== 2.5 fused-LN bench ==="
+timeout 600 python -m scripts.ln_bench 2>&1 | grep -v WARNING | tail -4
+echo "=== 3. train grid ==="
+timeout 900 python -m scripts.perf_probe --mode train --remat dots 2>&1 | grep -E "train remat" | tail -4
+echo "=== 4. bench.py (benchmark of record) ==="
+timeout 1550 python bench.py 2>&1 | tail -2
+echo "=== queue done ==="

@@ -1,0 +1,52 @@
+"""Fused Pallas LayerNorm vs flax.nnx.LayerNorm oracle (values + grads)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import nnx
+
+from jimm_tpu.ops.layer_norm import layer_norm
+
+
+@pytest.mark.parametrize("rows,feat", [(512, 768), (96, 64), (33, 256)])
+def test_layer_norm_matches_flax(rng, rows, feat):
+    x = jnp.asarray(rng.randn(rows, feat).astype(np.float32))
+    scale = jnp.asarray(rng.randn(feat).astype(np.float32))
+    bias = jnp.asarray(rng.randn(feat).astype(np.float32))
+    eps = 1e-6
+
+    ln = nnx.LayerNorm(feat, epsilon=eps, rngs=nnx.Rngs(0))
+    ln.scale.set_value(scale)
+    ln.bias.set_value(bias)
+
+    got = layer_norm(x, scale, bias, eps)
+    want = ln(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def loss_fused(x, s, b):
+        return jnp.sum(layer_norm(x, s, b, eps) ** 2)
+
+    def loss_ref(x, s, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+        y = (x - mu) * jax.lax.rsqrt(var + eps) * s + b
+        return jnp.sum(y ** 2)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, scale, bias)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b_, name in zip(gf, gr, ("dx", "dscale", "dbias")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-3,
+                                   rtol=1e-4, err_msg=name)
+
+
+def test_layer_norm_bf16(rng):
+    x = jnp.asarray(rng.randn(256, 128), jnp.bfloat16)
+    scale = jnp.ones((128,), jnp.bfloat16)
+    bias = jnp.zeros((128,), jnp.bfloat16)
+    got = layer_norm(x, scale, bias, 1e-6)
+    assert got.dtype == jnp.bfloat16
+    ref = nnx.LayerNorm(128, epsilon=1e-6, dtype=jnp.bfloat16,
+                        param_dtype=jnp.bfloat16, rngs=nnx.Rngs(0))(x)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)
