@@ -35,6 +35,39 @@ def act_to_hf(name: str) -> str:
             "quick_gelu": "quick_gelu"}.get(name, name)
 
 
+#: Tower fields that select execution strategy, not architecture — safe to
+#: override when loading a checkpoint (`from_pretrained(..., runtime=...)`)
+RUNTIME_FIELDS = frozenset({
+    "attn_impl", "ln_impl", "fused_qkv", "remat", "remat_policy", "scan_unroll",
+    "dropout", "pipeline", "pp_microbatches", "pp_virtual", "pp_stages",
+})
+
+
+def with_runtime(cfg, **fields):
+    """Return ``cfg`` with runtime (non-architecture) fields replaced in the
+    vision — and, if present, text — tower. Rejects architecture fields so a
+    checkpoint's shapes can never be silently contradicted.
+
+    Flat fields apply to both towers; ``vision=dict(...)`` / ``text=dict(...)``
+    target one tower (needed when the towers' depths admit different
+    pipeline splits, e.g. CLIP-L's 24-deep vision vs 12-deep text)."""
+    per_tower = {t: dict(fields.pop(t, None) or {})
+                 for t in ("vision", "text")}
+    bad = (set(fields) | set(per_tower["vision"]) | set(per_tower["text"])
+           ) - RUNTIME_FIELDS
+    if bad:
+        raise ValueError(f"not runtime-overridable: {sorted(bad)} "
+                         f"(allowed: {sorted(RUNTIME_FIELDS)})")
+    cfg = dataclasses.replace(cfg, vision=dataclasses.replace(
+        cfg.vision, **fields, **per_tower["vision"]))
+    if hasattr(cfg, "text"):
+        cfg = dataclasses.replace(cfg, text=dataclasses.replace(
+            cfg.text, **fields, **per_tower["text"]))
+    elif per_tower["text"]:
+        raise ValueError("config has no text tower to override")
+    return cfg
+
+
 @dataclass(frozen=True)
 class TransformerConfig:
     """Shared encoder-stack hyperparameters (vision or text tower)."""
@@ -75,6 +108,9 @@ class TransformerConfig:
     #: LayerNorm kernel: "xla" (nnx.LayerNorm) or "fused" (one-pass Pallas
     #: fwd/bwd, `jimm_tpu/ops/layer_norm.py`).
     ln_impl: Literal["xla", "fused"] = "xla"
+    #: Compute q/k/v as one (H, 3H) matmul (call-time kernel concat;
+    #: checkpoints unchanged).
+    fused_qkv: bool = False
     #: `lax.scan` unroll factor for the layer loop. >1 trades compile time
     #: for schedule freedom: XLA turns the per-layer stacked-gradient
     #: dynamic-update-slices into statically-indexed updates it can fuse.
@@ -117,6 +153,7 @@ class VisionConfig:
     remat: bool = False
     remat_policy: Literal["none", "dots"] = "none"
     ln_impl: Literal["xla", "fused"] = "xla"
+    fused_qkv: bool = False
     scan_unroll: int = 1
 
     @property
@@ -139,7 +176,8 @@ class VisionConfig:
             pipeline=self.pipeline, pp_microbatches=self.pp_microbatches,
             pp_virtual=self.pp_virtual, pp_stages=self.pp_stages,
             remat=self.remat, remat_policy=self.remat_policy,
-            ln_impl=self.ln_impl, scan_unroll=self.scan_unroll,
+            ln_impl=self.ln_impl, fused_qkv=self.fused_qkv,
+            scan_unroll=self.scan_unroll,
         )
 
 
@@ -172,6 +210,7 @@ class TextConfig:
     remat: bool = False
     remat_policy: Literal["none", "dots"] = "none"
     ln_impl: Literal["xla", "fused"] = "xla"
+    fused_qkv: bool = False
     scan_unroll: int = 1
 
     def encoder(self) -> TransformerConfig:
@@ -182,7 +221,8 @@ class TextConfig:
             pipeline=self.pipeline, pp_microbatches=self.pp_microbatches,
             pp_virtual=self.pp_virtual, pp_stages=self.pp_stages,
             remat=self.remat, remat_policy=self.remat_policy,
-            ln_impl=self.ln_impl, scan_unroll=self.scan_unroll,
+            ln_impl=self.ln_impl, fused_qkv=self.fused_qkv,
+            scan_unroll=self.scan_unroll,
         )
 
 

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import nnx
 
-from jimm_tpu.configs import act_to_hf, normalize_act, CLIPConfig, TextConfig, VisionConfig
+from jimm_tpu.configs import act_to_hf, normalize_act, with_runtime, CLIPConfig, TextConfig, VisionConfig
 from jimm_tpu.nn.text import TextTower
 from jimm_tpu.nn.vision import VisionTower
 from jimm_tpu.parallel.sharding import (ShardingRules, TENSOR_PARALLEL,
@@ -193,11 +193,16 @@ class CLIP(nnx.Module):
     def from_pretrained(cls, name_or_path: str, *,
                         mesh: jax.sharding.Mesh | None = None,
                         rules: ShardingRules | str = TENSOR_PARALLEL,
-                        dtype=None, use_pytorch: bool = False
+                        dtype=None, use_pytorch: bool = False,
+                        runtime: dict | None = None
                         ) -> "CLIP":
         weights, config = resolve_checkpoint(name_or_path,
                                              use_pytorch=use_pytorch)
         cfg = cls.config_from_hf(config, weights)
+        if runtime:
+            # execution-strategy overrides a checkpoint cannot know
+            # (remat/pipeline/attn_impl/... — configs.RUNTIME_FIELDS)
+            cfg = with_runtime(cfg, **runtime)
         param_dtype = dtype if dtype is not None else jnp.float32
         model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,
                     param_dtype=param_dtype)

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import nnx
 
-from jimm_tpu.configs import VisionConfig, ViTConfig, act_to_hf, normalize_act
+from jimm_tpu.configs import (VisionConfig, ViTConfig, act_to_hf,
+                              normalize_act, with_runtime)
 from jimm_tpu.nn.vision import VisionTower
 from jimm_tpu.parallel.sharding import (ShardingRules, TENSOR_PARALLEL, logical,
                                         shard_model)
@@ -150,13 +151,20 @@ class VisionTransformer(nnx.Module):
     def from_pretrained(cls, name_or_path: str, *,
                         mesh: jax.sharding.Mesh | None = None,
                         rules: ShardingRules | str = TENSOR_PARALLEL,
-                        dtype=None, use_pytorch: bool = False
+                        dtype=None, use_pytorch: bool = False,
+                        runtime: dict | None = None
                         ) -> "VisionTransformer":
         """Load any HF ViT checkpoint (safetensors). ``dtype`` sets both
-        compute and param dtype (ref `models/vit.py:181-182`)."""
+        compute and param dtype (ref `models/vit.py:181-182`). ``runtime``
+        overrides execution-strategy tower fields (remat/attn_impl/
+        pipeline/... — `configs.RUNTIME_FIELDS`) that a checkpoint cannot
+        know, e.g. ``runtime=dict(remat=True, pipeline=True, pp_stages=4)``
+        for pipelined fine-tuning."""
         weights, config = resolve_checkpoint(name_or_path,
                                              use_pytorch=use_pytorch)
         cfg = cls.config_from_hf(config, weights)
+        if runtime:
+            cfg = with_runtime(cfg, **runtime)
         param_dtype = dtype if dtype is not None else jnp.float32
         model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,
                     param_dtype=param_dtype)

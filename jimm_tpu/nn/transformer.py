@@ -62,10 +62,16 @@ def _layernorm(dim: int, eps: float, rngs: nnx.Rngs, *, dtype: Dtype,
 
 class Attention(nnx.Module):
     """Multi-head attention with (H, H) q/k/v/out kernels; supports
-    self-attention and cross-attention (MAP pooling probe)."""
+    self-attention and cross-attention (MAP pooling probe).
+
+    ``fused_qkv`` computes the three projections as one ``(H, 3H)`` matmul
+    by concatenating the kernels at call time — parameters (and therefore
+    checkpoints) stay separate, the concat is tiny next to the matmul, and
+    gradients flow back through the slice."""
 
     def __init__(self, width: int, num_heads: int, rngs: nnx.Rngs, *,
                  is_causal: bool = False, impl: str = "auto",
+                 fused_qkv: bool = False,
                  dtype: Dtype = None, param_dtype=jnp.float32):
         if width % num_heads:
             raise ValueError(f"width {width} not divisible by heads {num_heads}")
@@ -73,20 +79,36 @@ class Attention(nnx.Module):
         self.head_dim = width // num_heads
         self.is_causal = is_causal
         self.impl = impl
+        self.fused_qkv = fused_qkv
+        self.dtype = dtype
         lin = partial(_linear, dtype=dtype, param_dtype=param_dtype)
         self.q = lin(width, width, ("embed", "heads"), rngs)
         self.k = lin(width, width, ("embed", "heads"), rngs)
         self.v = lin(width, width, ("embed", "heads"), rngs)
         self.out = lin(width, width, ("heads", "embed"), rngs)
 
+    def _project_qkv(self, x: jax.Array) -> tuple[jax.Array, ...]:
+        w = jnp.concatenate([self.q.kernel[...], self.k.kernel[...],
+                             self.v.kernel[...]], axis=1)
+        b = jnp.concatenate([self.q.bias[...], self.k.bias[...],
+                             self.v.bias[...]])
+        dtype = self.dtype or x.dtype
+        qkv = x.astype(dtype) @ w.astype(dtype) + b.astype(dtype)
+        return tuple(jnp.split(qkv, 3, axis=-1))
+
     def __call__(self, x: jax.Array, kv: jax.Array | None = None,
                  mask: jax.Array | None = None) -> jax.Array:
-        kv = x if kv is None else kv
         B, Sq, _ = x.shape
-        Sk = kv.shape[1]
-        q = self.q(x).reshape(B, Sq, self.num_heads, self.head_dim)
-        k = self.k(kv).reshape(B, Sk, self.num_heads, self.head_dim)
-        v = self.v(kv).reshape(B, Sk, self.num_heads, self.head_dim)
+        if kv is None and self.fused_qkv:
+            q, k, v = self._project_qkv(x)
+            Sk = Sq
+        else:
+            kv = x if kv is None else kv
+            Sk = kv.shape[1]
+            q, k, v = self.q(x), self.k(kv), self.v(kv)
+        q = q.reshape(B, Sq, self.num_heads, self.head_dim)
+        k = k.reshape(B, Sk, self.num_heads, self.head_dim)
+        v = v.reshape(B, Sk, self.num_heads, self.head_dim)
         o = dot_product_attention(q, k, v, is_causal=self.is_causal,
                                   mask=mask, impl=self.impl)
         return self.out(o.reshape(B, Sq, self.num_heads * self.head_dim))
@@ -118,6 +140,7 @@ class Block(nnx.Module):
                               param_dtype=param_dtype, impl=cfg.ln_impl)
         self.attn = Attention(cfg.width, cfg.num_heads, rngs,
                               is_causal=cfg.causal, impl=cfg.attn_impl,
+                              fused_qkv=cfg.fused_qkv,
                               dtype=dtype, param_dtype=param_dtype)
         self.ln2 = _layernorm(cfg.width, cfg.ln_eps, rngs, dtype=dtype,
                               param_dtype=param_dtype, impl=cfg.ln_impl)

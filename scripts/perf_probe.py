@@ -69,9 +69,8 @@ def bench_attention():
 
 
 def bench_train_step(remat: str, attn_impl: str, batch: int = 128,
-                     ln_impl: str = "xla", unroll: int = 1):
-    import dataclasses
-
+                     ln_impl: str = "xla", unroll: int = 1,
+                     fused_qkv: bool = False):
     from flax import nnx
 
     from jimm_tpu import SigLIP, preset
@@ -79,21 +78,17 @@ def bench_train_step(remat: str, attn_impl: str, batch: int = 128,
                                 make_optimizer, mfu)
     from jimm_tpu.train.metrics import train_step_flops
 
+    from jimm_tpu.configs import with_runtime
+
     cfg = preset("siglip-base-patch16-256")
     do_remat = remat != "none"
     policy = remat if remat in ("dots", "none") else "none"
     if remat == "full":
         policy = "none"
-    cfg = dataclasses.replace(
-        cfg,
-        vision=dataclasses.replace(cfg.vision, remat=do_remat,
-                                   remat_policy=policy if do_remat else "none",
-                                   attn_impl=attn_impl, ln_impl=ln_impl,
-                                   scan_unroll=unroll),
-        text=dataclasses.replace(cfg.text, remat=do_remat,
-                                 remat_policy=policy if do_remat else "none",
-                                 attn_impl=attn_impl, ln_impl=ln_impl,
-                                 scan_unroll=unroll))
+    cfg = with_runtime(cfg, remat=do_remat,
+                       remat_policy=policy if do_remat else "none",
+                       attn_impl=attn_impl, ln_impl=ln_impl,
+                       fused_qkv=fused_qkv, scan_unroll=unroll)
     model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
                    param_dtype=jnp.bfloat16)
     optimizer = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
@@ -113,7 +108,7 @@ def bench_train_step(remat: str, attn_impl: str, batch: int = 128,
     dt = (time.perf_counter() - t0) / steps
     flops = train_step_flops(cfg, batch)
     print(f"  train remat={remat:5s} attn={attn_impl:9s} ln={ln_impl:5s} "
-          f"unroll={unroll:2d} b={batch:4d} "
+          f"qkv={'fus' if fused_qkv else 'sep'} unroll={unroll:2d} b={batch:4d} "
           f"{dt*1e3:8.2f} ms  {batch/dt:7.1f} img/s  mfu={mfu(flops, dt, 1):.3f}")
 
 
@@ -126,6 +121,7 @@ def main():
     p.add_argument("--batch", type=int, default=128)
     p.add_argument("--ln", default=None)
     p.add_argument("--unroll", type=int, default=1)
+    p.add_argument("--fused-qkv", action="store_true")
     args = p.parse_args()
     print("backend:", jax.default_backend(), jax.devices()[0].device_kind)
     if args.mode in ("all", "attn"):
@@ -137,7 +133,8 @@ def main():
         for r in remats:
             for a in attns:
                 for ln in lns:
-                    bench_train_step(r, a, args.batch, ln, args.unroll)
+                    bench_train_step(r, a, args.batch, ln, args.unroll,
+                                     args.fused_qkv)
 
 
 if __name__ == "__main__":

@@ -20,8 +20,12 @@ echo "=== 2. crossover sweep ==="
 timeout 600 python -m scripts.attn_crossover 2>&1 | grep -v WARNING | tail -8
 echo "=== 2.5 fused-LN bench ==="
 timeout 600 python -m scripts.ln_bench 2>&1 | grep -v WARNING | tail -4
-echo "=== 3. train grid ==="
-timeout 900 python -m scripts.perf_probe --mode train --remat dots 2>&1 | grep -E "train remat" | tail -4
+echo "=== 3. train grid (attn x kernels at unroll 12) ==="
+timeout 900 python -m scripts.perf_probe --mode train --remat dots --unroll 12 2>&1 | grep -E "train remat" | tail -4
+echo "=== 3b. ln fused / qkv fused variants ==="
+timeout 900 python -m scripts.perf_probe --mode train --remat dots --unroll 12 --attn auto --ln fused 2>&1 | grep -E "train remat" | tail -2
+timeout 900 python -m scripts.perf_probe --mode train --remat dots --unroll 12 --attn auto --fused-qkv 2>&1 | grep -E "train remat" | tail -2
+timeout 900 python -m scripts.perf_probe --mode train --remat dots --unroll 12 --attn auto --ln fused --fused-qkv 2>&1 | grep -E "train remat" | tail -2
 echo "=== 4. bench.py (benchmark of record) ==="
 timeout 1550 python bench.py 2>&1 | tail -2
 echo "=== queue done ==="

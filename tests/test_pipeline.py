@@ -113,14 +113,10 @@ def test_transformer_prebaked_placement_matches_plain(rng, pp_mesh):
 def test_prebaked_placement_checkpoint_roundtrip(rng, tmp_path, pp_mesh):
     """Canonical HF checkpoint -> permuted (pp_stages) storage via the
     loader's layer_order -> identical forward -> canonical re-export."""
-    import dataclasses
-
     from transformers import SiglipConfig, SiglipModel
 
     from jimm_tpu import SigLIP
     from jimm_tpu.weights.export import save_pretrained
-    from jimm_tpu.weights.loader import apply_mapping, layer_orders
-    from jimm_tpu.weights.resolve import resolve_checkpoint
 
     tower = dict(hidden_size=64, intermediate_size=128, num_hidden_layers=8,
                  num_attention_heads=2, image_size=32, patch_size=16)
@@ -132,19 +128,10 @@ def test_prebaked_placement_checkpoint_roundtrip(rng, tmp_path, pp_mesh):
                                            safe_serialization=True)
 
     plain = SigLIP.from_pretrained(str(tmp_path / "src"))
-    cfg = plain.config
-    pcfg = dataclasses.replace(
-        cfg,
-        vision=dataclasses.replace(cfg.vision, pipeline=True, pp_virtual=2,
-                                   pp_stages=4, pp_microbatches=4),
-        text=dataclasses.replace(cfg.text, pipeline=True, pp_virtual=2,
-                                 pp_stages=4, pp_microbatches=4))
-    piped = SigLIP(pcfg, rngs=nnx.Rngs(0), mesh=pp_mesh, rules=PIPELINE)
-    weights, _ = resolve_checkpoint(str(tmp_path / "src"))
-    apply_mapping(piped, weights, SigLIP.hf_mapping(pcfg),
-                  num_layers=pcfg.vision.depth,
-                  num_layers_by_prefix={"text.": pcfg.text.depth},
-                  layer_order=layer_orders(pcfg))
+    piped = SigLIP.from_pretrained(
+        str(tmp_path / "src"), mesh=pp_mesh, rules=PIPELINE,
+        runtime=dict(pipeline=True, pp_virtual=2, pp_stages=4,
+                     pp_microbatches=4))
 
     img = jnp.asarray(rng.randn(8, 32, 32, 3).astype(np.float32))
     txt = jnp.asarray(rng.randint(1, 99, size=(8, 16)), jnp.int32)

@@ -90,3 +90,34 @@ def test_no_torch_in_import_graph():
             "sys.exit(1 if 'torch' in sys.modules else 0)")
     proc = subprocess.run([sys.executable, "-c", code], capture_output=True)
     assert proc.returncode == 0, proc.stderr.decode()
+
+
+def test_runtime_overrides(vit_ckpt):
+    """from_pretrained(runtime=...) flips execution-strategy fields without
+    touching architecture; architecture fields are rejected."""
+    import pytest
+
+    m = VisionTransformer.from_pretrained(
+        str(vit_ckpt), runtime=dict(remat=True, remat_policy="dots",
+                                    attn_impl="xla", scan_unroll=3))
+    assert m.config.vision.remat and m.config.vision.scan_unroll == 3
+    with pytest.raises(ValueError, match="not runtime-overridable"):
+        VisionTransformer.from_pretrained(str(vit_ckpt),
+                                          runtime=dict(width=128))
+
+
+def test_with_runtime_per_tower():
+    """Flat fields hit both towers; vision=/text= dicts target one; ViT
+    rejects text-tower overrides."""
+    import pytest
+
+    from jimm_tpu.configs import CLIPConfig, ViTConfig, with_runtime
+
+    cfg = with_runtime(CLIPConfig(), remat=True,
+                       vision=dict(pipeline=True, pp_stages=2),
+                       text=dict(scan_unroll=4))
+    assert cfg.vision.remat and cfg.text.remat
+    assert cfg.vision.pipeline and not cfg.text.pipeline
+    assert cfg.text.scan_unroll == 4 and cfg.vision.scan_unroll == 1
+    with pytest.raises(ValueError, match="no text tower"):
+        with_runtime(ViTConfig(), text=dict(remat=True))
