@@ -1,0 +1,98 @@
+"""Pipelined fine-tuning of a pretrained checkpoint.
+
+Loads an HF SigLIP checkpoint with RUNTIME overrides (execution strategy,
+not architecture — `configs.RUNTIME_FIELDS`): interleaved pipeline
+parallelism with the circular placement baked into parameter storage at
+load, remat, and dropout for fine-tuning. The reference can only load a
+checkpoint into the exact execution mode it was authored for (none — it has
+no pipeline/remat machinery at all, SURVEY §2.3).
+
+Offline demo: builds a tiny random-init HF checkpoint first so no network
+is needed; swap `make_demo_checkpoint()` for a real repo id in practice.
+
+Run (single host / CPU mesh):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/pipelined_finetune.py --steps 10
+"""
+
+from __future__ import annotations
+
+import jimm_tpu.utils.env
+
+jimm_tpu.utils.env.configure_platform()
+
+import argparse
+import tempfile
+
+import numpy as np
+
+from jimm_tpu import SigLIP
+from jimm_tpu.parallel import PIPELINE, make_mesh, shard_batch, use_sharding
+from jimm_tpu.train import (MetricsLogger, OptimizerConfig,
+                            make_contrastive_train_step, make_optimizer)
+
+
+def make_demo_checkpoint(tmpdir: str) -> str:
+    """Random-init 8-layer SigLIP saved in HF format (offline stand-in for
+    e.g. 'google/siglip-base-patch16-256')."""
+    from transformers import SiglipConfig, SiglipModel
+
+    cfg = SiglipConfig(
+        vision_config=dict(hidden_size=64, intermediate_size=128,
+                           num_hidden_layers=8, num_attention_heads=2,
+                           image_size=32, patch_size=16),
+        text_config=dict(hidden_size=64, intermediate_size=128,
+                         num_hidden_layers=8, num_attention_heads=2))
+    SiglipModel(cfg).eval().save_pretrained(tmpdir, safe_serialization=True)
+    return tmpdir
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--checkpoint", default=None,
+                   help="HF repo id or local dir (default: tiny offline demo)")
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--stages", type=int, default=4)
+    p.add_argument("--virtual", type=int, default=2)
+    p.add_argument("--microbatches", type=int, default=4)
+    args = p.parse_args()
+
+    src = args.checkpoint or make_demo_checkpoint(tempfile.mkdtemp())
+
+    mesh = make_mesh({"data": -1, "stage": args.stages})
+
+    # runtime= changes HOW the checkpoint executes, never its architecture;
+    # pp_stages bakes the interleaved placement into storage at load
+    model = SigLIP.from_pretrained(
+        src, mesh=mesh, rules=PIPELINE,
+        runtime=dict(remat=True, remat_policy="dots", dropout=0.1,
+                     pipeline=True, pp_microbatches=args.microbatches,
+                     pp_virtual=args.virtual, pp_stages=args.stages))
+    model.set_attributes(deterministic=False)  # fine-tuning: dropout active
+
+    optimizer = make_optimizer(model, OptimizerConfig(
+        learning_rate=1e-4, warmup_steps=2, total_steps=args.steps))
+    step = make_contrastive_train_step("siglip")
+    log = MetricsLogger()
+
+    rng = np.random.RandomState(0)
+    v = model.config.vision
+    with use_sharding(mesh, PIPELINE):
+        for i in range(args.steps):
+            # hand shard_batch HOST arrays: a jnp input would round-trip
+            # device -> host -> sharded placement every step
+            images = shard_batch(
+                rng.randn(args.batch_size, v.image_size, v.image_size, 3)
+                .astype(np.float32), mesh)
+            text = shard_batch(
+                rng.randint(1, model.config.text.vocab_size,
+                            size=(args.batch_size,
+                                  model.config.text.context_length))
+                .astype(np.int32), mesh)
+            metrics = step(model, optimizer, images, text)
+            log.log(i, loss=float(metrics["loss"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,9 +42,19 @@ def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
             return optax.linear_schedule(0.0, cfg.learning_rate,
                                          cfg.warmup_steps)
         return optax.constant_schedule(cfg.learning_rate)
+    # short runs (smoke tests, debug) can have total_steps <= warmup_steps;
+    # optax requires decay_steps > warmup_steps, so clamp the warmup — but
+    # loudly, since in a long run this usually means a units typo
+    warmup = min(cfg.warmup_steps, max(cfg.total_steps - 1, 0))
+    if warmup != cfg.warmup_steps:
+        import warnings
+        warnings.warn(
+            f"warmup_steps={cfg.warmup_steps} >= total_steps="
+            f"{cfg.total_steps}; clamping warmup to {warmup}",
+            stacklevel=2)
     return optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=cfg.learning_rate,
-        warmup_steps=cfg.warmup_steps, decay_steps=cfg.total_steps,
+        warmup_steps=warmup, decay_steps=cfg.total_steps,
         end_value=cfg.learning_rate * cfg.min_lr_ratio)
 
 

@@ -1,0 +1,31 @@
+"""Smoke-run the example scripts end to end (subprocess, CPU mesh)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.slow
+
+
+def _run(script: str, *args: str) -> subprocess.CompletedProcess:
+    env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin",
+           "JIMM_PLATFORM": "cpu", "JIMM_HOST_DEVICES": "8",
+           "HOME": "/tmp"}
+    return subprocess.run(
+        [sys.executable, str(REPO / "examples" / script), *args],
+        capture_output=True, text=True, timeout=900, env=env)
+
+
+def test_pipelined_finetune_example():
+    proc = _run("pipelined_finetune.py", "--steps", "3")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "step 2" in proc.stdout
+
+
+def test_siglip_training_example():
+    proc = _run("siglip_training.py", "--steps", "3", "--batch-size", "16")
+    assert proc.returncode == 0, proc.stderr[-2000:]
