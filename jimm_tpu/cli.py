@@ -306,6 +306,22 @@ def cmd_inspect(args: argparse.Namespace) -> int:
     return 0
 
 
+def _positive_int(v: str) -> int:
+    n = int(v)
+    if n < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
+    return n
+
+
+def cmd_profile_analyze(args: argparse.Namespace) -> int:
+    """Offline per-op summary of a jax.profiler capture (no TensorBoard)."""
+    from jimm_tpu.train.profile import op_stats, summarize
+    device = None if args.device < 0 else args.device
+    print(summarize(op_stats(args.dir, device=device), top=args.top,
+                    steps=args.steps))
+    return 0
+
+
 def cmd_build_native(args: argparse.Namespace) -> int:
     """Compile the native host-preprocessing library (g++, no deps)."""
     import pathlib
@@ -448,6 +464,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("inspect", help="list tensors in a safetensors file")
     sp.add_argument("file")
     sp.set_defaults(fn=cmd_inspect)
+
+    sp = sub.add_parser("profile-analyze",
+                        help="per-op summary of a jax.profiler trace dir")
+    sp.add_argument("dir", help="--profile-dir of a train run")
+    sp.add_argument("--top", type=int, default=25)
+    sp.add_argument("--steps", type=_positive_int, default=1,
+                    help="steps captured, to report per-step numbers")
+    sp.add_argument("--device", type=int, default=0,
+                    help="device index to report (-1 = sum across devices)")
+    sp.set_defaults(fn=cmd_profile_analyze)
 
     sp = sub.add_parser("build-native",
                         help="compile native/libjimm_preprocess.so")

@@ -1,9 +1,17 @@
 """Profiling hooks (SURVEY §5 tracing row): `jax.profiler` trace capture
-around training steps, viewable in TensorBoard / Perfetto."""
+around training steps, viewable in TensorBoard / Perfetto — plus an
+offline per-op analyzer so a capture can be read without TensorBoard (the
+workflow behind docs/performance.md; `python -m jimm_tpu profile-analyze`)."""
 
 from __future__ import annotations
 
+import collections
+import glob
+import gzip
+import json
+import re
 from contextlib import contextmanager
+from dataclasses import dataclass
 from pathlib import Path
 
 import jax
@@ -28,3 +36,115 @@ def trace(log_dir: str | Path, *, host_tracer_level: int = 2):
 def annotate(name: str):
     """Named region that shows up in the trace timeline."""
     return jax.profiler.TraceAnnotation(name)
+
+
+# ---------------------------------------------------------------------------
+# Offline trace analysis
+# ---------------------------------------------------------------------------
+
+#: container/framework events that would double-count their children
+_NON_OP = re.compile(r"^(while\.|jit_|\d+$|SyncOnDone|.*Module)")
+
+
+@dataclass
+class OpStat:
+    """One XLA op aggregated across its occurrences in a trace.
+    ``bytes_accessed`` is the TOTAL over all occurrences."""
+
+    name: str
+    category: str
+    total_us: float
+    count: int
+    bytes_accessed: int
+    long_name: str
+
+    @property
+    def gbps(self) -> float:
+        """Achieved HBM bandwidth (GB/s) — the number that shows whether a
+        fusion is bandwidth-bound or stalling."""
+        if not self.total_us:
+            return 0.0
+        return self.bytes_accessed / (self.total_us * 1e-6) / 1e9
+
+
+def op_stats(log_dir: str | Path, *, device: int | None = 0) -> list[OpStat]:
+    """Aggregate device-op self times from the newest ``*.trace.json.gz``
+    under ``log_dir`` (written by :func:`trace`). Pure stdlib — no
+    TensorBoard required.
+
+    ``device`` picks ONE device pid (default: the first) — under SPMD every
+    core runs the same program, and summing across cores would report
+    n_devices times the per-step time. ``None`` aggregates all devices."""
+    paths = sorted(glob.glob(str(Path(log_dir) / "**" / "*.trace.json.gz"),
+                             recursive=True))
+    if not paths:
+        raise FileNotFoundError(f"no *.trace.json.gz under {log_dir}")
+    with gzip.open(paths[-1], "rt") as f:
+        events = json.load(f)["traceEvents"]
+
+    pids = {e["pid"]: e["args"].get("name", "")
+            for e in events if e.get("ph") == "M"
+            and e.get("name") == "process_name"}
+    tnames = {(e["pid"], e["tid"]): e["args"].get("name", "")
+              for e in events if e.get("ph") == "M"
+              and e.get("name") == "thread_name"}
+    device_pids = {p for p, n in pids.items() if n.startswith("/device:")}
+    if device_pids and device is not None:
+        device_pids = {sorted(device_pids)[device]}
+    if not device_pids:  # CPU-only capture: ops run inside the host process
+        device_pids = set(pids)
+
+    def is_op_lane(lane: str) -> bool:
+        # TPU: per-core "XLA Ops" lanes; CPU: tf_XLAEigen/... executor
+        # threads. Everything else (python host frames, "Steps", module
+        # lanes) would double-count or pollute the aggregation.
+        return "XLA Ops" in lane or lane.startswith("tf_XLA")
+
+    have_op_lanes = any(is_op_lane(n) for n in tnames.values())
+
+    agg: dict[str, list] = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        lane = tnames.get((e["pid"], e["tid"]), "")
+        if have_op_lanes:
+            if not is_op_lane(lane):
+                continue
+        elif lane == "python":
+            continue
+        if _NON_OP.match(e["name"]):
+            continue
+        a = e.get("args", {})
+        r = agg.setdefault(e["name"], [0.0, 0, 0, "", a.get("hlo_category",
+                                                            "?")])
+        r[0] += e.get("dur", 0)
+        r[1] += 1
+        r[2] += int(a.get("bytes_accessed", 0) or 0)
+        r[3] = r[3] or a.get("long_name", "")
+
+    stats = [OpStat(name=k, category=v[4], total_us=v[0], count=v[1],
+                    bytes_accessed=v[2], long_name=v[3])
+             for k, v in agg.items()]
+    stats.sort(key=lambda s: -s.total_us)
+    return stats
+
+
+def summarize(stats: list[OpStat], top: int = 25, steps: int = 1) -> str:
+    """Human-readable per-op and per-category summary. ``steps`` divides the
+    totals so numbers read as per-training-step."""
+    total = sum(s.total_us for s in stats)
+    by_cat = collections.Counter()
+    for s in stats:
+        by_cat[s.category] += s.total_us
+    lines = [f"device op time: {total / steps / 1e3:.2f} ms/step",
+             "by category (ms/step):"]
+    for cat, us in by_cat.most_common():
+        lines.append(f"  {us / steps / 1e3:9.2f}  {cat}")
+    lines.append(f"top {top} ops (ms/step, n/step, MB/occurrence, GB/s):")
+    for s in stats[:top]:
+        per_occ = s.bytes_accessed / max(s.count, 1)
+        lines.append(
+            f"  {s.total_us / steps / 1e3:8.2f} n={s.count // steps:4d} "
+            f"{per_occ / 1e6:8.1f}MB {s.gbps:6.0f}GB/s  "
+            f"{s.name[:44]:44s} {s.long_name[:60]}")
+    return "\n".join(lines)
