@@ -39,6 +39,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--unroll", type=int, default=12,
                    help="layer-scan unroll factor (12 = full for ViT-B: XLA "
                         "fuses the stacked-grad updates, ~+5 MFU points)")
+    p.add_argument("--ln", choices=["xla", "fused"], default="xla",
+                   help="LayerNorm kernel (fused = one-pass Pallas)")
+    p.add_argument("--fused-qkv", action="store_true",
+                   help="q/k/v as one (H, 3H) matmul")
     p.add_argument("--no-donate", action="store_true",
                    help="disable model/optimizer buffer donation")
     p.add_argument("--timeout", type=int,
@@ -154,10 +158,10 @@ def child_main(args: argparse.Namespace) -> int:
     signal.alarm(0)
 
     from jimm_tpu import SigLIP, preset
-    from jimm_tpu.configs import SigLIPConfig, TextConfig, VisionConfig
+    from jimm_tpu.configs import (SigLIPConfig, TextConfig,
+                                  VisionConfig, with_runtime)
     from jimm_tpu.train import OptimizerConfig, make_optimizer, mfu
     from jimm_tpu.train.metrics import train_step_flops
-    import dataclasses
 
     on_tpu = jax.default_backend() == "tpu"
     batch = args.batch_size or (128 if on_tpu else 8)
@@ -170,15 +174,9 @@ def child_main(args: argparse.Namespace) -> int:
         # far cheaper than full recompute (VERDICT r1 weak #1).
         remat = args.remat != "none"
         policy = "dots" if args.remat == "dots" else "none"
-        cfg = dataclasses.replace(
-            cfg,
-            vision=dataclasses.replace(cfg.vision, remat=remat,
-                                       remat_policy=policy,
-                                       attn_impl="auto",
-                                       scan_unroll=args.unroll),
-            text=dataclasses.replace(cfg.text, remat=remat,
-                                     remat_policy=policy,
-                                     scan_unroll=args.unroll))
+        cfg = with_runtime(cfg, remat=remat, remat_policy=policy,
+                           attn_impl="auto", scan_unroll=args.unroll,
+                           ln_impl=args.ln, fused_qkv=args.fused_qkv)
     else:  # smoke-test shape so the script runs anywhere
         cfg = SigLIPConfig(
             vision=VisionConfig(image_size=32, patch_size=16, width=64,
@@ -188,6 +186,8 @@ def child_main(args: argparse.Namespace) -> int:
                             num_heads=2, mlp_dim=128, act="gelu_tanh",
                             causal=False, pooling="last", proj_bias=True),
             projection_dim=64)
+        cfg = with_runtime(cfg, ln_impl=args.ln, fused_qkv=args.fused_qkv,
+                           scan_unroll=min(args.unroll, 2))
 
     model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
                    param_dtype=jnp.bfloat16)
@@ -241,6 +241,8 @@ def child_main(args: argparse.Namespace) -> int:
         "batch_size": batch,
         "steps_timed": args.steps,
         "remat": args.remat,
+        "ln": args.ln,
+        "fused_qkv": args.fused_qkv,
         "donate": not args.no_donate,
         "device": jax.devices()[0].device_kind,
     }

@@ -9,7 +9,7 @@ loss.
 
 from jimm_tpu.configs import (CLIPConfig, SigLIPConfig, TextConfig,
                               TransformerConfig, ViTConfig, VisionConfig,
-                              PRESETS, preset)
+                              PRESETS, RUNTIME_FIELDS, preset, with_runtime)
 from jimm_tpu.models import CLIP, SigLIP, VisionTransformer
 
 __version__ = "0.1.0"
@@ -18,4 +18,5 @@ __all__ = [
     "CLIP", "SigLIP", "VisionTransformer",
     "CLIPConfig", "SigLIPConfig", "ViTConfig", "VisionConfig", "TextConfig",
     "TransformerConfig", "PRESETS", "preset",
+    "RUNTIME_FIELDS", "with_runtime",
 ]
