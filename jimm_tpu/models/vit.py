@@ -9,7 +9,6 @@ sharding policy, safetensors-only weight path (zero torch).
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any
 
 import jax

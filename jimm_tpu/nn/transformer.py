@@ -274,7 +274,8 @@ class Transformer(nnx.Module):
 
             if self.cfg.remat:
                 body = jax.checkpoint(body, policy=self._remat_policy())
-            out, _ = jax.lax.scan(body, xm, state_chunk)
+            out, _ = jax.lax.scan(body, xm, state_chunk,
+                                  unroll=self.cfg.scan_unroll)
             return out
 
         return pipeline_forward(stage_apply, state, x,

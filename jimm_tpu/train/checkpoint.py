@@ -12,7 +12,6 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Any
 
-import jax
 import numpy as np
 import orbax.checkpoint as ocp
 from flax import nnx
@@ -20,6 +19,24 @@ from flax import nnx
 
 def _split_state(obj) -> Any:
     return nnx.state(obj)
+
+
+def _storage_layout(model: nnx.Module) -> dict[str, Any] | None:
+    """Fingerprint of any baked pipeline placement (`nn/transformer.py`
+    pp_stages): layer rows are stored in circular schedule order, so a
+    restore into a DIFFERENT placement would permute layers silently —
+    shapes all match. Recorded at save, validated at restore."""
+    cfg = getattr(model, "config", None)
+    if cfg is None:
+        return None
+    layout: dict[str, Any] = {}
+    for tower in ("vision", "text"):
+        t = getattr(cfg, tower, None)
+        if (t is not None and getattr(t, "pipeline", False)
+                and t.pp_virtual > 1 and t.pp_stages):
+            layout[tower] = {"pp_stages": t.pp_stages,
+                             "pp_virtual": t.pp_virtual, "depth": t.depth}
+    return layout or None
 
 
 class CheckpointManager:
@@ -43,8 +60,12 @@ class CheckpointManager:
         if optimizer is not None:
             items["opt"] = ocp.args.StandardSave(
                 nnx.state(optimizer, nnx.optimizer.OptState))
-        if extra:
-            items["extra"] = ocp.args.JsonSave(extra)
+        meta = dict(extra or {})
+        layout = _storage_layout(model)
+        if layout is not None:
+            meta["_storage_layout"] = layout
+        if meta:
+            items["extra"] = ocp.args.JsonSave(meta)
         return self._mgr.save(step, args=ocp.args.Composite(**items),
                               force=force)
 
@@ -52,7 +73,9 @@ class CheckpointManager:
                 optimizer: nnx.Optimizer | None = None,
                 *, step: int | None = None) -> int:
         """Restore in place (onto each param's current sharding); returns the
-        restored step."""
+        restored step. Raises if the checkpoint was saved with a different
+        baked pipeline placement than ``model`` uses — every shape would
+        match but layer rows would be permuted."""
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError("no checkpoint found")
@@ -62,7 +85,27 @@ class CheckpointManager:
         if optimizer is not None:
             items["opt"] = ocp.args.StandardRestore(
                 nnx.state(optimizer, nnx.optimizer.OptState))
-        restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
+        items["extra"] = ocp.args.JsonRestore()
+        try:
+            restored = self._mgr.restore(step,
+                                         args=ocp.args.Composite(**items))
+            saved_meta = restored.get("extra") or {}
+        except (FileNotFoundError, KeyError, ValueError):
+            # checkpoint without an extra/ item (older save, or bare state)
+            del items["extra"]
+            restored = self._mgr.restore(step,
+                                         args=ocp.args.Composite(**items))
+            saved_meta = {}
+        saved = (saved_meta or {}).get("_storage_layout")
+        current = _storage_layout(model)
+        if saved != current:
+            raise ValueError(
+                f"checkpoint step {step} was saved with baked pipeline "
+                f"placement {saved} but the model uses {current}; restoring "
+                "would silently permute layer rows. Rebuild the model with "
+                "the saved pp_stages/pp_virtual (see configs.with_runtime) "
+                "or export/import through save_pretrained, which is always "
+                "canonical.")
         nnx.update(model, restored["model"])
         if optimizer is not None:
             nnx.update(optimizer, restored["opt"])

@@ -226,3 +226,48 @@ def test_checkpoint_restores_across_topologies(tmp_path, rng, eight_devices):
     # params really live on the TP mesh sharding
     kernel = model2.vision.encoder.blocks.mlp.fc1.kernel
     assert kernel.get_value().sharding.mesh.shape == dict(tp_mesh.shape)
+
+
+def test_checkpoint_rejects_mismatched_baked_placement(tmp_path, rng,
+                                                       eight_devices):
+    """A checkpoint saved with pp_stages-baked (schedule-ordered) storage
+    must not restore into a differently-placed model: every shape matches,
+    but layer rows would be silently permuted."""
+    import dataclasses
+
+    import pytest
+
+    from jimm_tpu import SigLIP
+    from jimm_tpu.configs import SigLIPConfig, TextConfig, VisionConfig
+    from jimm_tpu.parallel import PIPELINE, use_sharding
+
+    def build(pp_stages):
+        pp = dict(pipeline=True, pp_microbatches=4, pp_virtual=2,
+                  pp_stages=pp_stages)
+        cfg = SigLIPConfig(
+            vision=VisionConfig(image_size=32, patch_size=16, width=32,
+                                depth=8, num_heads=2, mlp_dim=64,
+                                act="gelu_tanh", pooling="map", **pp),
+            text=TextConfig(vocab_size=64, context_length=8, width=32,
+                            depth=8, num_heads=2, mlp_dim=64, act="gelu_tanh",
+                            causal=False, pooling="last", proj_bias=True,
+                            **pp),
+            projection_dim=32)
+        mesh = make_mesh({"data": 8 // pp_stages, "stage": pp_stages})
+        return SigLIP(cfg, rngs=nnx.Rngs(0), mesh=mesh, rules=PIPELINE)
+
+    model = build(pp_stages=4)
+    mgr = CheckpointManager(tmp_path / "pp")
+    assert mgr.save(0, model, force=True)
+    mgr.wait()
+    mgr.close()
+
+    # same shapes, different schedule order -> must refuse
+    other = build(pp_stages=2)
+    mgr2 = CheckpointManager(tmp_path / "pp")
+    with pytest.raises(ValueError, match="baked pipeline placement"):
+        mgr2.restore(other)
+    # identical placement restores fine
+    same = build(pp_stages=4)
+    assert mgr2.restore(same) == 0
+    mgr2.close()
