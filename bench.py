@@ -34,8 +34,16 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="0 = auto (TPU: 128, CPU: 8)")
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--remat", choices=["none", "full", "dots"], default="dots",
-                   help="activation rematerialization inside the layer scan")
+    p.add_argument("--remat", default="dots",
+                   help="activation rematerialization inside the layer scan: "
+                        "none (remat off), full (remat, recompute all), or "
+                        "dots with +ln/+act/+attn suffixes (save matmul "
+                        "[+layernorm][+activation][+attention-prob] outputs), "
+                        "e.g. dots+ln+act")
+    p.add_argument("--attn", default="auto",
+                   choices=["auto", "xla", "flash", "saveable"],
+                   help="attention kernel (saveable = einsum with "
+                        "checkpoint-named probs, pair with --remat dots+attn)")
     p.add_argument("--unroll", type=int, default=12,
                    help="layer-scan unroll factor (12 = full for ViT-B: XLA "
                         "fuses the stacked-grad updates, ~+5 MFU points)")
@@ -45,13 +53,24 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="q/k/v as one (H, 3H) matmul")
     p.add_argument("--no-donate", action="store_true",
                    help="disable model/optimizer buffer donation")
+    p.add_argument("--moment-dtype", choices=["f32", "bf16"], default="f32",
+                   help="Adam first-moment dtype (bf16 halves that buffer's "
+                        "HBM traffic)")
     p.add_argument("--timeout", type=int,
                    default=int(os.environ.get("BENCH_TIMEOUT_S", "1500")),
                    help="watchdog: kill the child after this many seconds")
     p.add_argument("--probe-timeout", type=int, default=150,
                    help="child: SIGALRM around backend init + probe matmul")
     p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    # fail malformed --remat at parse time, not minutes later in the child's
+    # first jit trace
+    from jimm_tpu.configs import parse_remat
+    try:
+        parse_remat(args.remat)
+    except ValueError as e:
+        p.error(str(e))
+    return args
 
 
 # ---------------------------------------------------------------------------
@@ -163,6 +182,8 @@ def child_main(args: argparse.Namespace) -> int:
     from jimm_tpu.train import OptimizerConfig, make_optimizer, mfu
     from jimm_tpu.train.metrics import train_step_flops
 
+    from jimm_tpu.configs import parse_remat
+
     on_tpu = jax.default_backend() == "tpu"
     batch = args.batch_size or (128 if on_tpu else 8)
 
@@ -172,12 +193,11 @@ def child_main(args: argparse.Namespace) -> int:
         # big-batch training step overflows one chip's 16G HBM. Policy
         # "dots" keeps matmul outputs and recomputes only elementwise ops —
         # far cheaper than full recompute (VERDICT r1 weak #1).
-        remat = args.remat != "none"
-        policy = "dots" if args.remat == "dots" else "none"
-        cfg = with_runtime(cfg, remat=remat, remat_policy=policy,
-                           attn_impl="auto", scan_unroll=args.unroll,
+        cfg = with_runtime(cfg, **parse_remat(args.remat),
+                           attn_impl=args.attn, scan_unroll=args.unroll,
                            ln_impl=args.ln, fused_qkv=args.fused_qkv)
-    else:  # smoke-test shape so the script runs anywhere
+    else:  # smoke-test shape so the script runs anywhere; same runtime flags
+        # as the TPU branch so the reported JSON matches what actually ran
         cfg = SigLIPConfig(
             vision=VisionConfig(image_size=32, patch_size=16, width=64,
                                 depth=2, num_heads=2, mlp_dim=128,
@@ -186,12 +206,16 @@ def child_main(args: argparse.Namespace) -> int:
                             num_heads=2, mlp_dim=128, act="gelu_tanh",
                             causal=False, pooling="last", proj_bias=True),
             projection_dim=64)
-        cfg = with_runtime(cfg, ln_impl=args.ln, fused_qkv=args.fused_qkv,
+        cfg = with_runtime(cfg, **parse_remat(args.remat),
+                           attn_impl=args.attn,
+                           ln_impl=args.ln, fused_qkv=args.fused_qkv,
                            scan_unroll=min(args.unroll, 2))
 
     model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
                    param_dtype=jnp.bfloat16)
-    optimizer = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
+    moment_dtype = "bfloat16" if args.moment_dtype == "bf16" else None
+    optimizer = make_optimizer(model, OptimizerConfig(
+        learning_rate=1e-3, moment_dtype=moment_dtype))
 
     from jimm_tpu.train import make_contrastive_train_step
     step_fn = make_contrastive_train_step("siglip", donate=not args.no_donate)
@@ -241,8 +265,10 @@ def child_main(args: argparse.Namespace) -> int:
         "batch_size": batch,
         "steps_timed": args.steps,
         "remat": args.remat,
+        "attn": args.attn,
         "ln": args.ln,
         "fused_qkv": args.fused_qkv,
+        "moment_dtype": args.moment_dtype,
         "donate": not args.no_donate,
         "device": jax.devices()[0].device_kind,
     }

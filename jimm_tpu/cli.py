@@ -125,6 +125,16 @@ def cmd_train(args: argparse.Namespace) -> int:
         cfg = _tiny_override(cfg)
     if args.attn_impl:
         cfg = _replace_towers(cfg, attn_impl=args.attn_impl)
+    if args.remat:
+        from jimm_tpu.configs import parse_remat
+        try:
+            cfg = _replace_towers(cfg, **parse_remat(args.remat))
+        except ValueError as e:
+            raise SystemExit(f"--remat: {e}")
+    if args.ln_impl:
+        cfg = _replace_towers(cfg, ln_impl=args.ln_impl)
+    if args.fused_qkv:
+        cfg = _replace_towers(cfg, fused_qkv=True)
     mesh = _parse_mesh(args.mesh)
     pp_extra = {}
     if args.pipeline_virtual > 1:
@@ -166,7 +176,8 @@ def cmd_train(args: argparse.Namespace) -> int:
                             rules=rules, dtype=dtype, param_dtype=dtype)
     optimizer = make_optimizer(model, OptimizerConfig(
         learning_rate=args.lr, weight_decay=args.weight_decay,
-        warmup_steps=args.warmup_steps, total_steps=args.steps))
+        warmup_steps=args.warmup_steps, total_steps=args.steps,
+        moment_dtype="bfloat16" if args.bf16_momentum else None))
 
     import jax
 
@@ -426,9 +437,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--loss", default=None,
                     choices=["clip", "siglip", "siglip_ring"])
     sp.add_argument("--attn-impl", default=None,
-                    choices=["auto", "xla", "flash", "ring"],
+                    choices=["auto", "xla", "flash", "ring", "saveable"],
                     help="attention kernel for both towers "
-                         "(ring = sequence-parallel, needs a seq mesh axis)")
+                         "(ring = sequence-parallel, needs a seq mesh axis; "
+                         "saveable = checkpoint-named probs for --remat "
+                         "dots+attn)")
+    sp.add_argument("--remat", default=None,
+                    help="activation remat in the layer scan: none (off), "
+                         "full (recompute all), or dots with +ln/+act/+attn "
+                         "suffixes (save matmul [+layernorm][+activation]"
+                         "[+attention-prob] outputs)")
+    sp.add_argument("--ln-impl", default=None, choices=["xla", "fused"],
+                    help="LayerNorm kernel (fused = one-pass Pallas)")
+    sp.add_argument("--fused-qkv", action="store_true",
+                    help="q/k/v as one (H, 3H) matmul")
+    sp.add_argument("--bf16-momentum", action="store_true",
+                    help="keep Adam's first moment in bfloat16 (halves that "
+                         "buffer's HBM footprint and traffic)")
     sp.add_argument("--pipeline-microbatches", type=int, default=0,
                     help="enable pipeline parallelism with N microbatches "
                          "(needs a 'stage' mesh axis and --rules pp)")

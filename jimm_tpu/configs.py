@@ -17,7 +17,33 @@ from typing import Any, Literal
 
 Pooling = Literal["cls", "map", "last", "eot", "none"]
 Activation = Literal["gelu", "gelu_tanh", "quick_gelu"]
-AttnImpl = Literal["auto", "xla", "flash", "ring"]
+AttnImpl = Literal["auto", "xla", "flash", "ring", "saveable"]
+#: "dots" + optional "+ln"/"+act"/"+attn" save-list extensions
+RematPolicy = str
+
+
+def remat_policy_parts(policy: str) -> list[str]:
+    """Validate a remat policy string; return its ``+``-separated parts.
+    Canonical validator shared by the CLI/bench parse layer and the
+    execution point (`nn/transformer.py:_remat_policy`)."""
+    parts = policy.split("+")
+    if policy != "none" and (parts[0] != "dots"
+                             or not set(parts[1:]) <= {"ln", "act", "attn"}):
+        raise ValueError(f"unknown remat_policy {policy!r}; expected 'none' "
+                         "or 'dots' with optional '+ln', '+act', '+attn' "
+                         "suffixes (e.g. 'dots+ln+act')")
+    return parts
+
+
+def parse_remat(spec: str) -> dict[str, Any]:
+    """CLI ``--remat`` spec -> `with_runtime` kwargs. ``none`` = remat off,
+    ``full`` = remat with full recompute, ``dots[+ln][+act][+attn]`` = remat
+    with that save-list. Raises ValueError on a malformed spec, so tools can
+    fail at parse time instead of deep inside the first jit trace."""
+    if spec in ("none", "full"):
+        return {"remat": spec != "none", "remat_policy": "none"}
+    remat_policy_parts(spec)
+    return {"remat": True, "remat_policy": spec}
 
 
 def normalize_act(name: str | None, default: str = "gelu") -> str:
@@ -104,7 +130,7 @@ class TransformerConfig:
     #: "none" recomputes everything (min memory, ~1/3 extra FLOPs); "dots"
     #: saves matmul outputs and recomputes only cheap elementwise ops
     #: (ln/act/softmax) — the usual best MFU/memory trade on TPU.
-    remat_policy: Literal["none", "dots"] = "none"
+    remat_policy: RematPolicy = "none"
     #: LayerNorm kernel: "xla" (nnx.LayerNorm) or "fused" (one-pass Pallas
     #: fwd/bwd, `jimm_tpu/ops/layer_norm.py`).
     ln_impl: Literal["xla", "fused"] = "xla"
@@ -151,7 +177,7 @@ class VisionConfig:
     pp_virtual: int = 1
     pp_stages: int = 0
     remat: bool = False
-    remat_policy: Literal["none", "dots"] = "none"
+    remat_policy: RematPolicy = "none"
     ln_impl: Literal["xla", "fused"] = "xla"
     fused_qkv: bool = False
     scan_unroll: int = 1
@@ -208,7 +234,7 @@ class TextConfig:
     pp_virtual: int = 1
     pp_stages: int = 0
     remat: bool = False
-    remat_policy: Literal["none", "dots"] = "none"
+    remat_policy: RematPolicy = "none"
     ln_impl: Literal["xla", "fused"] = "xla"
     fused_qkv: bool = False
     scan_unroll: int = 1

@@ -29,6 +29,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from flax import nnx
+from jax.ad_checkpoint import checkpoint_name
 
 from jimm_tpu.configs import TransformerConfig
 from jimm_tpu.ops.activations import get_activation
@@ -123,7 +124,8 @@ class Mlp(nnx.Module):
         self.act: Callable = get_activation(act)
 
     def __call__(self, x: jax.Array) -> jax.Array:
-        return self.fc2(self.act(self.fc1(x)))
+        # name is free (identity) unless a "+act" remat policy saves it
+        return self.fc2(checkpoint_name(self.act(self.fc1(x)), "act_out"))
 
 
 #: dropout-stream draws per Block.__call__ (attn residual + mlp residual);
@@ -149,8 +151,11 @@ class Block(nnx.Module):
         self.dropout = nnx.Dropout(cfg.dropout, rngs=rngs)
 
     def __call__(self, x: jax.Array) -> jax.Array:
-        x = x + self.dropout(self.attn(self.ln1(x)))
-        x = x + self.dropout(self.mlp(self.ln2(x)))
+        # ln outputs carry a checkpoint name so "+ln" remat policies can keep
+        # them (skipping the LN recompute in the backward); plain identity
+        # under every other policy
+        x = x + self.dropout(self.attn(checkpoint_name(self.ln1(x), "ln_out")))
+        x = x + self.dropout(self.mlp(checkpoint_name(self.ln2(x), "ln_out")))
         return logical_constraint(x, "batch", "seq", None)
 
 
@@ -190,15 +195,32 @@ class Transformer(nnx.Module):
         # saving S^2 attention probabilities is pure HBM waste) plus the
         # flash kernel's o/lse residuals, so the backward recomputes only
         # elementwise ops; "none" is classic full rematerialization.
-        if self.cfg.remat_policy == "dots":
-            return jax.checkpoint_policies.save_from_both_policies(
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                jax.checkpoint_policies.save_only_these_names(
-                    "flash_o", "flash_lse"))
-        if self.cfg.remat_policy == "none":
+        # "+ln" / "+act" additionally keep the LayerNorm / MLP-activation
+        # outputs — a bit more HBM for one less elementwise recompute pass
+        # each (the step is bandwidth-bound; see docs/performance.md).
+        from jimm_tpu.configs import remat_policy_parts
+        policy = self.cfg.remat_policy
+        if policy == "none":
             return None
-        raise ValueError(f"unknown remat_policy {self.cfg.remat_policy!r}; "
-                         "expected 'none' or 'dots'")
+        parts = remat_policy_parts(policy)
+        names = ["flash_o", "flash_lse"]
+        if "ln" in parts:
+            names.append("ln_out")
+        if "act" in parts:
+            names.append("act_out")
+        if "attn" in parts:
+            # only the "saveable" attention impl emits this name — with any
+            # other impl the save-list entry matches nothing and the run
+            # silently measures plain "dots"
+            if self.cfg.attn_impl != "saveable":
+                raise ValueError(
+                    f"remat_policy {policy!r} saves attention probabilities, "
+                    f"but attn_impl={self.cfg.attn_impl!r} never emits them; "
+                    "use attn_impl='saveable'")
+            names.append("attn_probs")
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(*names))
 
     def _apply_stack(self, blocks: Block, x: jax.Array) -> jax.Array:
         """Scan ``x`` through a stacked block module (all layers or one

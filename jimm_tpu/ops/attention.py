@@ -11,6 +11,10 @@ The reference locks attention to ``flax.nnx.MultiHeadAttention``'s einsum path
 - ``"ring"`` — sequence-parallel ring attention over the ambient mesh's
   ``seq`` axis (long context across chips; flash within each chip on TPU).
   See `jimm_tpu/parallel/ring_attention.py`.
+- ``"saveable"`` — explicit einsum attention whose bf16 probabilities carry a
+  ``checkpoint_name`` so the ``"dots+attn"`` remat policy can keep them: the
+  remat'd backward then skips the qk^T + softmax recompute at the cost of one
+  (B, N, Sq, Sk) bf16 tensor per layer. Only sensible at short sequence.
 - ``"auto"`` — flash on TPU when shapes qualify, else XLA.
 """
 
@@ -20,6 +24,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 
 @functools.cache
@@ -70,9 +75,34 @@ def dot_product_attention(
     if impl == "xla":
         return jax.nn.dot_product_attention(q, k, v, mask=mask,
                                             is_causal=is_causal)
+    if impl == "saveable":
+        return saveable_attention(q, k, v, is_causal=is_causal, mask=mask)
     if impl == "einsum":  # reference semantics, fp32 softmax; used in tests
         return reference_attention(q, k, v, is_causal=is_causal, mask=mask)
     raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def saveable_attention(q, k, v, *, is_causal=False, mask=None):
+    """Attention with fp32-softmax numerics (matching the XLA path) whose
+    probabilities are bf16-cast and checkpoint-named: under a ``"dots+attn"``
+    remat policy the backward reuses them instead of recomputing
+    qk^T + softmax — ~half the attention recompute FLOPs for
+    ``O(B*N*Sq*Sk)`` bytes of HBM. The ``p @ v`` product is a batched dot,
+    deliberately NOT saved (recomputing it from saved p is one matmul)."""
+    dtype = q.dtype
+    depth = q.shape[-1]
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits * (1.0 / depth ** 0.5)
+    sq, sk = logits.shape[-2], logits.shape[-1]
+    if is_causal:
+        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        logits = jnp.where(causal, logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = checkpoint_name(
+        jax.nn.softmax(logits, axis=-1).astype(dtype), "attn_probs")
+    return jnp.einsum("bnqk,bknd->bqnd", probs, v)
 
 
 def reference_attention(q, k, v, *, is_causal=False, mask=None):

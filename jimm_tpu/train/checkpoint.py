@@ -85,18 +85,17 @@ class CheckpointManager:
         if optimizer is not None:
             items["opt"] = ocp.args.StandardRestore(
                 nnx.state(optimizer, nnx.optimizer.OptState))
-        items["extra"] = ocp.args.JsonRestore()
-        try:
-            restored = self._mgr.restore(step,
-                                         args=ocp.args.Composite(**items))
-            saved_meta = restored.get("extra") or {}
-        except (FileNotFoundError, KeyError, ValueError):
-            # checkpoint without an extra/ item (older save, or bare state)
-            del items["extra"]
-            restored = self._mgr.restore(step,
-                                         args=ocp.args.Composite(**items))
-            saved_meta = {}
-        saved = (saved_meta or {}).get("_storage_layout")
+        # probe for the optional extra/ item by its committed directory (the
+        # manager uses default step naming) instead of catch-and-retry: a
+        # corrupt/unreadable extra must FAIL the restore, not silently skip
+        # the placement guard below, and a genuine model-state error must not
+        # trigger a pointless second multi-GB restore attempt
+        has_extra = (self._mgr.directory / str(step) / "extra").exists()
+        if has_extra:
+            items["extra"] = ocp.args.JsonRestore()
+        restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
+        saved_meta = (restored.get("extra") or {}) if has_extra else {}
+        saved = saved_meta.get("_storage_layout")
         current = _storage_layout(model)
         if saved != current:
             raise ValueError(

@@ -34,6 +34,10 @@ class OptimizerConfig:
     b2: float = 0.999
     grad_clip_norm: float | None = 1.0
     min_lr_ratio: float = 0.0
+    #: dtype for Adam's first moment (optax ``mu_dtype``); "bfloat16" halves
+    #: that buffer's HBM footprint and read/write traffic on the (bandwidth-
+    #: bound) update. None = accumulate in the param dtype.
+    moment_dtype: str | None = None
 
 
 def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
@@ -70,7 +74,8 @@ def make_optimizer(model: nnx.Module, cfg: OptimizerConfig) -> nnx.Optimizer:
     if cfg.grad_clip_norm:
         chain.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
     chain.append(optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2,
-                             weight_decay=cfg.weight_decay, mask=decay_mask))
+                             weight_decay=cfg.weight_decay, mask=decay_mask,
+                             mu_dtype=cfg.moment_dtype))
     return nnx.Optimizer(model, optax.chain(*chain), wrt=nnx.Param)
 
 

@@ -1,0 +1,144 @@
+"""Sweep train-step runtime variants in ONE process (single backend init,
+shared compile cache) and print one JSON line per variant.
+
+The benchmark of record stays `bench.py`; this is the tuning tool that finds
+the flags `bench.py` should default to. Usage:
+
+    python -m scripts.bench_sweep                       # the standard grid
+    python -m scripts.bench_sweep --steps 30 \
+        --variant remat=dots,ln=fused \
+        --variant "remat=dots+ln,fused_qkv=1,unroll=6"
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import time
+
+
+VARIANT_KEYS = frozenset(
+    {"remat", "ln", "fused_qkv", "unroll", "moment", "donate", "attn"})
+
+
+def parse_variant(s: str) -> dict:
+    out = {}
+    for kv in s.split(","):
+        k, _, v = kv.partition("=")
+        k = k.strip()
+        if k not in VARIANT_KEYS:
+            # a typo'd key silently running the baseline would produce a
+            # misleading datapoint in the tool that picks bench defaults
+            raise SystemExit(f"unknown variant key {k!r} in {s!r}; "
+                             f"allowed: {sorted(VARIANT_KEYS)}")
+        out[k] = v.strip()
+    return out
+
+
+STANDARD_GRID = [
+    "remat=dots",
+    "remat=dots,ln=fused",
+    "remat=dots,fused_qkv=1",
+    "remat=dots,ln=fused,fused_qkv=1",
+    "remat=dots+ln",
+    "remat=dots+ln+act",
+    "remat=dots+ln+act,fused_qkv=1",
+    "remat=dots,moment=bf16",
+    "remat=dots+attn,attn=saveable",
+    "remat=dots+ln+act+attn,attn=saveable",
+]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--unroll", type=int, default=12,
+                   help="default scan unroll for variants that don't set it")
+    p.add_argument("--variant", action="append", default=None,
+                   help="comma-separated k=v list; repeatable. Keys: remat, "
+                        "attn, ln, fused_qkv, unroll, moment, donate")
+    args = p.parse_args()
+
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      str(pathlib.Path(__file__).resolve().parent.parent
+                          / ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import SigLIP, preset
+    from jimm_tpu.configs import parse_remat, with_runtime
+    from jimm_tpu.train import (OptimizerConfig, make_contrastive_train_step,
+                                make_optimizer, mfu)
+    from jimm_tpu.train.metrics import train_step_flops
+
+    variants = [parse_variant(v) for v in (args.variant or STANDARD_GRID)]
+    rng = np.random.RandomState(0)
+    base = preset("siglip-base-patch16-256")
+    images_np = rng.randn(args.batch, base.vision.image_size,
+                          base.vision.image_size, 3)
+    text_np = rng.randint(1, base.text.vocab_size,
+                          size=(args.batch, base.text.context_length))
+
+    for v in variants:
+        cfg = with_runtime(
+            base,
+            **parse_remat(v.get("remat", "dots")),
+            attn_impl=v.get("attn", "auto"),
+            scan_unroll=int(v.get("unroll", args.unroll)),
+            ln_impl=v.get("ln", "xla"),
+            fused_qkv=v.get("fused_qkv", "0") in ("1", "true"),
+        )
+        def sync(model, metrics):
+            # host materialization through the last optimizer update —
+            # block_until_ready can lie on remote-tunnel platforms
+            float(metrics["loss"])
+            float(nnx.state(model, nnx.Param)["logit_scale"].get_value())
+
+        model = optimizer = step_fn = metrics = None
+        try:
+            model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
+                           param_dtype=jnp.bfloat16)
+            moment = {"bf16": "bfloat16"}.get(v.get("moment"))
+            optimizer = make_optimizer(model, OptimizerConfig(
+                learning_rate=1e-3, moment_dtype=moment))
+            step_fn = make_contrastive_train_step(
+                "siglip", donate=v.get("donate", "1") in ("1", "true"))
+            images = jnp.asarray(images_np, jnp.bfloat16)
+            text = jnp.asarray(text_np, jnp.int32)
+
+            t_c0 = time.perf_counter()
+            for _ in range(args.warmup):
+                metrics = step_fn(model, optimizer, images, text)
+            sync(model, metrics)
+            compile_s = time.perf_counter() - t_c0
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                metrics = step_fn(model, optimizer, images, text)
+            sync(model, metrics)
+            dt = (time.perf_counter() - t0) / args.steps
+        except Exception as e:  # OOM on an aggressive save policy: keep going
+            print(json.dumps({"variant": v, "error": repr(e)[:300]}),
+                  flush=True)
+            continue
+        finally:
+            # drop this variant's buffers even on failure, so an OOM'd
+            # variant doesn't double-book HBM under the next one
+            del model, optimizer, step_fn, metrics
+        flops = train_step_flops(cfg, args.batch)
+        print(json.dumps({
+            "variant": v,
+            "step_time_ms": round(dt * 1e3, 2),
+            "images_per_sec": round(args.batch / dt, 1),
+            "mfu": round(mfu(flops, dt, n_devices=1), 4),
+            "warmup_s": round(compile_s, 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Extended remat save-lists (+ln/+act/+attn), the saveable-probs attention
+impl, and the bf16-moment optimizer option.
+
+These are pure runtime (execution-strategy) knobs: every variant must produce
+the same loss as the baseline "dots" policy, because none of them changes
+the math — only what the backward keeps vs recomputes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import nnx
+
+from jimm_tpu import SigLIP
+from jimm_tpu.configs import (SigLIPConfig, TextConfig, VisionConfig,
+                              with_runtime)
+from jimm_tpu.ops.attention import reference_attention, saveable_attention
+from jimm_tpu.train import (OptimizerConfig, make_contrastive_train_step,
+                            make_optimizer)
+
+
+def tiny_cfg(**runtime):
+    cfg = SigLIPConfig(
+        vision=VisionConfig(image_size=32, patch_size=16, width=64, depth=2,
+                            num_heads=2, mlp_dim=96, act="gelu_tanh",
+                            pooling="map"),
+        text=TextConfig(vocab_size=64, context_length=8, width=64, depth=2,
+                        num_heads=2, mlp_dim=96, act="gelu_tanh", causal=False,
+                        pooling="last", proj_bias=True),
+        projection_dim=64)
+    return with_runtime(cfg, **runtime) if runtime else cfg
+
+
+def test_saveable_attention_matches_reference():
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(2, 6, 2, 8), jnp.float32)
+               for _ in range(3))
+    out = saveable_attention(q, k, v)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # causal too
+    out_c = saveable_attention(q, k, v, is_causal=True)
+    ref_c = reference_attention(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out_c), np.asarray(ref_c), atol=1e-5)
+
+
+def _one_step_loss(policy: str, attn: str = "auto") -> float:
+    cfg = tiny_cfg(remat=True, remat_policy=policy, attn_impl=attn)
+    model = SigLIP(cfg, rngs=nnx.Rngs(0))
+    opt = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
+    step = make_contrastive_train_step("siglip")
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(4, 32, 32, 3), jnp.float32)
+    text = jnp.asarray(rng.randint(1, 64, size=(4, 8)), jnp.int32)
+    m = step(model, opt, images, text)
+    m = step(model, opt, images, text)  # second step sees updated params
+    return float(m["loss"])
+
+
+@pytest.mark.parametrize("policy,attn", [
+    ("dots+ln", "auto"),
+    ("dots+act", "auto"),
+    ("dots+ln+act", "auto"),
+    ("dots+attn", "saveable"),
+    ("dots+ln+act+attn", "saveable"),
+])
+def test_extended_policies_match_dots(policy, attn):
+    base = _one_step_loss("dots")
+    got = _one_step_loss(policy, attn)
+    # identical math, different save-lists: losses agree to fp tolerance
+    assert abs(got - base) < 5e-4, (policy, got, base)
+
+
+def test_unknown_policy_rejected():
+    cfg = tiny_cfg(remat=True, remat_policy="dots+bogus")
+    model = SigLIP(cfg, rngs=nnx.Rngs(0))
+    with pytest.raises(ValueError, match="remat_policy"):
+        model(jnp.ones((1, 32, 32, 3)), jnp.ones((1, 8), jnp.int32))
+
+
+def test_attn_save_requires_saveable_impl():
+    # "+attn" with an impl that never emits attn_probs would silently
+    # measure plain "dots"; it must refuse instead
+    cfg = tiny_cfg(remat=True, remat_policy="dots+attn", attn_impl="auto")
+    model = SigLIP(cfg, rngs=nnx.Rngs(0))
+    with pytest.raises(ValueError, match="saveable"):
+        model(jnp.ones((1, 32, 32, 3)), jnp.ones((1, 8), jnp.int32))
+
+
+def test_parse_remat():
+    from jimm_tpu.configs import parse_remat
+    assert parse_remat("none") == {"remat": False, "remat_policy": "none"}
+    assert parse_remat("full") == {"remat": True, "remat_policy": "none"}
+    assert parse_remat("dots+ln+act") == {"remat": True,
+                                          "remat_policy": "dots+ln+act"}
+    with pytest.raises(ValueError):
+        parse_remat("dot")  # typo must fail at parse time, not in jit trace
+
+
+def test_bf16_moment_dtype():
+    cfg = tiny_cfg()
+    model = SigLIP(cfg, rngs=nnx.Rngs(0))
+    opt = make_optimizer(model, OptimizerConfig(moment_dtype="bfloat16"))
+    leaves = jax.tree.leaves(nnx.state(opt))
+    assert any(getattr(l, "dtype", None) == jnp.bfloat16 for l in leaves), \
+        "no bf16 moment buffers found in optimizer state"
+    # and the step still runs
+    step = make_contrastive_train_step("siglip")
+    m = step(model, opt, jnp.ones((2, 32, 32, 3)),
+             jnp.ones((2, 8), jnp.int32))
+    assert np.isfinite(float(m["loss"]))
