@@ -198,9 +198,42 @@ def cmd_train(args: argparse.Namespace) -> int:
                    shard_count=jax.process_count(),
                    shuffle_buffer=args.shuffle_buffer, seed=args.seed,
                    skip_examples=start_step * args.batch_size)
+
+    grain_iter = None  # raw grain iterator, for checkpointable state
+
+    def _grain_data(task: str):
+        nonlocal grain_iter
+        import base64
+
+        from jimm_tpu.data.grain_pipeline import (grain_batches,
+                                                  make_grain_loader)
+        extra = ({"seq_len": cfg.text.context_length}
+                 if task == "contrastive" else {})
+        loader = make_grain_loader(
+            args.data, args.batch_size, task=task,
+            image_size=cfg.vision.image_size, seed=args.seed,
+            worker_count=args.data_workers,
+            shard_index=jax.process_index(),
+            shard_count=jax.process_count(), **extra)
+        grain_iter = iter(loader)
+        saved = (ckpt.last_restored_extra.get("grain_state")
+                 if ckpt is not None else None)
+        if start_step and saved:
+            # exact position from the checkpoint — no decode replay.
+            # (Captured after the saved step's batch; under PrefetchIterator
+            # the producer may have pulled a couple of batches ahead, so up
+            # to `prefetch` batches are skipped, never repeated.)
+            grain_iter.set_state(base64.b64decode(saved))
+        else:
+            for _ in range(start_step):  # pre-grain_state checkpoint:
+                next(grain_iter)         # replay (decodes) to position
+        return grain_batches(grain_iter)
+
     if fam == "vit":
         step_fn = make_classifier_train_step()
-        if args.data:
+        if args.data and args.loader == "grain":
+            data = _grain_data("classification")
+        elif args.data:
             from jimm_tpu.data.records import classification_batches
             data = classification_batches(
                 args.data, args.batch_size,
@@ -215,7 +248,9 @@ def cmd_train(args: argparse.Namespace) -> int:
                                   ("siglip_ring" if mesh is not None
                                    else "siglip"))
         step_fn = make_contrastive_train_step(loss_kind, mesh=mesh)
-        if args.data:
+        if args.data and args.loader == "grain":
+            data = _grain_data("contrastive")
+        elif args.data:
             from jimm_tpu.data.records import image_text_batches
             data = image_text_batches(
                 args.data, args.batch_size,
@@ -265,7 +300,12 @@ def cmd_train(args: argparse.Namespace) -> int:
                 logger.log(step, step_time_s=dt,
                            **{k: float(v) for k, v in metrics.items()})
                 if ckpt is not None:
-                    ckpt.save(step, model, optimizer)
+                    extra = None
+                    if grain_iter is not None:
+                        import base64
+                        extra = {"grain_state": base64.b64encode(
+                            grain_iter.get_state()).decode("ascii")}
+                    ckpt.save(step, model, optimizer, extra=extra)
                 if args.fake_failure_at_step is not None \
                         and step == args.fake_failure_at_step:
                     # failure-injection drill (SURVEY §5 failure-detection
@@ -420,7 +460,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "image+tokens (clip/siglip) examples; default: "
                          "procedural synthetic data")
     sp.add_argument("--shuffle-buffer", type=int, default=256,
-                    help="example shuffle-buffer size for --data")
+                    help="example shuffle-buffer size for --data "
+                         "(records loader)")
+    sp.add_argument("--loader", default="records",
+                    choices=["records", "grain"],
+                    help="--data pipeline: 'records' (generator, buffer "
+                         "shuffle) or 'grain' (parallel workers, global "
+                         "shuffle, checkpointable iteration)")
+    sp.add_argument("--data-workers", type=int, default=0,
+                    help="grain loader subprocess count (0 = in-process)")
     sp.add_argument("--num-classes", type=int, default=None,
                     help="override classifier width (vit + --data)")
     sp.add_argument("--lr", type=float, default=1e-3)

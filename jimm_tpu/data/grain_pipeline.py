@@ -1,0 +1,183 @@
+"""grain-backed input pipeline over the zero-dependency TFRecord codec.
+
+`jimm_tpu.data.records` is a plain-python generator pipeline (decode ->
+native preprocess -> batch). This module offers the same batches through
+`grain` (installed in the target environment; SURVEY App B): a random-access
+record source + `grain.python.DataLoader`, which adds what a generator
+cannot —
+
+- **parallel workers** (``worker_count``): decode/resize in subprocesses,
+  overlapping host preprocessing with device steps,
+- **global shuffle** (index-level, not a buffer) with per-epoch reshuffling,
+- **deterministic, checkpointable iteration**: the iterator's
+  ``get_state()/set_state()`` captures the exact position (grain's
+  ``PyGrainCheckpointHandler`` plugs into orbax for the same thing), a
+  stronger resume story than the records-path ``skip_examples``
+  fast-forward,
+- **multi-host sharding** via ``ShardOptions`` (equivalent to the records
+  path's ``shard_index/shard_count``).
+
+The on-disk format and the decoded batches are identical to
+`jimm_tpu.data.records` (reference anchor for the data story: the
+reference's only input path is a network tfds call,
+ref `examples/vit_training.py:205-212`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from jimm_tpu.data.preprocess import (SIGLIP_MEAN, SIGLIP_STD,
+                                      to_float_normalized)
+from jimm_tpu.data.records import pad_tokens, prep_image, resolve_paths
+from jimm_tpu.data.tfrecord import decode_example
+
+_LEN_BYTES = 8
+_CRC_BYTES = 4
+
+
+def _scan_offsets(path: str) -> list[tuple[int, int]]:
+    """(payload_offset, payload_length) of every record in one shard —
+    header-only scan (seeks past payloads), so indexing is IO-light.
+    Truncated shards (interrupted copy/write) fail HERE with a clear error,
+    like `read_tfrecord` — not later with a confusing worker decode error."""
+    out = []
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(_LEN_BYTES)
+            if not head:
+                break
+            if len(head) != _LEN_BYTES:
+                raise ValueError(f"truncated tfrecord length in {path}")
+            n = int.from_bytes(head, "little")
+            f.seek(_CRC_BYTES, 1)  # length crc
+            off = f.tell()
+            end = off + n + _CRC_BYTES
+            if end > size:
+                raise ValueError(
+                    f"truncated tfrecord payload in {path}: record at "
+                    f"offset {off} claims {n} bytes but the file ends at "
+                    f"{size}")
+            out.append((off, n))
+            f.seek(end)
+    return out
+
+
+class TFRecordDataSource:
+    """Random-access view over tfrecord shards (grain's
+    ``RandomAccessDataSource`` protocol: ``len`` + ``getitem`` -> payload
+    bytes). Builds a per-record offset index at construction. Reads use
+    ``os.pread`` on a per-path fd: positionless, so grain's multithreaded
+    readers (``ReadOptions.num_threads`` is 16 by default) can hit one
+    source concurrently without interleaving seeks. The source pickles to
+    worker processes; fds reopen lazily there."""
+
+    def __init__(self, data: str | Sequence[str]):
+        self._paths = resolve_paths(data)
+        self._index: list[tuple[int, int, int]] = []  # (path_i, off, len)
+        for pi, path in enumerate(self._paths):
+            self._index.extend((pi, off, n)
+                               for off, n in _scan_offsets(path))
+        self._fds: dict[int, int] = {}
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_fds"] = {}  # fds don't pickle; workers reopen
+        return state
+
+    def __repr__(self) -> str:
+        # stable across processes: grain embeds repr(data_source) in the
+        # iterator state and refuses to restore when it differs (the default
+        # object repr contains the memory address, which never matches)
+        return (f"TFRecordDataSource(paths={self._paths!r}, "
+                f"records={len(self._index)})")
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def __getitem__(self, i: int) -> bytes:
+        pi, off, n = self._index[int(i)]
+        fd = self._fds.get(pi)
+        if fd is None:
+            new = os.open(self._paths[pi], os.O_RDONLY)
+            fd = self._fds.setdefault(pi, new)  # GIL-atomic; lose the race
+            if fd is not new:                   # -> close the extra fd
+                os.close(new)
+        data = os.pread(fd, n, off)
+        if len(data) != n:
+            raise ValueError(f"short read at offset {off} of "
+                             f"{self._paths[pi]} (file changed underfoot?)")
+        return data
+
+    def close(self) -> None:
+        while self._fds:
+            os.close(self._fds.popitem()[1])
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _prep_image(ex: dict, image_size: int, mean, std) -> np.ndarray:
+    return to_float_normalized(prep_image(ex, image_size)[None], mean, std)[0]
+
+
+def make_grain_loader(data: str | Sequence[str], batch_size: int, *,
+                      task: str = "contrastive", image_size: int,
+                      seq_len: int | None = None, pad_id: int = 0,
+                      mean=SIGLIP_MEAN, std=SIGLIP_STD, seed: int = 0,
+                      num_epochs: int | None = None, shuffle: bool = True,
+                      worker_count: int = 0, shard_index: int = 0,
+                      shard_count: int = 1):
+    """Build a ``grain.python.DataLoader`` yielding the same batch tuples as
+    `jimm_tpu.data.records`:
+
+    - ``task="contrastive"``: ``(images f32 [B,S,S,3], tokens i32 [B,L])``
+      (requires ``seq_len``)
+    - ``task="classification"``: ``(images f32 [B,S,S,3], labels i32 [B])``
+
+    Iterate it directly, or grab ``iter(loader)`` and use
+    ``get_state()/set_state()`` for exact checkpointable resume.
+    """
+    import grain.python as pg
+
+    if task == "contrastive" and seq_len is None:
+        raise ValueError("contrastive task needs seq_len")
+    if task not in ("contrastive", "classification"):
+        raise ValueError(f"unknown task {task!r}")
+
+    class _Parse(pg.MapTransform):
+        def map(self, payload: bytes):
+            ex = decode_example(payload)
+            image = _prep_image(ex, image_size, mean, std)
+            if task == "classification":
+                return image, np.int32(ex["label"][0])
+            return image, pad_tokens(ex["tokens"], seq_len, pad_id)
+
+    source = TFRecordDataSource(data)
+    sampler = pg.IndexSampler(
+        num_records=len(source),
+        shuffle=shuffle,
+        seed=seed,
+        num_epochs=num_epochs,
+        shard_options=pg.ShardOptions(shard_index=shard_index,
+                                      shard_count=shard_count,
+                                      drop_remainder=True))
+    return pg.DataLoader(
+        data_source=source,
+        sampler=sampler,
+        operations=[_Parse(), pg.Batch(batch_size, drop_remainder=True)],
+        worker_count=worker_count)
+
+
+def grain_batches(loader) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Adapter: a grain DataLoader -> the plain ``(images, aux)`` tuple
+    stream the trainer consumes (`jimm_tpu.cli.cmd_train`)."""
+    for batch in loader:
+        yield tuple(np.asarray(b) for b in batch)

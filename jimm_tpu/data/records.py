@@ -102,19 +102,30 @@ def iter_examples(paths: Sequence[str], *, repeat: bool = True,
         yield buf.pop(rng.randrange(len(buf)))
 
 
+def prep_image(ex: dict[str, list], image_size: int) -> np.ndarray:
+    """One decoded example -> float32 [S, S, 3] in [0, 1] (resized if
+    needed, NOT yet mean/std-normalized). Single source of the decode+resize
+    semantics shared by this pipeline and `jimm_tpu.data.grain_pipeline`."""
+    img = decode_image(ex["image"][0], ex.get("shape"))
+    if img.shape[:2] != (image_size, image_size):
+        return resize_bilinear(img[None].astype(np.float32) / 255.0,
+                               (image_size, image_size))[0]
+    return img.astype(np.float32) / 255.0
+
+
+def pad_tokens(tokens: Sequence[int], seq_len: int, pad_id: int = 0
+               ) -> np.ndarray:
+    """Token ids -> int32 [seq_len], truncated/right-padded with ``pad_id``
+    (shared with the grain pipeline)."""
+    out = np.full((seq_len,), pad_id, np.int32)
+    t = tokens[:seq_len]
+    out[:len(t)] = t
+    return out
+
+
 def _image_batch(examples: list[dict[str, list]], image_size: int,
                  mean, std) -> np.ndarray:
-    images = []
-    for ex in examples:
-        img = decode_image(ex["image"][0], ex.get("shape"))
-        if img.shape[:2] != (image_size, image_size):
-            img = resize_bilinear(
-                img[None].astype(np.float32) / 255.0,
-                (image_size, image_size))[0]
-            images.append(img)
-        else:
-            images.append(img.astype(np.float32) / 255.0)
-    batch = np.stack(images)
+    batch = np.stack([prep_image(ex, image_size) for ex in examples])
     return to_float_normalized(batch, mean, std)
 
 
@@ -147,10 +158,8 @@ def image_text_batches(data: str | Sequence[str], batch_size: int, *,
         if len(chunk) < batch_size:
             return  # non-repeating stream exhausted
         images = _image_batch(chunk, image_size, mean, std)
-        tokens = np.full((batch_size, seq_len), pad_id, np.int32)
-        for i, ex in enumerate(chunk):
-            t = ex["tokens"][:seq_len]
-            tokens[i, :len(t)] = t
+        tokens = np.stack([pad_tokens(ex["tokens"], seq_len, pad_id)
+                           for ex in chunk])
         yield images, tokens
 
 

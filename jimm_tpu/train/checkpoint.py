@@ -50,6 +50,9 @@ class CheckpointManager:
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
                 create=True))
+        #: user-supplied ``extra`` metadata of the last restored step
+        #: (e.g. the grain data-iterator state) — populated by `restore`
+        self.last_restored_extra: dict[str, Any] = {}
 
     def save(self, step: int, model: nnx.Module,
              optimizer: nnx.Optimizer | None = None, *,
@@ -95,6 +98,8 @@ class CheckpointManager:
             items["extra"] = ocp.args.JsonRestore()
         restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
         saved_meta = (restored.get("extra") or {}) if has_extra else {}
+        self.last_restored_extra = {k: v for k, v in saved_meta.items()
+                                    if k != "_storage_layout"}
         saved = saved_meta.get("_storage_layout")
         current = _storage_layout(model)
         if saved != current:
