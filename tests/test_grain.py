@@ -85,6 +85,18 @@ def test_shuffle_deterministic(shards):
     assert order(7) != order(8)
 
 
+def test_subprocess_workers_match_inprocess(shards):
+    """worker_count=1 spawns a real subprocess: exercises source pickling
+    (__getstate__ drops fds) and produces identical batches."""
+    def run(wc):
+        loader = make_grain_loader(shards, 3, task="contrastive",
+                                   image_size=8, seq_len=3, shuffle=False,
+                                   num_epochs=1, worker_count=wc)
+        return [t.tolist() for _, t in grain_batches(loader)]
+
+    assert run(1) == run(0)
+
+
 def test_cross_instance_resume(shards):
     """State saved from one loader restores into a FRESH loader (new source
     object, as after a process restart) — requires the stable __repr__
