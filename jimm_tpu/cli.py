@@ -328,6 +328,116 @@ def cmd_train(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_prepare_data(args: argparse.Namespace) -> int:
+    """Build tfrecord shards (the format `--data` consumes) from raw files.
+
+    - ``--task classification``: SRC/<class_name>/*.{jpg,jpeg,png} — labels
+      are sorted class-directory indices; writes ``classes.json`` alongside
+      the shards.
+    - ``--task contrastive``: SRC holds the images; ``--captions`` is a TSV
+      of ``relative/path<TAB>caption``. Captions that are whitespace-
+      separated integers are taken as pre-tokenized ids; otherwise
+      ``--tokenizer`` names a HuggingFace tokenizer (needs the optional
+      ``transformers`` install — tokenization is offline-optional tooling,
+      never a runtime dependency).
+    """
+    import json
+    import re
+    from pathlib import Path
+
+    from jimm_tpu.data.tfrecord import TFRecordWriter, encode_example
+
+    src, out = Path(args.src), Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    stale = sorted(out.glob("part-*.tfrecord"))
+    if stale:
+        # the readers glob the whole dir: leftover higher-numbered shards
+        # from a previous run would silently mix into the dataset
+        raise SystemExit(f"{out} already holds {len(stale)} shard(s) "
+                         f"({stale[0].name}..); remove them or use a fresh "
+                         "output directory")
+    exts = {".jpg", ".jpeg", ".png"}
+    _INT = re.compile(r"^-?\d+$")
+
+    class ShardWriter:
+        """Rotates part-NNNNN.tfrecord files every --shard-size examples."""
+
+        def __init__(self):
+            self.n_in_shard = 0
+            self.shards = 0
+            self.total = 0
+            self._w = None
+
+        def write(self, payload: bytes) -> None:
+            if self._w is None or self.n_in_shard >= args.shard_size:
+                self.close()
+                self._w = TFRecordWriter(
+                    out / f"part-{self.shards:05d}.tfrecord")
+                self.shards += 1
+                self.n_in_shard = 0
+            self._w.write(payload)
+            self.n_in_shard += 1
+            self.total += 1
+
+        def close(self) -> None:
+            if self._w is not None:
+                self._w.close()
+                self._w = None
+
+    writer = ShardWriter()
+    classes: dict[str, int] = {}
+    try:
+        if args.task == "classification":
+            names = sorted(d.name for d in src.iterdir() if d.is_dir())
+            if not names:
+                raise SystemExit(f"no class directories under {src}")
+            classes = {name: i for i, name in enumerate(names)}
+            for name, label in classes.items():
+                for img in sorted((src / name).iterdir()):
+                    if img.suffix.lower() not in exts or not img.is_file():
+                        continue
+                    writer.write(encode_example({"image": img.read_bytes(),
+                                                 "label": label}))
+        else:  # contrastive
+            if not args.captions:
+                raise SystemExit("--task contrastive needs --captions TSV")
+            tok = None
+            for ln, line in enumerate(
+                    Path(args.captions).read_text().splitlines(), 1):
+                if not line.strip():
+                    continue
+                rel, _, caption = line.partition("\t")
+                parts = caption.split()
+                if not parts:
+                    raise SystemExit(f"{args.captions}:{ln}: no caption "
+                                     f"after TAB (line {line[:60]!r})")
+                if all(_INT.match(p) for p in parts):
+                    ids = [int(p) for p in parts]  # pre-tokenized
+                else:
+                    if tok is None:
+                        if not args.tokenizer:
+                            raise SystemExit(
+                                f"{args.captions}:{ln}: text caption needs "
+                                "--tokenizer (HF name/path)")
+                        from transformers import AutoTokenizer  # opt tooling
+                        tok = AutoTokenizer.from_pretrained(args.tokenizer)
+                    ids = tok(caption)["input_ids"]
+                writer.write(encode_example(
+                    {"image": (src / rel).read_bytes(),
+                     "tokens": ids[:args.seq_len]}))
+    finally:
+        writer.close()  # flush the open shard even on a mid-run error
+    if not writer.total:
+        raise SystemExit(f"no examples found under {src}")
+    if classes:
+        # written last: a failed run must not leave a plausible-looking
+        # classes.json next to no (or partial) shards
+        (out / "classes.json").write_text(json.dumps(classes, indent=2))
+    print(f"wrote {writer.total} examples in {writer.shards} shard(s) "
+          f"to {out}")
+    return 0
+
+
 def cmd_export(args: argparse.Namespace) -> int:
     _configure_backend(args)
     import jax.numpy as jnp
@@ -524,6 +634,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="capture a jax.profiler trace of steps 2-4 here")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_train)
+
+    sp = sub.add_parser("prepare-data",
+                        help="build tfrecord shards from raw image files")
+    sp.add_argument("src", help="source directory (class dirs, or images)")
+    sp.add_argument("out", help="output directory for part-*.tfrecord")
+    sp.add_argument("--task", default="classification",
+                    choices=["classification", "contrastive"])
+    sp.add_argument("--captions", default=None,
+                    help="TSV: relative/path<TAB>caption (contrastive)")
+    sp.add_argument("--tokenizer", default=None,
+                    help="HF tokenizer for text captions (optional tooling; "
+                         "integer captions are used as pre-tokenized ids)")
+    sp.add_argument("--seq-len", type=int, default=64,
+                    help="truncate token ids to this length")
+    sp.add_argument("--shard-size", type=int, default=1000,
+                    help="examples per tfrecord shard")
+    sp.set_defaults(fn=cmd_prepare_data)
 
     sp = sub.add_parser("export",
                         help="load a checkpoint and save as HF safetensors")

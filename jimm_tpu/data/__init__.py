@@ -4,9 +4,11 @@ from jimm_tpu.data.preprocess import (CLIP_MEAN, CLIP_STD, IMAGENET_MEAN,
                                       center_crop, native_available,
                                       preprocess_batch, resize_bilinear,
                                       to_float_normalized)
+from jimm_tpu.data.grain_pipeline import (TFRecordDataSource,
+                                          grain_batches, make_grain_loader)
 from jimm_tpu.data.records import (classification_batches, decode_image,
                                    image_text_batches, iter_examples,
-                                   resolve_paths,
+                                   pad_tokens, prep_image, resolve_paths,
                                    write_classification_records,
                                    write_image_text_records)
 from jimm_tpu.data.synthetic import blob_classification, contrastive_pairs
@@ -22,6 +24,7 @@ __all__ = [
     "TFRecordWriter", "write_tfrecord", "read_tfrecord", "crc32c",
     "masked_crc32c", "encode_example", "decode_example",
     "image_text_batches", "classification_batches", "iter_examples",
-    "decode_image", "resolve_paths", "write_image_text_records",
-    "write_classification_records",
+    "decode_image", "resolve_paths", "prep_image", "pad_tokens",
+    "write_image_text_records", "write_classification_records",
+    "TFRecordDataSource", "make_grain_loader", "grain_batches",
 ]

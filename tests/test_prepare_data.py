@@ -1,0 +1,83 @@
+"""`prepare-data` CLI: raw image folders -> tfrecord shards both loaders
+consume."""
+
+import json
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from jimm_tpu.cli import main
+from jimm_tpu.data.records import classification_batches, image_text_batches
+
+
+def _write_png(path, rng):
+    img = rng.randint(0, 255, size=(8, 8, 3)).astype(np.uint8)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    Image.fromarray(img).save(path)
+
+
+def test_classification_prepare(tmp_path, rng, capsys):
+    src, out = tmp_path / "src", tmp_path / "out"
+    for cls in ("cat", "dog"):
+        for i in range(3):
+            _write_png(src / cls / f"{i}.png", rng)
+    assert main(["prepare-data", str(src), str(out), "--shard-size", "4"]) == 0
+    assert "6 examples in 2 shard(s)" in capsys.readouterr().out
+    classes = json.loads((out / "classes.json").read_text())
+    assert classes == {"cat": 0, "dog": 1}
+    images, labels = next(classification_batches(
+        str(out), 6, image_size=8, shuffle_buffer=0, repeat=False))
+    assert images.shape == (6, 8, 8, 3)
+    assert sorted(labels.tolist()) == [0, 0, 0, 1, 1, 1]
+
+
+def test_contrastive_prepare_pretokenized(tmp_path, rng):
+    src, out = tmp_path / "src", tmp_path / "out"
+    lines = []
+    for i in range(4):
+        _write_png(src / f"img{i}.png", rng)
+        lines.append(f"img{i}.png\t{i + 1} {i + 2} {i + 3}")
+    captions = tmp_path / "captions.tsv"
+    captions.write_text("\n".join(lines) + "\n")
+    assert main(["prepare-data", str(src), str(out), "--task", "contrastive",
+                 "--captions", str(captions)]) == 0
+    images, tokens = next(image_text_batches(
+        str(out), 4, image_size=8, seq_len=4, shuffle_buffer=0, repeat=False))
+    assert images.shape == (4, 8, 8, 3)
+    np.testing.assert_array_equal(tokens[0], [1, 2, 3, 0])
+
+
+def test_refuses_stale_shards(tmp_path, rng):
+    src, out = tmp_path / "src", tmp_path / "out"
+    _write_png(src / "cat" / "0.png", rng)
+    out.mkdir()
+    (out / "part-00099.tfrecord").write_bytes(b"")
+    with pytest.raises(SystemExit, match="already holds"):
+        main(["prepare-data", str(src), str(out)])
+
+
+def test_empty_caption_errors_with_line(tmp_path, rng):
+    src = tmp_path / "src"
+    _write_png(src / "a.png", rng)
+    captions = tmp_path / "c.tsv"
+    captions.write_text("a.png\t \n")
+    with pytest.raises(SystemExit, match=":1:"):
+        main(["prepare-data", str(src), str(tmp_path / "o"),
+              "--task", "contrastive", "--captions", str(captions)])
+
+
+def test_contrastive_needs_captions(tmp_path):
+    with pytest.raises(SystemExit, match="captions"):
+        main(["prepare-data", str(tmp_path), str(tmp_path / "o"),
+              "--task", "contrastive"])
+
+
+def test_text_captions_need_tokenizer(tmp_path, rng):
+    src = tmp_path / "src"
+    _write_png(src / "a.png", rng)
+    captions = tmp_path / "c.tsv"
+    captions.write_text("a.png\ta photo of a cat\n")
+    with pytest.raises(SystemExit, match="tokenizer"):
+        main(["prepare-data", str(src), str(tmp_path / "o"),
+              "--task", "contrastive", "--captions", str(captions)])
