@@ -165,7 +165,20 @@ def cmd_train(args: argparse.Namespace) -> int:
     if fam == "vit":
         if args.num_classes:
             cfg = dataclasses.replace(cfg, num_classes=args.num_classes)
-        elif not args.data:
+        elif args.data:
+            # prepare-data leaves a classes.json next to the shards; derive
+            # the shard dir through resolve_paths so every supported --data
+            # form (dir, glob, file, list) finds it
+            import json
+            from pathlib import Path
+
+            from jimm_tpu.data.records import resolve_paths
+            cj = Path(resolve_paths(args.data)[0]).parent / "classes.json"
+            if cj.is_file():
+                n = len(json.loads(cj.read_text()))
+                print(f"num_classes={n} from {cj}")
+                cfg = dataclasses.replace(cfg, num_classes=n)
+        else:
             cfg = dataclasses.replace(cfg, num_classes=4)  # synthetic classes
 
     rules = PRESET_RULES[args.rules] if args.rules else (
