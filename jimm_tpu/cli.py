@@ -5,10 +5,14 @@ ref `examples/vit_training.py:18-29`).
 Subcommands::
 
     python -m jimm_tpu presets                      # list named model presets
-    python -m jimm_tpu train --preset ... --steps N # synthetic-data training
+    python -m jimm_tpu train --preset ... --steps N # training (synthetic or --data)
+    python -m jimm_tpu classify IMG --ckpt ...      # zero-shot classification
+    python -m jimm_tpu prepare-data SRC OUT         # raw images -> tfrecord shards
     python -m jimm_tpu export SRC OUT               # HF checkpoint -> safetensors dir
     python -m jimm_tpu inspect FILE.safetensors     # tensor names/shapes/dtypes
     python -m jimm_tpu bench-forward --preset ...   # jitted forward throughput
+    python -m jimm_tpu profile-analyze DIR          # per-op trace summary
+    python -m jimm_tpu build-native                 # compile the C++ preprocessing lib
 
 `train` runs entirely offline on procedural data (`jimm_tpu.data.synthetic`)
 so it works with zero network on CPU or TPU, and exercises the real stack:
@@ -451,6 +455,75 @@ def cmd_prepare_data(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_classify(args: argparse.Namespace) -> int:
+    """Zero-shot image classification with CLIP/SigLIP (the reference's
+    `examples/clip_inference.py` flow as a command).
+
+    Label prompts come from ``--labels`` (tokenized via ``--tokenizer``, an
+    optional HF tokenizer — tooling only, never a runtime dependency) or
+    from ``--tokens-file`` (JSON ``{label: [token ids]}``, fully offline).
+    """
+    _configure_backend(args)
+    import json
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from jimm_tpu.data.preprocess import (CLIP_MEAN, CLIP_STD, SIGLIP_MEAN,
+                                          SIGLIP_STD, preprocess_batch)
+    from jimm_tpu.data.records import decode_image, pad_tokens
+    from jimm_tpu.utils import jit_forward
+
+    model_cls = _model_cls(args.model)
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    model = model_cls.from_pretrained(args.ckpt, dtype=dtype)
+    cfg = model.config
+
+    if args.tokens_file:
+        table = json.loads(open(args.tokens_file).read())
+        labels = list(table)
+        rows = [table[k] for k in labels]
+        for k, r in table.items():
+            if len(r) > cfg.text.context_length:
+                # silent truncation could drop the EOT token CLIP pools at
+                raise SystemExit(
+                    f"tokens for {k!r} are {len(r)} ids but the checkpoint's "
+                    f"context_length is {cfg.text.context_length}; "
+                    "re-tokenize to fit")
+    else:
+        if not (args.labels and args.tokenizer):
+            raise SystemExit("need --labels with --tokenizer, "
+                             "or --tokens-file")
+        labels = [s.strip() for s in args.labels.split(",") if s.strip()]
+        from transformers import AutoTokenizer  # optional tooling
+        tok = AutoTokenizer.from_pretrained(args.tokenizer)
+        prompts = [args.template.format(label) for label in labels]
+        rows = tok(prompts, padding="max_length", truncation=True,
+                   max_length=cfg.text.context_length)["input_ids"]
+    text = jnp.asarray(np.stack(
+        [pad_tokens(r, cfg.text.context_length) for r in rows]))
+
+    with open(args.image, "rb") as f:
+        img = decode_image(f.read())
+    mean, std = ((CLIP_MEAN, CLIP_STD) if args.model == "clip"
+                 else (SIGLIP_MEAN, SIGLIP_STD))
+    # CLIP checkpoints are trained with shortest-side resize + center crop;
+    # SigLIP's processor resizes straight to the square
+    batch = preprocess_batch(img[None], image_size=cfg.vision.image_size,
+                             mean=mean, std=std, crop=args.model == "clip")
+    images = jnp.asarray(batch, dtype)
+
+    logits = np.asarray(jit_forward(model)(images, text), np.float32)[0]
+    if args.model == "siglip":
+        scores = 1.0 / (1.0 + np.exp(-logits))  # per-pair sigmoid
+    else:
+        e = np.exp(logits - logits.max())
+        scores = e / e.sum()
+    for i in np.argsort(-scores):
+        print(f"{scores[i]:8.4f}  {labels[i]}")
+    return 0
+
+
 def cmd_export(args: argparse.Namespace) -> int:
     _configure_backend(args)
     import jax.numpy as jnp
@@ -647,6 +720,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="capture a jax.profiler trace of steps 2-4 here")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_train)
+
+    sp = sub.add_parser("classify",
+                        help="zero-shot image classification (CLIP/SigLIP)")
+    sp.add_argument("image", help="image file (PNG/JPEG)")
+    sp.add_argument("--ckpt", required=True,
+                    help="checkpoint: local safetensors file/dir or HF repo")
+    sp.add_argument("--model", default="clip", choices=["clip", "siglip"])
+    sp.add_argument("--labels", default=None,
+                    help='comma-separated label names, e.g. "cat,dog"')
+    sp.add_argument("--template", default="a photo of a {}",
+                    help="prompt template applied to each label")
+    sp.add_argument("--tokenizer", default=None,
+                    help="HF tokenizer for --labels (optional tooling)")
+    sp.add_argument("--tokens-file", default=None,
+                    help="JSON {label: [token ids]} — offline alternative "
+                         "to --tokenizer")
+    sp.add_argument("--bf16", action="store_true")
+    _add_backend_flags(sp)
+    sp.set_defaults(fn=cmd_classify)
 
     sp = sub.add_parser("prepare-data",
                         help="build tfrecord shards from raw image files")

@@ -1,0 +1,65 @@
+"""`classify` CLI: zero-shot classification, offline via --tokens-file."""
+
+import json
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from jimm_tpu.cli import main
+
+from hf_util import save_tiny_clip, save_tiny_siglip
+
+
+@pytest.fixture()
+def image_file(tmp_path, rng):
+    p = tmp_path / "img.png"
+    Image.fromarray(rng.randint(0, 255, size=(24, 24, 3))
+                    .astype(np.uint8)).save(p)
+    return str(p)
+
+
+def test_classify_clip(tmp_path, image_file, capsys):
+    ckpt = save_tiny_clip(tmp_path / "ckpt")
+    tokens = tmp_path / "tokens.json"
+    # EOT (max vocab id in the tiny config) present per row: CLIP pools there
+    tokens.write_text(json.dumps({"cat": [1, 5, 63], "dog": [2, 6, 63]}))
+    rc = main(["classify", image_file, "--ckpt", str(ckpt), "--model", "clip",
+               "--tokens-file", str(tokens), "--platform", "cpu"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2
+    scores = [float(line.split()[0]) for line in out]
+    assert abs(sum(scores) - 1.0) < 1e-3  # softmax over labels
+    assert {line.split()[1] for line in out} == {"cat", "dog"}
+
+
+def test_classify_siglip(tmp_path, image_file, capsys):
+    ckpt = save_tiny_siglip(tmp_path / "ckpt")
+    tokens = tmp_path / "tokens.json"
+    tokens.write_text(json.dumps({"ant": [1, 2], "bee": [3, 4],
+                                  "fly": [5, 6]}))
+    rc = main(["classify", image_file, "--ckpt", str(ckpt),
+               "--model", "siglip", "--tokens-file", str(tokens),
+               "--platform", "cpu"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 3
+    for line in out:  # sigmoid scores, each in (0, 1)
+        assert 0.0 < float(line.split()[0]) < 1.0
+
+
+def test_classify_rejects_overlong_tokens(tmp_path, image_file):
+    ckpt = save_tiny_clip(tmp_path / "ckpt")
+    tokens = tmp_path / "tokens.json"
+    tokens.write_text(json.dumps({"cat": list(range(1, 40))}))  # ctx is 8
+    with pytest.raises(SystemExit, match="context_length"):
+        main(["classify", image_file, "--ckpt", str(ckpt), "--model", "clip",
+              "--tokens-file", str(tokens), "--platform", "cpu"])
+
+
+def test_classify_needs_token_source(tmp_path, image_file):
+    ckpt = save_tiny_clip(tmp_path / "ckpt")
+    with pytest.raises(SystemExit, match="tokens-file"):
+        main(["classify", image_file, "--ckpt", str(ckpt),
+              "--platform", "cpu"])
