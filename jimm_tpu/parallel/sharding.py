@@ -167,15 +167,28 @@ def logical(init: Callable, *names: str | None) -> Callable:
 
 
 def logical_constraint(x: jax.Array, *names: str | None) -> jax.Array:
-    """Constrain an activation to the ambient rules; no-op without context
-    (and inside ``shard_map``, where axes are Manual and arrays are local)."""
+    """Constrain an activation to the ambient rules; no-op without context.
+
+    Inside ``shard_map`` the manual axes are filtered OUT of the spec (those
+    dims are already local), but constraints on any still-auto axes of a
+    partially-manual mesh (``shard_map(..., axis_names=...)`` subsets) are
+    preserved rather than dropped wholesale."""
     rules = current_rules()
     mesh = jax.sharding.get_abstract_mesh()
     if rules is None or mesh is None or mesh.empty or not mesh.shape_tuple:
         return x
-    if any(t == jax.sharding.AxisType.Manual for t in mesh.axis_types):
-        return x
     spec = rules.spec(*names)
+    manual = {n for n, t in zip(mesh.axis_names, mesh.axis_types)
+              if t == jax.sharding.AxisType.Manual}
+    if manual:
+        def keep(axis):
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            kept = tuple(a for a in axes if a is not None and a not in manual)
+            if not kept:
+                return None
+            return kept if len(kept) > 1 else kept[0]
+
+        spec = P(*(keep(a) for a in spec))
     if all(s is None for s in spec):
         return x
     return jax.lax.with_sharding_constraint(x, spec)
