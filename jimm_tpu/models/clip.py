@@ -194,7 +194,8 @@ class CLIP(nnx.Module):
                         mesh: jax.sharding.Mesh | None = None,
                         rules: ShardingRules | str = TENSOR_PARALLEL,
                         dtype=None, use_pytorch: bool = False,
-                        runtime: dict | None = None
+                        runtime: dict | None = None,
+                        image_size: int | None = None
                         ) -> "CLIP":
         weights, config = resolve_checkpoint(name_or_path,
                                              use_pytorch=use_pytorch)
@@ -203,6 +204,12 @@ class CLIP(nnx.Module):
             # execution-strategy overrides a checkpoint cannot know
             # (remat/pipeline/attn_impl/... — configs.RUNTIME_FIELDS)
             cfg = with_runtime(cfg, **runtime)
+        # higher-res fine-tune: bilinear pos-embed grid resample
+        from jimm_tpu.weights.surgery import apply_image_size
+        weights, cfg = apply_image_size(
+            weights, cfg, image_size,
+            key="vision_model.embeddings.position_embedding.weight",
+            n_prefix=1)  # class-token position first
         param_dtype = dtype if dtype is not None else jnp.float32
         model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,
                     param_dtype=param_dtype)

@@ -195,7 +195,8 @@ class SigLIP(nnx.Module):
                         mesh: jax.sharding.Mesh | None = None,
                         rules: ShardingRules | str = TENSOR_PARALLEL,
                         dtype=None, use_pytorch: bool = False,
-                        runtime: dict | None = None
+                        runtime: dict | None = None,
+                        image_size: int | None = None
                         ) -> "SigLIP":
         weights, config = resolve_checkpoint(name_or_path,
                                              use_pytorch=use_pytorch)
@@ -204,6 +205,12 @@ class SigLIP(nnx.Module):
             # execution-strategy overrides a checkpoint cannot know
             # (remat/pipeline/attn_impl/... — configs.RUNTIME_FIELDS)
             cfg = with_runtime(cfg, **runtime)
+        # higher-res fine-tune: bilinear pos-embed grid resample
+        from jimm_tpu.weights.surgery import apply_image_size
+        weights, cfg = apply_image_size(
+            weights, cfg, image_size,
+            key="vision_model.embeddings.position_embedding.weight",
+            n_prefix=0)  # MAP pooling: pure grid, no class token
         param_dtype = dtype if dtype is not None else jnp.float32
         model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,
                     param_dtype=param_dtype)

@@ -151,19 +151,27 @@ class VisionTransformer(nnx.Module):
                         mesh: jax.sharding.Mesh | None = None,
                         rules: ShardingRules | str = TENSOR_PARALLEL,
                         dtype=None, use_pytorch: bool = False,
-                        runtime: dict | None = None
+                        runtime: dict | None = None,
+                        image_size: int | None = None
                         ) -> "VisionTransformer":
         """Load any HF ViT checkpoint (safetensors). ``dtype`` sets both
         compute and param dtype (ref `models/vit.py:181-182`). ``runtime``
         overrides execution-strategy tower fields (remat/attn_impl/
         pipeline/... — `configs.RUNTIME_FIELDS`) that a checkpoint cannot
         know, e.g. ``runtime=dict(remat=True, pipeline=True, pp_stages=4)``
-        for pipelined fine-tuning."""
+        for pipelined fine-tuning. ``image_size`` loads at a DIFFERENT
+        resolution than the checkpoint's by bilinearly resampling the
+        position-embedding grid (standard higher-res fine-tune recipe;
+        impossible in the reference)."""
         weights, config = resolve_checkpoint(name_or_path,
                                              use_pytorch=use_pytorch)
         cfg = cls.config_from_hf(config, weights)
         if runtime:
             cfg = with_runtime(cfg, **runtime)
+        from jimm_tpu.weights.surgery import apply_image_size
+        weights, cfg = apply_image_size(
+            weights, cfg, image_size,
+            key="vit.embeddings.position_embeddings", n_prefix=1)
         param_dtype = dtype if dtype is not None else jnp.float32
         model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,
                     param_dtype=param_dtype)

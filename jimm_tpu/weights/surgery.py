@@ -1,0 +1,83 @@
+"""Checkpoint surgery: adapt pretrained weights to a different architecture
+shape at load time.
+
+The reference can only instantiate a checkpoint at its native resolution
+(image size is read from, or inferred from, the position-embedding table —
+ref `models/vit.py:144-164`). Standard ViT practice is to fine-tune at a
+higher resolution by interpolating the 2-D grid of position embeddings;
+`from_pretrained(..., image_size=...)` does that here.
+
+Interpolation is bilinear via the framework's own host-side resizer
+(`jimm_tpu.data.preprocess.resize_bilinear` — native C++ when built, numpy
+otherwise): pure host work, no device/backend touch during weight loading.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from jimm_tpu.data.preprocess import resize_bilinear
+
+
+def interpolate_pos_embed(pos: np.ndarray, new_grid: int, *,
+                          n_prefix: int = 0) -> np.ndarray:
+    """Resample a ViT position-embedding table to a new square grid.
+
+    - ``pos``: ``(P, H)`` or ``(1, P, H)`` with ``P = n_prefix + g*g``
+      (``n_prefix`` class/register tokens first, then the row-major grid).
+    - ``new_grid``: target side length; output has ``n_prefix + new_grid^2``
+      positions, same rank and dtype as the input.
+    """
+    arr = np.asarray(pos)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[None]
+    if arr.ndim != 3:
+        raise ValueError(f"pos embed must be (P, H) or (1, P, H), "
+                         f"got {arr.shape}")
+    n_grid = arr.shape[1] - n_prefix
+    old_grid = int(round(n_grid ** 0.5))
+    if old_grid * old_grid != n_grid:
+        raise ValueError(f"{n_grid} grid positions is not a square grid")
+    prefix = arr[:, :n_prefix]
+    if old_grid == new_grid:
+        out = arr
+    else:
+        grid = arr[:, n_prefix:].reshape(old_grid, old_grid, -1)
+        resized = resize_bilinear(grid[None].astype(np.float32),
+                                  (new_grid, new_grid))[0]
+        resized = resized.reshape(1, new_grid * new_grid, -1)
+        out = np.concatenate([prefix.astype(np.float32),
+                              resized], axis=1).astype(arr.dtype)
+    return out[0] if squeeze else out
+
+
+def resize_checkpoint_pos_embed(weights: dict, key: str, *, patch_size: int,
+                                image_size: int, n_prefix: int) -> dict:
+    """Copy ``weights`` with ``weights[key]`` resampled for ``image_size``.
+    Validates divisibility by ``patch_size``."""
+    if image_size % patch_size:
+        raise ValueError(f"image_size {image_size} is not a multiple of "
+                         f"patch_size {patch_size}")
+    out = dict(weights)
+    out[key] = interpolate_pos_embed(weights[key],
+                                     image_size // patch_size,
+                                     n_prefix=n_prefix)
+    return out
+
+
+def apply_image_size(weights: dict, cfg, image_size: int | None, *,
+                     key: str, n_prefix: int):
+    """``from_pretrained(..., image_size=...)`` entry point: returns
+    ``(weights, cfg)`` adapted to the requested resolution (no-op when it
+    already matches). ``key`` is the family's HF pos-embed tensor name and
+    ``n_prefix`` its class/register-token count (0 for SigLIP's MAP grid)."""
+    if not image_size or image_size == cfg.vision.image_size:
+        return weights, cfg
+    import dataclasses
+    weights = resize_checkpoint_pos_embed(
+        weights, key, patch_size=cfg.vision.patch_size,
+        image_size=image_size, n_prefix=n_prefix)
+    cfg = dataclasses.replace(cfg, vision=dataclasses.replace(
+        cfg.vision, image_size=image_size))
+    return weights, cfg

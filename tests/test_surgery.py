@@ -1,0 +1,68 @@
+"""Pos-embed interpolation: load a checkpoint at a different resolution
+(`from_pretrained(..., image_size=...)` — impossible in the reference, whose
+image size is pinned to the checkpoint's table)."""
+
+import numpy as np
+import pytest
+
+from jimm_tpu.weights.surgery import interpolate_pos_embed
+
+from hf_util import save_tiny_clip, save_tiny_siglip, save_tiny_vit
+
+
+def test_interpolate_identity():
+    pos = np.random.RandomState(0).randn(1, 1 + 4, 8).astype(np.float32)
+    out = interpolate_pos_embed(pos, 2, n_prefix=1)
+    np.testing.assert_array_equal(out, pos)  # same grid: untouched
+
+
+def test_interpolate_shapes_and_prefix():
+    rng = np.random.RandomState(0)
+    pos = rng.randn(1 + 16, 8).astype(np.float32)  # rank-2 form, 4x4 grid
+    out = interpolate_pos_embed(pos, 8, n_prefix=1)
+    assert out.shape == (1 + 64, 8)
+    np.testing.assert_array_equal(out[0], pos[0])  # CLS row passes through
+    # constant grid stays constant under bilinear resampling
+    const = np.concatenate([pos[:1], np.full((16, 8), 3.0, np.float32)])
+    up = interpolate_pos_embed(const, 8, n_prefix=1)
+    np.testing.assert_allclose(up[1:], 3.0, atol=1e-6)
+
+
+def test_interpolate_rejects_non_square():
+    with pytest.raises(ValueError, match="square"):
+        interpolate_pos_embed(np.zeros((7, 8), np.float32), 3)
+
+
+@pytest.mark.parametrize("family", ["vit", "clip", "siglip"])
+def test_from_pretrained_at_new_resolution(tmp_path, rng, family):
+    import jax.numpy as jnp
+
+    from jimm_tpu import CLIP, SigLIP, VisionTransformer
+
+    save = {"vit": save_tiny_vit, "clip": save_tiny_clip,
+            "siglip": save_tiny_siglip}[family]
+    cls = {"vit": VisionTransformer, "clip": CLIP, "siglip": SigLIP}[family]
+    ckpt = save(tmp_path / "ckpt")
+
+    base = cls.from_pretrained(str(ckpt))
+    old = base.config.vision.image_size
+    patch = base.config.vision.patch_size
+    new = old * 2
+
+    model = cls.from_pretrained(str(ckpt), image_size=new)
+    assert model.config.vision.image_size == new
+    images = jnp.asarray(rng.randn(2, new, new, 3), jnp.float32)
+    if family == "vit":
+        out = model(images)
+    else:
+        ctx = model.config.text.context_length
+        vocab = model.config.text.vocab_size
+        text = jnp.asarray(
+            rng.randint(1, vocab - 1, size=(2, ctx)), jnp.int32)
+        if family == "clip":  # EOT (max id) required per row
+            text = text.at[:, -1].set(vocab - 1)
+        out = model(images, text)
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))
+
+    with pytest.raises(ValueError, match="multiple"):
+        cls.from_pretrained(str(ckpt), image_size=old + patch // 2 + 1)
