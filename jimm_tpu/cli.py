@@ -7,6 +7,7 @@ Subcommands::
     python -m jimm_tpu presets                      # list named model presets
     python -m jimm_tpu train --preset ... --steps N # training (synthetic or --data)
     python -m jimm_tpu classify IMG --ckpt ...      # zero-shot classification
+    python -m jimm_tpu evaluate --data ...          # accuracy / retrieval metrics
     python -m jimm_tpu prepare-data SRC OUT         # raw images -> tfrecord shards
     python -m jimm_tpu export SRC OUT               # HF checkpoint -> safetensors dir
     python -m jimm_tpu inspect FILE.safetensors     # tensor names/shapes/dtypes
@@ -66,6 +67,21 @@ def _replace_towers(cfg: Any, **fields: Any) -> Any:
         cfg = dataclasses.replace(
             cfg, text=dataclasses.replace(cfg.text, **fields))
     return cfg
+
+
+def _num_classes_from_data(data: str) -> int | None:
+    """classes.json written by prepare-data, found next to the shards
+    through resolve_paths (dir/glob/file --data forms all work)."""
+    import json
+    from pathlib import Path
+
+    from jimm_tpu.data.records import resolve_paths
+    cj = Path(resolve_paths(data)[0]).parent / "classes.json"
+    if cj.is_file():
+        n = len(json.loads(cj.read_text()))
+        print(f"num_classes={n} from {cj}")
+        return n
+    return None
 
 
 def _tiny_override(cfg: Any) -> Any:
@@ -170,17 +186,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         if args.num_classes:
             cfg = dataclasses.replace(cfg, num_classes=args.num_classes)
         elif args.data:
-            # prepare-data leaves a classes.json next to the shards; derive
-            # the shard dir through resolve_paths so every supported --data
-            # form (dir, glob, file, list) finds it
-            import json
-            from pathlib import Path
-
-            from jimm_tpu.data.records import resolve_paths
-            cj = Path(resolve_paths(args.data)[0]).parent / "classes.json"
-            if cj.is_file():
-                n = len(json.loads(cj.read_text()))
-                print(f"num_classes={n} from {cj}")
+            n = _num_classes_from_data(args.data)
+            if n:
                 cfg = dataclasses.replace(cfg, num_classes=n)
         else:
             cfg = dataclasses.replace(cfg, num_classes=4)  # synthetic classes
@@ -342,6 +349,96 @@ def cmd_train(args: argparse.Namespace) -> int:
         ckpt.wait()
         ckpt.close()
     logger.close()
+    return 0
+
+
+def cmd_evaluate(args: argparse.Namespace) -> int:
+    """Evaluate a model over a file dataset (single non-repeating pass).
+
+    - vit: top-1 accuracy over labeled records
+    - clip/siglip: in-batch retrieval R@1, image->text and text->image
+      (diagonal is the positive pair, as in contrastive training)
+
+    Weights: ``--ckpt`` (HF checkpoint: local safetensors file/dir or hub
+    id) or ``--preset`` + ``--ckpt-dir`` (orbax training checkpoint).
+    Prints one JSON line.
+    """
+    _configure_backend(args)
+    import json
+
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import preset
+    from jimm_tpu.utils import jit_forward
+
+    if args.ckpt:
+        if not (args.model or args.preset):
+            raise SystemExit("--ckpt needs --model (or --preset to infer "
+                             "the family)")
+        fam = args.model or _family(args.preset)
+        model = _model_cls(fam).from_pretrained(
+            args.ckpt, dtype=jnp.bfloat16 if args.bf16 else None)
+        cfg = model.config
+    else:
+        if not (args.preset and args.ckpt_dir):
+            raise SystemExit("need --ckpt, or --preset with --ckpt-dir")
+        fam = _family(args.preset)
+        cfg = preset(args.preset)
+        if args.tiny:
+            cfg = _tiny_override(cfg)
+        if fam == "vit":
+            # must match the classifier head shape the training run used
+            n = args.num_classes or _num_classes_from_data(args.data)
+            if n:
+                cfg = dataclasses.replace(cfg, num_classes=n)
+        dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+        model = _model_cls(fam)(cfg, rngs=nnx.Rngs(0), dtype=dtype,
+                                param_dtype=dtype)
+        from jimm_tpu.train import CheckpointManager
+        step = CheckpointManager(args.ckpt_dir).restore(model)
+        print(f"restored step {step} from {args.ckpt_dir}")
+
+    # family-correct normalization; images are square-resized by the file
+    # pipeline (the training convention) — classify's center-crop path is
+    # for single wild images, eval keeps the train-time protocol
+    from jimm_tpu.data.preprocess import CLIP_MEAN, CLIP_STD
+    norm = ({"mean": CLIP_MEAN, "std": CLIP_STD} if fam == "clip" else {})
+
+    fwd = jit_forward(model)
+    n = 0
+    if fam == "vit":
+        from jimm_tpu.data.records import classification_batches
+        correct = 0
+        for images, labels in classification_batches(
+                args.data, args.batch_size, image_size=cfg.vision.image_size,
+                repeat=False, shuffle_buffer=0, drop_remainder=False):
+            pred = np.asarray(jnp.argmax(fwd(jnp.asarray(images)), axis=-1))
+            correct += int((pred == labels).sum())
+            n += len(labels)
+        if not n:
+            raise SystemExit(f"no examples in {args.data}")
+        metrics = {"top1_accuracy": round(correct / n, 4)}
+    else:
+        from jimm_tpu.data.records import image_text_batches
+        i2t = t2i = 0
+        for images, tokens in image_text_batches(
+                args.data, args.batch_size, image_size=cfg.vision.image_size,
+                seq_len=cfg.text.context_length, repeat=False,
+                shuffle_buffer=0, drop_remainder=False, **norm):
+            logits = np.asarray(
+                fwd(jnp.asarray(images), jnp.asarray(tokens)), np.float32)
+            diag = np.arange(len(logits))
+            i2t += int((logits.argmax(axis=1) == diag).sum())
+            t2i += int((logits.argmax(axis=0) == diag).sum())
+            n += len(logits)
+        if not n:
+            raise SystemExit(f"no examples in {args.data}")
+        metrics = {"retrieval_r1_image_to_text": round(i2t / n, 4),
+                   "retrieval_r1_text_to_image": round(t2i / n, 4)}
+    print(json.dumps({"examples": n, "batch_size": args.batch_size,
+                      **metrics}))
     return 0
 
 
@@ -720,6 +817,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="capture a jax.profiler trace of steps 2-4 here")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_train)
+
+    sp = sub.add_parser("evaluate",
+                        help="accuracy / retrieval metrics over a dataset")
+    sp.add_argument("--data", required=True,
+                    help="tfrecord file/dir/glob (single pass, no repeat)")
+    sp.add_argument("--batch-size", type=int, default=32)
+    sp.add_argument("--ckpt", default=None,
+                    help="HF checkpoint (local file/dir or hub id)")
+    sp.add_argument("--model", default=None,
+                    choices=["vit", "clip", "siglip"],
+                    help="model family for --ckpt (else from --preset name)")
+    sp.add_argument("--preset", default=None)
+    sp.add_argument("--tiny", action="store_true")
+    sp.add_argument("--ckpt-dir", default=None,
+                    help="orbax training checkpoint (with --preset)")
+    sp.add_argument("--num-classes", type=int, default=None,
+                    help="classifier width of the trained head (vit + "
+                         "--ckpt-dir; default: classes.json next to --data)")
+    sp.add_argument("--bf16", action="store_true")
+    _add_backend_flags(sp)
+    sp.set_defaults(fn=cmd_evaluate)
 
     sp = sub.add_parser("classify",
                         help="zero-shot image classification (CLIP/SigLIP)")

@@ -142,9 +142,12 @@ def image_text_batches(data: str | Sequence[str], batch_size: int, *,
                        shuffle_buffer: int = 0, seed: int = 0,
                        repeat: bool = True, shard_index: int = 0,
                        shard_count: int = 1, skip_examples: int = 0,
+                       drop_remainder: bool = True,
                        ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     """(images f32 [B,S,S,3] normalized, tokens i32 [B,L]) batches for
-    CLIP/SigLIP contrastive training. Tokens pad/truncate to ``seq_len``."""
+    CLIP/SigLIP contrastive training. Tokens pad/truncate to ``seq_len``.
+    ``drop_remainder=False`` yields the short final batch of a non-repeating
+    pass (evaluation must count every example; training wants fixed shapes)."""
     examples = iter_examples(resolve_paths(data), repeat=repeat,
                              shuffle_buffer=shuffle_buffer, seed=seed,
                              shard_index=shard_index, shard_count=shard_count)
@@ -155,12 +158,14 @@ def image_text_batches(data: str | Sequence[str], batch_size: int, *,
             chunk.append(ex)
             if len(chunk) == batch_size:
                 break
-        if len(chunk) < batch_size:
+        if not chunk or (len(chunk) < batch_size and drop_remainder):
             return  # non-repeating stream exhausted
         images = _image_batch(chunk, image_size, mean, std)
         tokens = np.stack([pad_tokens(ex["tokens"], seq_len, pad_id)
                            for ex in chunk])
         yield images, tokens
+        if len(chunk) < batch_size:
+            return
 
 
 def classification_batches(data: str | Sequence[str], batch_size: int, *,
@@ -168,8 +173,10 @@ def classification_batches(data: str | Sequence[str], batch_size: int, *,
                            shuffle_buffer: int = 0, seed: int = 0,
                            repeat: bool = True, shard_index: int = 0,
                            shard_count: int = 1, skip_examples: int = 0,
+                           drop_remainder: bool = True,
                            ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-    """(images f32 [B,S,S,3] normalized, labels i32 [B]) batches."""
+    """(images f32 [B,S,S,3] normalized, labels i32 [B]) batches. See
+    `image_text_batches` for ``drop_remainder``."""
     examples = iter_examples(resolve_paths(data), repeat=repeat,
                              shuffle_buffer=shuffle_buffer, seed=seed,
                              shard_index=shard_index, shard_count=shard_count)
@@ -180,11 +187,13 @@ def classification_batches(data: str | Sequence[str], batch_size: int, *,
             chunk.append(ex)
             if len(chunk) == batch_size:
                 break
-        if len(chunk) < batch_size:
+        if not chunk or (len(chunk) < batch_size and drop_remainder):
             return
         images = _image_batch(chunk, image_size, mean, std)
         labels = np.asarray([int(ex["label"][0]) for ex in chunk], np.int32)
         yield images, labels
+        if len(chunk) < batch_size:
+            return
 
 
 # ---------------------------------------------------------------------------

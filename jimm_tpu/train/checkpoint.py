@@ -39,6 +39,53 @@ def _storage_layout(model: nnx.Module) -> dict[str, Any] | None:
     return layout or None
 
 
+def _relayout(state, saved: dict | None, current: dict | None):
+    """Re-permute stacked layer rows from a checkpoint's baked pipeline
+    placement to the target model's (either may be canonical=None). Applies
+    to every leaf under a tower's ``blocks`` whose leading dim is the layer
+    count — model params and mirrored optimizer moments alike."""
+    from jimm_tpu.parallel.pipeline import circular_layer_order
+
+    perms: dict[str, np.ndarray] = {}
+    for tower in ("vision", "text"):
+        s = (saved or {}).get(tower)
+        c = (current or {}).get(tower)
+        if s == c:
+            continue
+        if s and c and s["depth"] != c["depth"]:
+            raise ValueError(f"{tower} depth changed between checkpoint "
+                             f"({s['depth']}) and model ({c['depth']})")
+        depth = (s or c)["depth"]
+
+        def order(layout):
+            if not layout:
+                return np.arange(depth)
+            return circular_layer_order(depth, layout["pp_stages"],
+                                        layout["pp_virtual"])
+
+        o_saved, o_cur = order(s), order(c)
+        inv_saved = np.empty(depth, np.int64)
+        inv_saved[o_saved] = np.arange(depth)
+        perm = inv_saved[o_cur]  # saved-storage -> canonical -> cur-storage
+        if not np.array_equal(perm, np.arange(depth)):
+            perms[tower] = perm
+    if not perms:
+        return state
+
+    out = []
+    for path, leaf in nnx.to_flat_state(state):
+        keys = tuple(str(k) for k in path)
+        tower = next((t for t in perms if t in keys), None)
+        if tower is not None and "blocks" in keys:
+            perm = perms[tower]
+            val = leaf.value if hasattr(leaf, "value") else leaf
+            if getattr(val, "ndim", 0) >= 1 and val.shape[0] == len(perm):
+                new = val[perm]
+                leaf = leaf.replace(new) if hasattr(leaf, "replace") else new
+        out.append((path, leaf))
+    return nnx.from_flat_state(out)
+
+
 class CheckpointManager:
     """Thin nnx-aware wrapper over ``orbax.checkpoint.CheckpointManager``."""
 
@@ -76,9 +123,14 @@ class CheckpointManager:
                 optimizer: nnx.Optimizer | None = None,
                 *, step: int | None = None) -> int:
         """Restore in place (onto each param's current sharding); returns the
-        restored step. Raises if the checkpoint was saved with a different
-        baked pipeline placement than ``model`` uses — every shape would
-        match but layer rows would be permuted."""
+        restored step.
+
+        Baked pipeline placement (`nn/transformer.py` pp_stages) stores
+        layer rows in circular schedule order. When the checkpoint's layout
+        differs from the model's, the stacked layer arrays are re-permuted
+        through canonical order (saved-storage -> canonical -> current-
+        storage), so a pipelined run can be evaluated or fine-tuned with any
+        other placement — including none."""
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError("no checkpoint found")
@@ -102,17 +154,17 @@ class CheckpointManager:
                                     if k != "_storage_layout"}
         saved = saved_meta.get("_storage_layout")
         current = _storage_layout(model)
+        model_state = restored["model"]
+        opt_state = restored.get("opt")
         if saved != current:
-            raise ValueError(
-                f"checkpoint step {step} was saved with baked pipeline "
-                f"placement {saved} but the model uses {current}; restoring "
-                "would silently permute layer rows. Rebuild the model with "
-                "the saved pp_stages/pp_virtual (see configs.with_runtime) "
-                "or export/import through save_pretrained, which is always "
-                "canonical.")
-        nnx.update(model, restored["model"])
+            model_state = _relayout(model_state, saved, current)
+            if opt_state is not None:
+                # optimizer moments live under opt.model mirroring the
+                # param tree; same stacked rows, same re-permutation
+                opt_state = _relayout(opt_state, saved, current)
+        nnx.update(model, model_state)
         if optimizer is not None:
-            nnx.update(optimizer, restored["opt"])
+            nnx.update(optimizer, opt_state)
         return step
 
     def latest_step(self) -> int | None:

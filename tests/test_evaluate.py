@@ -1,0 +1,82 @@
+"""`evaluate` CLI: single-pass metrics over tfrecord datasets."""
+
+import json
+
+import numpy as np
+import pytest
+
+from jimm_tpu.cli import main
+from jimm_tpu.data.records import (write_classification_records,
+                                   write_image_text_records)
+
+from hf_util import save_tiny_siglip, save_tiny_vit
+
+
+def test_evaluate_vit_hf_ckpt(tmp_path, rng, capsys):
+    ckpt = save_tiny_vit(tmp_path / "ckpt")  # 7 classes, 48px
+    pairs = [(rng.randint(0, 255, size=(16, 16, 3)).astype(np.uint8), i % 7)
+             for i in range(8)]
+    write_classification_records(tmp_path / "d.tfrecord", pairs,
+                                 encoding="raw")
+    rc = main(["evaluate", "--data", str(tmp_path / "d.tfrecord"),
+               "--batch-size", "4", "--ckpt", str(ckpt), "--model", "vit",
+               "--platform", "cpu"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["examples"] == 8
+    assert 0.0 <= out["top1_accuracy"] <= 1.0
+
+
+def test_evaluate_siglip_retrieval(tmp_path, rng, capsys):
+    ckpt = save_tiny_siglip(tmp_path / "ckpt")
+    pairs = [(rng.randint(0, 255, size=(16, 16, 3)).astype(np.uint8),
+              [i + 1, i + 2]) for i in range(6)]
+    write_image_text_records(tmp_path / "d.tfrecord", pairs, encoding="raw")
+    rc = main(["evaluate", "--data", str(tmp_path / "d.tfrecord"),
+               "--batch-size", "3", "--ckpt", str(ckpt),
+               "--model", "siglip", "--platform", "cpu"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["examples"] == 6
+    for k in ("retrieval_r1_image_to_text", "retrieval_r1_text_to_image"):
+        assert 0.0 <= out[k] <= 1.0
+
+
+def test_evaluate_trained_orbax_ckpt(tmp_path, rng, capsys):
+    pairs = [(rng.randint(0, 255, size=(16, 16, 3)).astype(np.uint8), i % 4)
+             for i in range(8)]
+    write_classification_records(tmp_path / "d.tfrecord", pairs,
+                                 encoding="raw")
+    ck = tmp_path / "run"
+    assert main(["train", "--preset", "vit-base-patch16-224", "--tiny",
+                 "--steps", "2", "--batch-size", "4", "--platform", "cpu",
+                 "--data", str(tmp_path / "d.tfrecord"), "--num-classes", "4",
+                 "--ckpt-dir", str(ck), "--save-every", "1"]) == 0
+    rc = main(["evaluate", "--data", str(tmp_path / "d.tfrecord"),
+               "--batch-size", "4", "--preset", "vit-base-patch16-224",
+               "--tiny", "--ckpt-dir", str(ck), "--num-classes", "4",
+               "--platform", "cpu"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["examples"] == 8
+
+
+def test_evaluate_counts_trailing_remainder(tmp_path, rng, capsys):
+    """10 examples at batch 4: the short final batch of 2 must be counted,
+    not silently dropped (training pipelines drop it; eval must not)."""
+    ckpt = save_tiny_vit(tmp_path / "ckpt")
+    pairs = [(rng.randint(0, 255, size=(16, 16, 3)).astype(np.uint8), i % 7)
+             for i in range(10)]
+    write_classification_records(tmp_path / "d.tfrecord", pairs,
+                                 encoding="raw")
+    rc = main(["evaluate", "--data", str(tmp_path / "d.tfrecord"),
+               "--batch-size", "4", "--ckpt", str(ckpt), "--model", "vit",
+               "--platform", "cpu"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["examples"] == 10
+
+
+def test_evaluate_requires_weights_source(tmp_path):
+    with pytest.raises(SystemExit, match="ckpt"):
+        main(["evaluate", "--data", str(tmp_path), "--platform", "cpu"])

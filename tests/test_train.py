@@ -228,22 +228,22 @@ def test_checkpoint_restores_across_topologies(tmp_path, rng, eight_devices):
     assert kernel.get_value().sharding.mesh.shape == dict(tp_mesh.shape)
 
 
-def test_checkpoint_rejects_mismatched_baked_placement(tmp_path, rng,
-                                                       eight_devices):
+def test_checkpoint_relayouts_baked_placement(tmp_path, rng, eight_devices):
     """A checkpoint saved with pp_stages-baked (schedule-ordered) storage
-    must not restore into a differently-placed model: every shape matches,
-    but layer rows would be silently permuted."""
-    import dataclasses
-
-    import pytest
+    restores into ANY other placement — different stage count, or canonical
+    (no pipeline) — by re-permuting layer rows through canonical order.
+    Every shape matches, so without the relayout rows would silently land
+    permuted."""
+    import numpy as _np
 
     from jimm_tpu import SigLIP
     from jimm_tpu.configs import SigLIPConfig, TextConfig, VisionConfig
-    from jimm_tpu.parallel import PIPELINE, use_sharding
+    from jimm_tpu.parallel import PIPELINE
+    from jimm_tpu.parallel.pipeline import circular_layer_order
 
     def build(pp_stages):
-        pp = dict(pipeline=True, pp_microbatches=4, pp_virtual=2,
-                  pp_stages=pp_stages)
+        pp = (dict(pipeline=True, pp_microbatches=4, pp_virtual=2,
+                   pp_stages=pp_stages) if pp_stages else {})
         cfg = SigLIPConfig(
             vision=VisionConfig(image_size=32, patch_size=16, width=32,
                                 depth=8, num_heads=2, mlp_dim=64,
@@ -253,21 +253,39 @@ def test_checkpoint_rejects_mismatched_baked_placement(tmp_path, rng,
                             causal=False, pooling="last", proj_bias=True,
                             **pp),
             projection_dim=32)
+        if not pp_stages:
+            return SigLIP(cfg, rngs=nnx.Rngs(0))
         mesh = make_mesh({"data": 8 // pp_stages, "stage": pp_stages})
         return SigLIP(cfg, rngs=nnx.Rngs(0), mesh=mesh, rules=PIPELINE)
 
+    def canonical_fc1(model, pp_stages):
+        stored = np.asarray(
+            model.vision.encoder.blocks.mlp.fc1.kernel.get_value())
+        if not pp_stages:
+            return stored
+        order = circular_layer_order(8, pp_stages, 2)
+        inv = _np.empty(8, _np.int64)
+        inv[order] = _np.arange(8)
+        return stored[inv]
+
     model = build(pp_stages=4)
+    want = canonical_fc1(model, 4)
     mgr = CheckpointManager(tmp_path / "pp")
     assert mgr.save(0, model, force=True)
     mgr.wait()
     mgr.close()
 
-    # same shapes, different schedule order -> must refuse
-    other = build(pp_stages=2)
     mgr2 = CheckpointManager(tmp_path / "pp")
-    with pytest.raises(ValueError, match="baked pipeline placement"):
-        mgr2.restore(other)
-    # identical placement restores fine
+    # different schedule order: rows re-permuted 4-stage -> 2-stage
+    other = build(pp_stages=2)
+    assert mgr2.restore(other) == 0
+    np.testing.assert_array_equal(canonical_fc1(other, 2), want)
+    # canonical (unpipelined) model: rows land in layer order
+    plain = build(pp_stages=0)
+    assert mgr2.restore(plain) == 0
+    np.testing.assert_array_equal(canonical_fc1(plain, 0), want)
+    # identical placement: untouched fast path
     same = build(pp_stages=4)
     assert mgr2.restore(same) == 0
+    np.testing.assert_array_equal(canonical_fc1(same, 4), want)
     mgr2.close()
