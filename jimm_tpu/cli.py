@@ -69,6 +69,16 @@ def _replace_towers(cfg: Any, **fields: Any) -> Any:
     return cfg
 
 
+def _norm_for(fam: str) -> dict:
+    """Family-correct file-pipeline normalization (HF processor
+    conventions): CLIP's mean/std; ViT/SigLIP use the 0.5 defaults. Shared
+    by train and evaluate so both see the same pixels."""
+    if fam == "clip":
+        from jimm_tpu.data.preprocess import CLIP_MEAN, CLIP_STD
+        return {"mean": CLIP_MEAN, "std": CLIP_STD}
+    return {}
+
+
 def _num_classes_from_data(data: str) -> int | None:
     """classes.json written by prepare-data, found next to the shards
     through resolve_paths (dir/glob/file --data forms all work)."""
@@ -221,7 +231,8 @@ def cmd_train(args: argparse.Namespace) -> int:
     data_kw = dict(shard_index=jax.process_index(),
                    shard_count=jax.process_count(),
                    shuffle_buffer=args.shuffle_buffer, seed=args.seed,
-                   skip_examples=start_step * args.batch_size)
+                   skip_examples=start_step * args.batch_size,
+                   **_norm_for(fam))
 
     grain_iter = None  # raw grain iterator, for checkpointable state
 
@@ -238,7 +249,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             image_size=cfg.vision.image_size, seed=args.seed,
             worker_count=args.data_workers,
             shard_index=jax.process_index(),
-            shard_count=jax.process_count(), **extra)
+            shard_count=jax.process_count(), **_norm_for(fam), **extra)
         grain_iter = iter(loader)
         saved = (ckpt.last_restored_extra.get("grain_state")
                  if ckpt is not None else None)
@@ -290,7 +301,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         for _ in range(start_step):
             next(data)
 
-    logger = MetricsLogger(path=args.metrics_file, print_every=args.log_every)
+    logger = MetricsLogger(path=args.metrics_file, print_every=args.log_every,
+                           tensorboard_dir=args.tensorboard_dir)
     timer = StepTimer()
     profiler_ctx = None
 
@@ -345,10 +357,12 @@ def cmd_train(args: argparse.Namespace) -> int:
             # crash mid-profile: still flush what was captured
             profiler_ctx.__exit__(None, None, None)
             print(f"profile trace written to {args.profile_dir}")
+        # a mid-run crash must not strand buffered TensorBoard events (the
+        # EventFileWriter queue flushes on close, not per event)
+        logger.close()
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()
-    logger.close()
     return 0
 
 
@@ -400,11 +414,10 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
         step = CheckpointManager(args.ckpt_dir).restore(model)
         print(f"restored step {step} from {args.ckpt_dir}")
 
-    # family-correct normalization; images are square-resized by the file
-    # pipeline (the training convention) — classify's center-crop path is
-    # for single wild images, eval keeps the train-time protocol
-    from jimm_tpu.data.preprocess import CLIP_MEAN, CLIP_STD
-    norm = ({"mean": CLIP_MEAN, "std": CLIP_STD} if fam == "clip" else {})
+    # family-correct normalization, SAME helper as cmd_train's loaders —
+    # eval must see the pixels training saw; square resize is the shared
+    # file-pipeline convention (classify's center-crop is for wild images)
+    norm = _norm_for(fam)
 
     fwd = jit_forward(model)
     n = 0
@@ -813,6 +826,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--log-every", type=int, default=10)
     sp.add_argument("--metrics-file", default=None,
                     help="JSONL metrics output path")
+    sp.add_argument("--tensorboard-dir", default=None,
+                    help="write TensorBoard scalar events here")
     sp.add_argument("--profile-dir", default=None,
                     help="capture a jax.profiler trace of steps 2-4 here")
     _add_backend_flags(sp)

@@ -136,6 +136,25 @@ def _skip(examples: Iterator, n: int) -> None:
         next(examples, None)
 
 
+def _chunks(examples: Iterator, batch_size: int, drop_remainder: bool
+            ) -> Iterator[list]:
+    """Group a (possibly finite) example stream into batch-sized lists.
+    ``drop_remainder=False`` yields the short final chunk of a non-repeating
+    pass — evaluation must count every example; training wants fixed
+    shapes."""
+    while True:
+        chunk = []
+        for ex in examples:
+            chunk.append(ex)
+            if len(chunk) == batch_size:
+                break
+        if not chunk or (len(chunk) < batch_size and drop_remainder):
+            return
+        yield chunk
+        if len(chunk) < batch_size:
+            return
+
+
 def image_text_batches(data: str | Sequence[str], batch_size: int, *,
                        image_size: int, seq_len: int, pad_id: int = 0,
                        mean=SIGLIP_MEAN, std=SIGLIP_STD,
@@ -146,26 +165,16 @@ def image_text_batches(data: str | Sequence[str], batch_size: int, *,
                        ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     """(images f32 [B,S,S,3] normalized, tokens i32 [B,L]) batches for
     CLIP/SigLIP contrastive training. Tokens pad/truncate to ``seq_len``.
-    ``drop_remainder=False`` yields the short final batch of a non-repeating
-    pass (evaluation must count every example; training wants fixed shapes)."""
+    See `_chunks` for ``drop_remainder``."""
     examples = iter_examples(resolve_paths(data), repeat=repeat,
                              shuffle_buffer=shuffle_buffer, seed=seed,
                              shard_index=shard_index, shard_count=shard_count)
     _skip(examples, skip_examples)
-    while True:
-        chunk = []
-        for ex in examples:
-            chunk.append(ex)
-            if len(chunk) == batch_size:
-                break
-        if not chunk or (len(chunk) < batch_size and drop_remainder):
-            return  # non-repeating stream exhausted
+    for chunk in _chunks(examples, batch_size, drop_remainder):
         images = _image_batch(chunk, image_size, mean, std)
         tokens = np.stack([pad_tokens(ex["tokens"], seq_len, pad_id)
                            for ex in chunk])
         yield images, tokens
-        if len(chunk) < batch_size:
-            return
 
 
 def classification_batches(data: str | Sequence[str], batch_size: int, *,
@@ -176,24 +185,15 @@ def classification_batches(data: str | Sequence[str], batch_size: int, *,
                            drop_remainder: bool = True,
                            ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     """(images f32 [B,S,S,3] normalized, labels i32 [B]) batches. See
-    `image_text_batches` for ``drop_remainder``."""
+    `_chunks` for ``drop_remainder``."""
     examples = iter_examples(resolve_paths(data), repeat=repeat,
                              shuffle_buffer=shuffle_buffer, seed=seed,
                              shard_index=shard_index, shard_count=shard_count)
     _skip(examples, skip_examples)
-    while True:
-        chunk = []
-        for ex in examples:
-            chunk.append(ex)
-            if len(chunk) == batch_size:
-                break
-        if not chunk or (len(chunk) < batch_size and drop_remainder):
-            return
+    for chunk in _chunks(examples, batch_size, drop_remainder):
         images = _image_batch(chunk, image_size, mean, std)
         labels = np.asarray([int(ex["label"][0]) for ex in chunk], np.int32)
         yield images, labels
-        if len(chunk) < batch_size:
-            return
 
 
 # ---------------------------------------------------------------------------

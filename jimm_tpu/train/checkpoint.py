@@ -81,6 +81,12 @@ def _relayout(state, saved: dict | None, current: dict | None):
             val = leaf.value if hasattr(leaf, "value") else leaf
             if getattr(val, "ndim", 0) >= 1 and val.shape[0] == len(perm):
                 new = val[perm]
+                if getattr(val, "sharding", None) is not None:
+                    # the gather's output sharding is XLA's choice; pin it
+                    # back so restore keeps its onto-current-sharding
+                    # contract (stage-sharded pipelined params especially)
+                    import jax
+                    new = jax.device_put(new, val.sharding)
                 leaf = leaf.replace(new) if hasattr(leaf, "replace") else new
         out.append((path, leaf))
     return nnx.from_flat_state(out)

@@ -78,11 +78,15 @@ class StepTimer:
 
 @dataclass
 class MetricsLogger:
-    """Structured metrics: console + JSONL file (one object per step)."""
+    """Structured metrics: console + JSONL file (one object per step) +
+    optional TensorBoard scalars (``tensorboard_dir``; writes event files
+    through the ``tensorboard`` package directly — no tensorflow)."""
 
     path: str | Path | None = None
     print_every: int = 1
+    tensorboard_dir: str | Path | None = None
     _file: IO | None = field(default=None, repr=False)
+    _tb: Any = field(default=None, repr=False)
     _step: int = 0
 
     def log(self, step: int, **metrics: Any) -> None:
@@ -93,15 +97,41 @@ class MetricsLogger:
                 self._file = open(self.path, "a")
             self._file.write(json.dumps(record, default=float) + "\n")
             self._file.flush()
+        if self.tensorboard_dir is not None:
+            self._tb_log(step, metrics)
         if self.print_every and step % self.print_every == 0:
             parts = " ".join(f"{k}={float(v):.4g}" if isinstance(v, (int, float))
                              else f"{k}={v}" for k, v in metrics.items())
             print(f"step {step}: {parts}")
 
+    def _tb_log(self, step: int, metrics: dict[str, Any]) -> None:
+        if self._tb is None:
+            try:
+                from tensorboard.summary.writer.event_file_writer import (
+                    EventFileWriter)
+            except ImportError:
+                self.tensorboard_dir = None  # optional dep absent: degrade
+                import warnings
+                warnings.warn("tensorboard not installed; scalar event "
+                              "logging disabled", stacklevel=3)
+                return
+            Path(self.tensorboard_dir).mkdir(parents=True, exist_ok=True)
+            self._tb = EventFileWriter(str(self.tensorboard_dir))
+        from tensorboard.compat.proto.event_pb2 import Event
+        from tensorboard.compat.proto.summary_pb2 import Summary
+        values = [Summary.Value(tag=k, simple_value=float(v))
+                  for k, v in metrics.items() if isinstance(v, (int, float))]
+        if values:
+            self._tb.add_event(Event(step=step, wall_time=time.time(),
+                                     summary=Summary(value=values)))
+
     def close(self) -> None:
         if self._file is not None:
             self._file.close()
             self._file = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
 
 
 # ---------------------------------------------------------------------------
