@@ -119,8 +119,14 @@ class MetricsLogger:
             self._tb = EventFileWriter(str(self.tensorboard_dir))
         from tensorboard.compat.proto.event_pb2 import Event
         from tensorboard.compat.proto.summary_pb2 import Summary
-        values = [Summary.Value(tag=k, simple_value=float(v))
-                  for k, v in metrics.items() if isinstance(v, (int, float))]
+        values = []
+        for k, v in metrics.items():
+            try:
+                # match the JSONL path's default=float coercion: np/jax
+                # scalars must land in TensorBoard too, not just floats
+                values.append(Summary.Value(tag=k, simple_value=float(v)))
+            except (TypeError, ValueError):
+                pass  # non-numeric (strings etc.) — JSONL-only
         if values:
             self._tb.add_event(Event(step=step, wall_time=time.time(),
                                      summary=Summary(value=values)))

@@ -60,7 +60,13 @@ def main():
     p.add_argument("--variant", action="append", default=None,
                    help="comma-separated k=v list; repeatable. Keys: remat, "
                         "attn, ln, fused_qkv, unroll, moment, donate")
+    p.add_argument("--tiny", action="store_true",
+                   help="smoke-test the whole grid on a tiny model (CPU "
+                        "validation of the sweep itself)")
     args = p.parse_args()
+
+    import jimm_tpu.utils.env
+    jimm_tpu.utils.env.configure_platform()  # honors JIMM_PLATFORM=cpu
 
     import jax
     jax.config.update("jax_compilation_cache_dir",
@@ -79,7 +85,21 @@ def main():
 
     variants = [parse_variant(v) for v in (args.variant or STANDARD_GRID)]
     rng = np.random.RandomState(0)
-    base = preset("siglip-base-patch16-256")
+    if args.tiny:
+        from jimm_tpu.configs import SigLIPConfig, TextConfig, VisionConfig
+        base = SigLIPConfig(
+            vision=VisionConfig(image_size=32, patch_size=16, width=64,
+                                depth=2, num_heads=2, mlp_dim=128,
+                                act="gelu_tanh", pooling="map"),
+            text=TextConfig(vocab_size=64, context_length=8, width=64,
+                            depth=2, num_heads=2, mlp_dim=128,
+                            act="gelu_tanh", causal=False, pooling="last",
+                            proj_bias=True),
+            projection_dim=64)
+        args.batch = min(args.batch, 8)
+        args.unroll = min(args.unroll, 2)
+    else:
+        base = preset("siglip-base-patch16-256")
     images_np = rng.randn(args.batch, base.vision.image_size,
                           base.vision.image_size, 3)
     text_np = rng.randint(1, base.text.vocab_size,
