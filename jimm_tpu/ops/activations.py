@@ -14,7 +14,7 @@ import warnings
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
+
 
 
 def quick_gelu(x: jax.Array) -> jax.Array:
