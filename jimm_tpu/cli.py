@@ -79,14 +79,31 @@ def _norm_for(fam: str) -> dict:
     return {}
 
 
+def _is_tar_data(data: str) -> bool:
+    """Route --data to the webdataset loader when it names tar shards
+    (compressed .tar.gz/.tar.zst included)."""
+    from pathlib import Path
+    p = Path(data)
+    if p.is_dir():
+        return (not any(p.glob("*.tfrecord*"))) and any(p.glob("*.tar*"))
+    return ".tar" in p.name
+
+
 def _num_classes_from_data(data: str) -> int | None:
-    """classes.json written by prepare-data, found next to the shards
-    through resolve_paths (dir/glob/file --data forms all work)."""
+    """classes.json written by prepare-data, found next to the shards —
+    resolved by the container's own path rules (tfrecord or tar), so every
+    --data form (dir, glob, file) works for both formats."""
     import json
     from pathlib import Path
 
-    from jimm_tpu.data.records import resolve_paths
-    cj = Path(resolve_paths(data)[0]).parent / "classes.json"
+    if _is_tar_data(data):
+        from jimm_tpu.data.webdataset import resolve_tar_paths as resolve
+    else:
+        from jimm_tpu.data.records import resolve_paths as resolve
+    try:
+        cj = Path(resolve(data)[0]).parent / "classes.json"
+    except FileNotFoundError:
+        return None  # the loader itself will raise with the right message
     if cj.is_file():
         n = len(json.loads(cj.read_text()))
         print(f"num_classes={n} from {cj}")
@@ -238,6 +255,9 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     def _grain_data(task: str):
         nonlocal grain_iter
+        if _is_tar_data(args.data):
+            raise SystemExit("--loader grain reads tfrecord shards; tar "
+                             "(webdataset) data uses --loader records")
         import base64
 
         from jimm_tpu.data.grain_pipeline import (grain_batches,
@@ -269,7 +289,11 @@ def cmd_train(args: argparse.Namespace) -> int:
         if args.data and args.loader == "grain":
             data = _grain_data("classification")
         elif args.data:
-            from jimm_tpu.data.records import classification_batches
+            if _is_tar_data(args.data):
+                from jimm_tpu.data.webdataset import (
+                    wds_classification_batches as classification_batches)
+            else:
+                from jimm_tpu.data.records import classification_batches
             data = classification_batches(
                 args.data, args.batch_size,
                 image_size=cfg.vision.image_size, **data_kw)
@@ -286,7 +310,11 @@ def cmd_train(args: argparse.Namespace) -> int:
         if args.data and args.loader == "grain":
             data = _grain_data("contrastive")
         elif args.data:
-            from jimm_tpu.data.records import image_text_batches
+            if _is_tar_data(args.data):
+                from jimm_tpu.data.webdataset import (
+                    wds_image_text_batches as image_text_batches)
+            else:
+                from jimm_tpu.data.records import image_text_batches
             data = image_text_batches(
                 args.data, args.batch_size,
                 image_size=cfg.vision.image_size,
@@ -422,7 +450,11 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
     fwd = jit_forward(model)
     n = 0
     if fam == "vit":
-        from jimm_tpu.data.records import classification_batches
+        if _is_tar_data(args.data):
+            from jimm_tpu.data.webdataset import (
+                wds_classification_batches as classification_batches)
+        else:
+            from jimm_tpu.data.records import classification_batches
         correct = 0
         for images, labels in classification_batches(
                 args.data, args.batch_size, image_size=cfg.vision.image_size,
@@ -434,7 +466,11 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
             raise SystemExit(f"no examples in {args.data}")
         metrics = {"top1_accuracy": round(correct / n, 4)}
     else:
-        from jimm_tpu.data.records import image_text_batches
+        if _is_tar_data(args.data):
+            from jimm_tpu.data.webdataset import (
+                wds_image_text_batches as image_text_batches)
+        else:
+            from jimm_tpu.data.records import image_text_batches
         i2t = t2i = 0
         for images, tokens in image_text_batches(
                 args.data, args.batch_size, image_size=cfg.vision.image_size,

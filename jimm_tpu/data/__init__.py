@@ -12,6 +12,9 @@ from jimm_tpu.data.records import (classification_batches, decode_image,
                                    write_classification_records,
                                    write_image_text_records)
 from jimm_tpu.data.synthetic import blob_classification, contrastive_pairs
+from jimm_tpu.data.webdataset import (iter_wds_examples, resolve_tar_paths,
+                                      wds_classification_batches,
+                                      wds_image_text_batches, write_wds_shard)
 from jimm_tpu.data.tfrecord import (TFRecordWriter, crc32c, decode_example,
                                     encode_example, masked_crc32c,
                                     read_tfrecord, write_tfrecord)
@@ -27,4 +30,6 @@ __all__ = [
     "decode_image", "resolve_paths", "prep_image", "pad_tokens",
     "write_image_text_records", "write_classification_records",
     "TFRecordDataSource", "make_grain_loader", "grain_batches",
+    "wds_image_text_batches", "wds_classification_batches",
+    "iter_wds_examples", "resolve_tar_paths", "write_wds_shard",
 ]

@@ -169,6 +169,21 @@ def image_text_batches(data: str | Sequence[str], batch_size: int, *,
     examples = iter_examples(resolve_paths(data), repeat=repeat,
                              shuffle_buffer=shuffle_buffer, seed=seed,
                              shard_index=shard_index, shard_count=shard_count)
+    return image_text_batches_from(
+        examples, batch_size, image_size=image_size, seq_len=seq_len,
+        pad_id=pad_id, mean=mean, std=std, skip_examples=skip_examples,
+        drop_remainder=drop_remainder)
+
+
+def image_text_batches_from(examples: Iterator[dict], batch_size: int, *,
+                            image_size: int, seq_len: int, pad_id: int = 0,
+                            mean=SIGLIP_MEAN, std=SIGLIP_STD,
+                            skip_examples: int = 0,
+                            drop_remainder: bool = True
+                            ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Batch builder over ANY decoded-example stream (records schema) —
+    shared by the tfrecord and webdataset front-ends so batch semantics
+    live in one place."""
     _skip(examples, skip_examples)
     for chunk in _chunks(examples, batch_size, drop_remainder):
         images = _image_batch(chunk, image_size, mean, std)
@@ -189,6 +204,18 @@ def classification_batches(data: str | Sequence[str], batch_size: int, *,
     examples = iter_examples(resolve_paths(data), repeat=repeat,
                              shuffle_buffer=shuffle_buffer, seed=seed,
                              shard_index=shard_index, shard_count=shard_count)
+    return classification_batches_from(
+        examples, batch_size, image_size=image_size, mean=mean, std=std,
+        skip_examples=skip_examples, drop_remainder=drop_remainder)
+
+
+def classification_batches_from(examples: Iterator[dict], batch_size: int, *,
+                                image_size: int, mean=SIGLIP_MEAN,
+                                std=SIGLIP_STD, skip_examples: int = 0,
+                                drop_remainder: bool = True
+                                ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Batch builder over any decoded-example stream — see
+    `image_text_batches_from`."""
     _skip(examples, skip_examples)
     for chunk in _chunks(examples, batch_size, drop_remainder):
         images = _image_batch(chunk, image_size, mean, std)
