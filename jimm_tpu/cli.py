@@ -637,15 +637,33 @@ def cmd_classify(args: argparse.Namespace) -> int:
                     f"context_length is {cfg.text.context_length}; "
                     "re-tokenize to fit")
     else:
-        if not (args.labels and args.tokenizer):
-            raise SystemExit("need --labels with --tokenizer, "
+        if not args.labels:
+            raise SystemExit("need --labels (with --tokenizer or a CLIP "
+                             "checkpoint dir holding vocab.json/merges.txt), "
                              "or --tokens-file")
         labels = [s.strip() for s in args.labels.split(",") if s.strip()]
-        from transformers import AutoTokenizer  # optional tooling
-        tok = AutoTokenizer.from_pretrained(args.tokenizer)
         prompts = [args.template.format(label) for label in labels]
-        rows = tok(prompts, padding="max_length", truncation=True,
-                   max_length=cfg.text.context_length)["input_ids"]
+        rows = None
+        if not args.tokenizer and args.model == "clip":
+            # zero-dependency path: every HF CLIP checkpoint ships its BPE
+            # vocab; use the built-in tokenizer when the files are local
+            from pathlib import Path
+
+            from jimm_tpu.data.clip_tokenizer import CLIPTokenizer
+            p = Path(args.ckpt)
+            d = p if p.is_dir() else p.parent
+            if (d / "vocab.json").is_file() and (d / "merges.txt").is_file():
+                rows = CLIPTokenizer.from_dir(d)(
+                    prompts, context_length=cfg.text.context_length)
+        if rows is None:
+            if not args.tokenizer:
+                raise SystemExit(
+                    "no vocab.json/merges.txt next to the checkpoint; pass "
+                    "--tokenizer (HF name/path) or --tokens-file")
+            from transformers import AutoTokenizer  # optional tooling
+            tok = AutoTokenizer.from_pretrained(args.tokenizer)
+            rows = tok(prompts, padding="max_length", truncation=True,
+                       max_length=cfg.text.context_length)["input_ids"]
     text = jnp.asarray(np.stack(
         [pad_tokens(r, cfg.text.context_length) for r in rows]))
 

@@ -4,6 +4,7 @@ from jimm_tpu.data.preprocess import (CLIP_MEAN, CLIP_STD, IMAGENET_MEAN,
                                       center_crop, native_available,
                                       preprocess_batch, resize_bilinear,
                                       to_float_normalized)
+from jimm_tpu.data.clip_tokenizer import CLIPTokenizer
 from jimm_tpu.data.grain_pipeline import (TFRecordDataSource,
                                           grain_batches, make_grain_loader)
 from jimm_tpu.data.records import (classification_batches, decode_image,
@@ -30,6 +31,7 @@ __all__ = [
     "decode_image", "resolve_paths", "prep_image", "pad_tokens",
     "write_image_text_records", "write_classification_records",
     "TFRecordDataSource", "make_grain_loader", "grain_batches",
+    "CLIPTokenizer",
     "wds_image_text_batches", "wds_classification_batches",
     "iter_wds_examples", "resolve_tar_paths", "write_wds_shard",
 ]

@@ -1,0 +1,161 @@
+"""Pure-python CLIP byte-level BPE tokenizer.
+
+The reference delegates all tokenization to `transformers` processors in its
+examples (e.g. ref `examples/clip_inference.py`), making torch-free zero-shot
+use impossible without the full HF stack. This implements CLIP's tokenizer
+(lowercase + whitespace cleanup, byte-level BPE with ``</w>`` end-of-word
+marks, ``<|startoftext|>``/``<|endoftext|>`` specials, endoftext padding)
+from the ``vocab.json`` + ``merges.txt`` files that ship inside every CLIP
+checkpoint — so ``CLIP.from_pretrained(dir)`` + `CLIPTokenizer.from_dir(dir)`
+is a complete offline zero-shot pipeline.
+
+Parity with ``transformers.CLIPTokenizer`` is pinned by
+`tests/test_clip_tokenizer.py` (same vocab/merges, identical ids).
+
+SigLIP's tokenizer is SentencePiece (a binary model format) and is NOT
+reimplemented — use `--tokenizer` (transformers) or pre-tokenized ids there.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+from pathlib import Path
+
+import numpy as np
+
+
+@functools.lru_cache()
+def bytes_to_unicode() -> dict[int, str]:
+    """GPT-2's reversible byte <-> printable-unicode table (the byte-level
+    BPE alphabet)."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+def _get_pairs(word: tuple[str, ...]) -> set[tuple[str, str]]:
+    return set(zip(word[:-1], word[1:]))
+
+
+class CLIPTokenizer:
+    """Byte-level BPE with CLIP's text cleanup and special tokens."""
+
+    SOT = "<|startoftext|>"
+    EOT = "<|endoftext|>"
+
+    def __init__(self, vocab: dict[str, int],
+                 merges: list[tuple[str, str]]):
+        self.encoder = dict(vocab)
+        self.bpe_ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.byte_encoder = bytes_to_unicode()
+        self.sot_id = self.encoder[self.SOT]
+        self.eot_id = self.encoder[self.EOT]
+        self._cache: dict[str, str] = {}
+        import regex  # unicode \p classes (a transformers dependency too)
+        self._pat = regex.compile(
+            r"""<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d"""
+            r"""|[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+""",
+            regex.IGNORECASE)
+
+    # ------------------------------------------------------------------
+    # Loading
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_dir(cls, path: str | Path) -> "CLIPTokenizer":
+        """Load ``vocab.json`` + ``merges.txt`` from a checkpoint directory
+        (the files every HF CLIP checkpoint ships)."""
+        p = Path(path)
+        vocab = json.loads((p / "vocab.json").read_text(encoding="utf-8"))
+        merges = []
+        for line in (p / "merges.txt").read_text(
+                encoding="utf-8").splitlines():
+            if line.startswith("#version") or not line.strip():
+                continue
+            a, _, b = line.partition(" ")
+            merges.append((a, b))
+        return cls(vocab, merges)
+
+    # ------------------------------------------------------------------
+    # BPE
+    # ------------------------------------------------------------------
+
+    def _bpe(self, token: str) -> str:
+        if token in self._cache:
+            return self._cache[token]
+        word = tuple(token[:-1]) + (token[-1] + "</w>",)
+        pairs = _get_pairs(word)
+        if not pairs:
+            return token + "</w>"
+        while True:
+            pair = min(pairs,
+                       key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if pair not in self.bpe_ranks:
+                break
+            a, b = pair
+            out = []
+            i = 0
+            while i < len(word):
+                try:
+                    j = word.index(a, i)
+                except ValueError:
+                    out.extend(word[i:])
+                    break
+                out.extend(word[i:j])
+                if j < len(word) - 1 and word[j + 1] == b:
+                    out.append(a + b)
+                    i = j + 2
+                else:
+                    out.append(word[j])
+                    i = j + 1
+            word = tuple(out)
+            if len(word) == 1:
+                break
+            pairs = _get_pairs(word)
+        result = " ".join(word)
+        self._cache[token] = result
+        return result
+
+    # ------------------------------------------------------------------
+    # Encoding
+    # ------------------------------------------------------------------
+
+    def encode(self, text: str) -> list[int]:
+        """Text -> token ids, WITH the sot/eot specials (HF parity)."""
+        import re
+        text = re.sub(r"\s+", " ", text.strip()).lower()
+        ids = [self.sot_id]
+        for token in self._pat.findall(text):
+            if token in (self.SOT, self.EOT):
+                # literal specials map to their single id (HF's added-token
+                # trie does the same), never through byte-level BPE
+                ids.append(self.encoder[token])
+                continue
+            mapped = "".join(self.byte_encoder[b]
+                             for b in token.encode("utf-8"))
+            ids.extend(self.encoder[t] for t in self._bpe(mapped).split(" "))
+        ids.append(self.eot_id)
+        return ids
+
+    def __call__(self, texts: str | list[str], *, context_length: int = 77
+                 ) -> np.ndarray:
+        """Batch-encode to int32 [B, context_length], truncated (keeping the
+        final EOT) and endoftext-padded like HF's ``padding="max_length"``."""
+        if isinstance(texts, str):
+            texts = [texts]
+        out = np.full((len(texts), context_length), self.eot_id, np.int32)
+        for i, t in enumerate(texts):
+            ids = self.encode(t)
+            if len(ids) > context_length:
+                ids = ids[: context_length - 1] + [self.eot_id]
+            out[i, : len(ids)] = ids
+        return out

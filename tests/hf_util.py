@@ -29,9 +29,10 @@ def save_tiny_vit(tmpdir, **overrides) -> str:
     return str(tmpdir)
 
 
-def save_tiny_clip(tmpdir, projection_dim: int = 32) -> str:
+def save_tiny_clip(tmpdir, projection_dim: int = 32, **text_overrides) -> str:
     from transformers import CLIPConfig, CLIPModel
-    cfg = CLIPConfig(text_config=dict(TINY_TEXT), vision_config=dict(TINY_VISION),
+    cfg = CLIPConfig(text_config=dict(TINY_TEXT, **text_overrides),
+                     vision_config=dict(TINY_VISION),
                      projection_dim=projection_dim)
     model = CLIPModel(cfg).eval()
     model.save_pretrained(tmpdir, safe_serialization=True)

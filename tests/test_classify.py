@@ -58,6 +58,31 @@ def test_classify_rejects_overlong_tokens(tmp_path, image_file):
               "--tokens-file", str(tokens), "--platform", "cpu"])
 
 
+def test_classify_builtin_clip_tokenizer(tmp_path, image_file, capsys):
+    """A CLIP checkpoint dir with vocab.json/merges.txt needs no
+    --tokenizer and no --tokens-file: the built-in BPE handles --labels."""
+    import json as _json
+
+    from jimm_tpu.data.clip_tokenizer import bytes_to_unicode
+
+    alphabet = list(bytes_to_unicode().values())
+    merges = [("c", "a"), ("ca", "t</w>"), ("d", "o"), ("do", "g</w>")]
+    vocab_tokens = (alphabet + [c + "</w>" for c in alphabet]
+                    + ["".join(m) for m in merges]
+                    + ["<|startoftext|>", "<|endoftext|>"])
+    # model vocab must cover the BPE table (incl. EOT as the max id)
+    ckpt = save_tiny_clip(tmp_path / "ckpt", vocab_size=len(vocab_tokens))
+    (tmp_path / "ckpt" / "vocab.json").write_text(_json.dumps(
+        {tok: i for i, tok in enumerate(vocab_tokens)}))
+    (tmp_path / "ckpt" / "merges.txt").write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges) + "\n")
+    rc = main(["classify", image_file, "--ckpt", str(ckpt), "--model", "clip",
+               "--labels", "cat,dog", "--platform", "cpu"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert {line.split()[1] for line in out} == {"cat", "dog"}
+
+
 def test_classify_needs_token_source(tmp_path, image_file):
     ckpt = save_tiny_clip(tmp_path / "ckpt")
     with pytest.raises(SystemExit, match="tokens-file"):

@@ -1,0 +1,73 @@
+"""Pure-python CLIP tokenizer vs the transformers oracle: identical ids on
+the same vocab/merges files."""
+
+import json
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+from jimm_tpu.data.clip_tokenizer import CLIPTokenizer, bytes_to_unicode
+
+
+@pytest.fixture(scope="module")
+def vocab_dir(tmp_path_factory):
+    """Synthetic vocab/merges in the real CLIP layout: byte alphabet, </w>
+    variants, merged tokens, then the specials last."""
+    d = tmp_path_factory.mktemp("clip_vocab")
+    alphabet = list(bytes_to_unicode().values())
+    merges = [("t", "h"), ("th", "e</w>"), ("c", "a"), ("ca", "t</w>"),
+              ("p", "h"), ("ph", "o"), ("o", "f</w>"), ("4", "2</w>")]
+    vocab_tokens = (alphabet + [c + "</w>" for c in alphabet]
+                    + ["".join(m) for m in merges]
+                    + ["<|startoftext|>", "<|endoftext|>"])
+    vocab = {tok: i for i, tok in enumerate(vocab_tokens)}
+    (d / "vocab.json").write_text(json.dumps(vocab), encoding="utf-8")
+    (d / "merges.txt").write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges) + "\n",
+        encoding="utf-8")
+    return d
+
+
+PROMPTS = [
+    "a photo of a cat",
+    "The THE the",
+    "hello, world!!",
+    "don't stop",
+    "42 cats",
+    "  spaced   out  ",
+    "café ph",
+    "a cat <|endoftext|> the",  # literal special maps to its single id
+]
+
+
+@pytest.mark.parametrize("text", PROMPTS)
+def test_ids_match_transformers(vocab_dir, text):
+    ours = CLIPTokenizer.from_dir(vocab_dir)
+    oracle = transformers.CLIPTokenizer(str(vocab_dir / "vocab.json"),
+                                        str(vocab_dir / "merges.txt"))
+    assert ours.encode(text) == oracle(text)["input_ids"], text
+
+
+def test_batch_padding_matches_transformers(vocab_dir):
+    ours = CLIPTokenizer.from_dir(vocab_dir)
+    oracle = transformers.CLIPTokenizer(str(vocab_dir / "vocab.json"),
+                                        str(vocab_dir / "merges.txt"))
+    got = ours(PROMPTS[:4], context_length=16)
+    want = oracle(PROMPTS[:4], padding="max_length", truncation=True,
+                  max_length=16)["input_ids"]
+    np.testing.assert_array_equal(got, np.asarray(want, np.int32))
+
+
+def test_truncation_keeps_eot(vocab_dir):
+    ours = CLIPTokenizer.from_dir(vocab_dir)
+    ids = ours("cat " * 50, context_length=8)[0]
+    assert ids.shape == (8,)
+    assert ids[0] == ours.sot_id and ids[-1] == ours.eot_id
+
+
+def test_eot_is_max_id(vocab_dir):
+    # our CLIP text pooling (argmax fallback) relies on EOT being the max id
+    ours = CLIPTokenizer.from_dir(vocab_dir)
+    assert ours.eot_id == max(ours.encoder.values())
