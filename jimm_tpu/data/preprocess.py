@@ -57,6 +57,15 @@ def _load_library() -> ctypes.CDLL | None:
             lib.jimm_center_crop_f32.argtypes = [
                 _F32P, _F32P, _I64, _I64, _I64, _I64, _I64, _I64,
                 ctypes.c_int]
+            if hasattr(lib, "jimm_image_info"):  # newer .so: image codecs
+                lib.jimm_image_info.argtypes = [
+                    ctypes.c_char_p, _I64, ctypes.POINTER(_I64),
+                    ctypes.POINTER(_I64)]
+                lib.jimm_image_info.restype = ctypes.c_int
+                lib.jimm_decode_image.argtypes = [
+                    ctypes.c_char_p, _I64, _U8P, _I64, _I64]
+                lib.jimm_decode_image.restype = ctypes.c_int
+                lib.jimm_has_image_codecs.restype = ctypes.c_int
             return lib
     return None
 
@@ -68,6 +77,31 @@ _THREADS = int(os.environ.get("JIMM_PREPROCESS_THREADS",
 
 def native_available() -> bool:
     return _LIB is not None
+
+
+def native_codecs_available() -> bool:
+    return (_LIB is not None and hasattr(_LIB, "jimm_has_image_codecs")
+            and bool(_LIB.jimm_has_image_codecs()))
+
+
+def decode_image_native(data: bytes) -> np.ndarray | None:
+    """Decode JPEG/PNG bytes to uint8 [H, W, 3] RGB via the native library
+    (libjpeg/libpng). Returns None when the native path can't take it —
+    library not built, codecs absent, or an image class the C side doesn't
+    handle (alpha/palette/16-bit PNG, CMYK JPEG, decompression-bomb sizes)
+    — so callers fall back to PIL. Corrupt image bodies raise OSError like
+    PIL's loader does, so existing skip-bad-record handlers keep working."""
+    if not native_codecs_available():
+        return None
+    h, w = _I64(0), _I64(0)
+    status = _LIB.jimm_image_info(data, len(data), ctypes.byref(h),
+                                  ctypes.byref(w))
+    if status != 0:
+        return None  # needs-PIL (1) or not an image (2: caller will raise)
+    out = np.empty((h.value, w.value, 3), np.uint8)
+    if _LIB.jimm_decode_image(data, len(data), out, h.value, w.value) != 0:
+        raise OSError("native image decode failed (corrupt data?)")
+    return out
 
 
 def _chanwise(arr: np.ndarray, c: int) -> np.ndarray:

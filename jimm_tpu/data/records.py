@@ -63,6 +63,12 @@ def decode_image(value: bytes, shape: Sequence[int] | None = None
         h, w, c = (int(s) for s in shape)
         return np.frombuffer(value, np.uint8).reshape(h, w, c)
     if value[:4] == _PNG_MAGIC or value[:2] == _JPEG_MAGIC:
+        # native libjpeg/libpng fast path (no PIL import); falls back for
+        # image classes the C side doesn't take (alpha/palette/CMYK/16-bit)
+        from jimm_tpu.data.preprocess import decode_image_native
+        native = decode_image_native(value)
+        if native is not None:
+            return native
         from PIL import Image
         return np.asarray(Image.open(io.BytesIO(value)).convert("RGB"))
     raise ValueError("image bytes are neither PNG/JPEG nor raw-with-'shape'")

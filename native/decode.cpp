@@ -1,0 +1,171 @@
+// Native JPEG/PNG decode for the input pipeline (libjpeg + libpng, both
+// ubiquitous system libraries). The pure-python path decodes through PIL —
+// a heavyweight optional dependency and the usual ingestion bottleneck; this
+// gives the data loaders a zero-python decode for the common cases (baseline
+// /progressive JPEG in grayscale/YCbCr/RGB, 8-bit gray/RGB PNG) and reports
+// "not mine" for everything else (alpha, palette, 16-bit, CMYK), which
+// falls back to PIL in `jimm_tpu/data/preprocess.py:decode_image_native`.
+//
+// Built into libjimm_preprocess.so when the codec headers exist (the
+// Makefile probes); otherwise the stubs below report unavailability and the
+// python wrapper never calls in.
+
+#include <cstdint>
+#include <cstring>
+
+#ifndef JIMM_NO_IMAGE_CODECS
+
+#include <csetjmp>
+#include <cstdio>
+
+#include <jpeglib.h>
+#include <png.h>
+
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jmp;
+};
+
+void jimm_jpeg_abort(j_common_ptr cinfo) {
+  std::longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jmp, 1);
+}
+
+bool is_jpeg(const uint8_t* d, int64_t n) {
+  return n >= 2 && d[0] == 0xFF && d[1] == 0xD8;
+}
+
+bool is_png(const uint8_t* d, int64_t n) {
+  static const uint8_t sig[8] = {0x89, 'P', 'N', 'G', '\r', '\n', 0x1a, '\n'};
+  // full signature AND the IHDR chunk tag where it must sit: dimensions are
+  // read straight from these bytes, so a garbled header must not pass
+  return n >= 26 && std::memcmp(d, sig, 8) == 0 &&
+         std::memcmp(d + 12, "IHDR", 4) == 0;
+}
+
+// Same spirit as PIL's decompression-bomb guard (MAX_IMAGE_PIXELS):
+// anything bigger goes to the python path, where PIL enforces its limit.
+constexpr int64_t kMaxPixels = 178956970;
+
+uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Probe: 0 = this library can decode it (fills h/w), 1 = recognized but
+// needs the python fallback, 2 = not a JPEG/PNG at all.
+int jimm_image_info(const uint8_t* data, int64_t n, int64_t* h, int64_t* w) {
+  if (is_jpeg(data, n)) {
+    jpeg_decompress_struct cinfo;
+    JpegErr err;
+    cinfo.err = jpeg_std_error(&err.mgr);
+    err.mgr.error_exit = jimm_jpeg_abort;
+    if (setjmp(err.jmp)) {
+      jpeg_destroy_decompress(&cinfo);
+      return 1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, data, static_cast<unsigned long>(n));
+    jpeg_read_header(&cinfo, TRUE);
+    // CMYK/YCCK can't convert to RGB in libjpeg: python fallback
+    bool ok = cinfo.jpeg_color_space == JCS_GRAYSCALE ||
+              cinfo.jpeg_color_space == JCS_YCbCr ||
+              cinfo.jpeg_color_space == JCS_RGB;
+    *h = cinfo.image_height;
+    *w = cinfo.image_width;
+    jpeg_destroy_decompress(&cinfo);
+    if (*h <= 0 || *w <= 0 || *h * *w > kMaxPixels) return 1;
+    return ok ? 0 : 1;
+  }
+  if (is_png(data, n)) {
+    // IHDR is always first: length(4) "IHDR"(4) width(4) height(4)
+    // bit_depth(1) color_type(1) at fixed offsets 8..26
+    *w = be32(data + 16);
+    *h = be32(data + 20);
+    int bit_depth = data[24];
+    int color = data[25];
+    if (*h <= 0 || *w <= 0 || *h * *w > kMaxPixels) return 1;
+    // 0 = gray, 2 = truecolor RGB; everything else (palette, alpha,
+    // 16-bit) takes the python path
+    return (bit_depth == 8 && (color == 0 || color == 2)) ? 0 : 1;
+  }
+  return 2;
+}
+
+// Decode into caller-allocated uint8 [h, w, 3] RGB. Returns 0 on success.
+int jimm_decode_image(const uint8_t* data, int64_t n, uint8_t* out,
+                      int64_t h, int64_t w) {
+  if (is_jpeg(data, n)) {
+    jpeg_decompress_struct cinfo;
+    JpegErr err;
+    cinfo.err = jpeg_std_error(&err.mgr);
+    err.mgr.error_exit = jimm_jpeg_abort;
+    if (setjmp(err.jmp)) {
+      jpeg_destroy_decompress(&cinfo);
+      return -1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, data, static_cast<unsigned long>(n));
+    jpeg_read_header(&cinfo, TRUE);
+    cinfo.out_color_space = JCS_RGB;
+    jpeg_start_decompress(&cinfo);
+    if (static_cast<int64_t>(cinfo.output_height) != h ||
+        static_cast<int64_t>(cinfo.output_width) != w ||
+        cinfo.output_components != 3) {
+      jpeg_destroy_decompress(&cinfo);
+      return -1;
+    }
+    while (cinfo.output_scanline < cinfo.output_height) {
+      JSAMPROW row = out + int64_t(cinfo.output_scanline) * w * 3;
+      jpeg_read_scanlines(&cinfo, &row, 1);
+    }
+    jpeg_finish_decompress(&cinfo);
+    // truncated bodies only WARN in libjpeg (it pads the missing data);
+    // surface them as decode failures like PIL's strict loader does
+    bool warned = cinfo.err->num_warnings > 0;
+    jpeg_destroy_decompress(&cinfo);
+    return warned ? -1 : 0;
+  }
+  if (is_png(data, n)) {
+    png_image image;
+    std::memset(&image, 0, sizeof(image));
+    image.version = PNG_IMAGE_VERSION;
+    if (!png_image_begin_read_from_memory(&image, data,
+                                          static_cast<size_t>(n)))
+      return -1;
+    image.format = PNG_FORMAT_RGB;
+    if (static_cast<int64_t>(image.height) != h ||
+        static_cast<int64_t>(image.width) != w) {
+      png_image_free(&image);
+      return -1;
+    }
+    if (!png_image_finish_read(&image, nullptr, out, 0, nullptr)) {
+      png_image_free(&image);
+      return -1;
+    }
+    return 0;
+  }
+  return -1;
+}
+
+// 1 when this build carries the codecs (python checks before trusting info)
+int jimm_has_image_codecs(void) { return 1; }
+
+}  // extern "C"
+
+#else  // JIMM_NO_IMAGE_CODECS
+
+extern "C" {
+int jimm_image_info(const uint8_t*, int64_t, int64_t*, int64_t*) { return 2; }
+int jimm_decode_image(const uint8_t*, int64_t, uint8_t*, int64_t, int64_t) {
+  return -1;
+}
+int jimm_has_image_codecs(void) { return 0; }
+}
+
+#endif  // JIMM_NO_IMAGE_CODECS

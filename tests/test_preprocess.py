@@ -1,6 +1,7 @@
 """Native C++ preprocessing vs numpy fallback: identical results, and the
 numpy path is itself validated against straightforward reference math."""
 
+import io
 import numpy as np
 import pytest
 
@@ -86,3 +87,87 @@ def test_preprocess_batch_end_to_end(rng):
     assert out.shape == (2, 32, 32, 3) and out.dtype == np.float32
     # SigLIP normalization maps [0,1] -> [-1,1]
     assert -1.001 <= out.min() and out.max() <= 1.001
+
+
+needs_codecs = pytest.mark.skipif(not pp.native_codecs_available(),
+                                  reason="native image codecs not built")
+
+
+@needs_codecs
+def test_native_png_decode_matches_pil(rng):
+    from PIL import Image
+    img = rng.randint(0, 255, size=(21, 17, 3)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    got = pp.decode_image_native(buf.getvalue())
+    np.testing.assert_array_equal(got, img)  # PNG is lossless: exact
+
+
+@needs_codecs
+def test_native_gray_png_decode(rng):
+    from PIL import Image
+    gray = rng.randint(0, 255, size=(12, 9)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(gray, mode="L").save(buf, format="PNG")
+    got = pp.decode_image_native(buf.getvalue())
+    want = np.asarray(Image.open(io.BytesIO(buf.getvalue())).convert("RGB"))
+    np.testing.assert_array_equal(got, want)
+
+
+@needs_codecs
+def test_native_jpeg_decode_close_to_pil(rng):
+    from PIL import Image
+    img = rng.randint(0, 255, size=(32, 24, 3)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=95)
+    got = pp.decode_image_native(buf.getvalue())
+    want = np.asarray(Image.open(io.BytesIO(buf.getvalue())).convert("RGB"))
+    assert got.shape == want.shape
+    # both decode through libjpeg; IDCT rounding may differ by a ULP of u8
+    assert np.max(np.abs(got.astype(int) - want.astype(int))) <= 1
+
+
+@needs_codecs
+def test_native_decode_declines_alpha_png(rng):
+    from PIL import Image
+    rgba = rng.randint(0, 255, size=(8, 8, 4)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(rgba, mode="RGBA").save(buf, format="PNG")
+    assert pp.decode_image_native(buf.getvalue()) is None  # PIL fallback
+
+
+@needs_codecs
+def test_decode_image_uses_native_and_matches(rng):
+    """records.decode_image routes through the native path and stays
+    equivalent to the PIL result."""
+    from PIL import Image
+
+    from jimm_tpu.data.records import decode_image
+    img = rng.randint(0, 255, size=(15, 11, 3)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    # prove the native path actually takes this image (a PIL fallback would
+    # make the equality below pass without covering the routing)
+    assert pp.decode_image_native(buf.getvalue()) is not None
+    np.testing.assert_array_equal(decode_image(buf.getvalue()), img)
+
+
+@needs_codecs
+def test_native_decode_rejects_garbled_png_header(rng):
+    # \x89PNG prefix but garbage IHDR: must decline (None) rather than trust
+    # unvalidated dimensions into an allocation
+    junk = b"\x89PNG" + bytes(rng.randint(0, 255, size=40).tolist())
+    assert pp.decode_image_native(junk) is None
+
+
+@needs_codecs
+def test_native_decode_corrupt_body_raises_oserror(rng):
+    from PIL import Image
+    img = rng.randint(0, 255, size=(16, 16, 3)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG")
+    data = buf.getvalue()
+    sos = data.index(b"\xff\xda")  # cut after the scan header: the header
+    data = data[: sos + 20]        # parses fine, the body is truncated
+    with pytest.raises(OSError):
+        pp.decode_image_native(data)
