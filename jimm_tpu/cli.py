@@ -111,6 +111,30 @@ def _num_classes_from_data(data: str) -> int | None:
     return None
 
 
+def _swap_classifier(model, n_target: int, *, dtype, seed: int,
+                     mesh=None, rules=None) -> None:
+    """Replace a ViT's classification head with a fresh ``n_target``-wide
+    zero-init Linear (the standard fine-tune head swap). Shared by train
+    and evaluate so both rebuild the same architecture around an orbax
+    checkpoint."""
+    import dataclasses as _dc
+
+    from flax import nnx
+
+    from jimm_tpu.parallel.sharding import logical, shard_model
+    cfg = model.config
+    model.classifier = nnx.Linear(
+        cfg.vision.width, n_target, dtype=dtype, param_dtype=dtype,
+        kernel_init=logical(nnx.initializers.zeros_init(),
+                            "embed", "classes"),
+        bias_init=logical(nnx.initializers.zeros_init(), "classes"),
+        rngs=nnx.Rngs(seed))
+    model.config = _dc.replace(cfg, num_classes=n_target,
+                               do_classification=True)
+    if mesh is not None:
+        shard_model(model, mesh, rules)
+
+
 def _tiny_override(cfg: Any) -> Any:
     """Shrink any preset to CPU-demo size, keeping its architecture class."""
     from jimm_tpu.configs import CLIPConfig, SigLIPConfig, ViTConfig
@@ -209,22 +233,62 @@ def cmd_train(args: argparse.Namespace) -> int:
         unroll = args.scan_unroll or (
             cfg.vision.depth if _jax.default_backend() == "tpu" else 1)
         cfg = _replace_towers(cfg, scan_unroll=unroll)
+    n_classes = None
     if fam == "vit":
-        if args.num_classes:
-            cfg = dataclasses.replace(cfg, num_classes=args.num_classes)
-        elif args.data:
-            n = _num_classes_from_data(args.data)
-            if n:
-                cfg = dataclasses.replace(cfg, num_classes=n)
-        else:
-            cfg = dataclasses.replace(cfg, num_classes=4)  # synthetic classes
+        n_classes = args.num_classes or (
+            _num_classes_from_data(args.data) if args.data else None)
+        if n_classes is None and not args.data:
+            n_classes = 4  # synthetic classes
+        if n_classes:
+            cfg = dataclasses.replace(cfg, num_classes=n_classes)
 
     rules = PRESET_RULES[args.rules] if args.rules else (
         PRESET_RULES["dp"] if mesh is not None else None)
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
 
-    model = _model_cls(fam)(cfg, rngs=nnx.Rngs(args.seed), mesh=mesh,
-                            rules=rules, dtype=dtype, param_dtype=dtype)
+    if args.from_pretrained:
+        # fine-tune: architecture from the checkpoint, execution strategy
+        # from the flags (the preset only names the model family here)
+        rt: dict[str, Any] = {}
+        if args.attn_impl:
+            rt["attn_impl"] = args.attn_impl
+        if args.ln_impl:
+            rt["ln_impl"] = args.ln_impl
+        if args.fused_qkv:
+            rt["fused_qkv"] = True
+        if args.remat:
+            from jimm_tpu.configs import parse_remat
+            rt.update(parse_remat(args.remat))
+        if args.pipeline_microbatches or args.rules == "pp":
+            rt["pipeline"] = True
+            rt.update(pp_extra)
+            if args.pipeline_microbatches:
+                rt["pp_microbatches"] = args.pipeline_microbatches
+        if args.scan_unroll > 1:
+            # 0 = auto resolves against the PRESET depth, which need not
+            # match the checkpoint's: only explicit unrolls pass through
+            rt["scan_unroll"] = args.scan_unroll
+        model = _model_cls(fam).from_pretrained(
+            args.from_pretrained, mesh=mesh,
+            rules=rules if rules is not None else "replicated",
+            dtype=dtype, runtime=rt or None, image_size=args.image_size)
+        cfg = model.config
+        if fam == "vit":
+            if (n_classes and (not cfg.do_classification
+                               or n_classes != cfg.num_classes)):
+                # standard fine-tune head swap: pretrained backbone,
+                # freshly-initialized classifier of the task's width
+                _swap_classifier(model, n_classes, dtype=dtype,
+                                 seed=args.seed, mesh=mesh, rules=rules)
+                cfg = model.config
+                print(f"fresh classifier head: {n_classes} classes")
+            elif not cfg.do_classification:
+                raise SystemExit(
+                    "checkpoint has no classifier head; pass --num-classes "
+                    "(or put classes.json next to --data)")
+    else:
+        model = _model_cls(fam)(cfg, rngs=nnx.Rngs(args.seed), mesh=mesh,
+                                rules=rules, dtype=dtype, param_dtype=dtype)
     optimizer = make_optimizer(model, OptimizerConfig(
         learning_rate=args.lr, weight_decay=args.weight_decay,
         warmup_steps=args.warmup_steps, total_steps=args.steps,
@@ -427,20 +491,36 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
         if not (args.preset and args.ckpt_dir):
             raise SystemExit("need --ckpt, or --preset with --ckpt-dir")
         fam = _family(args.preset)
-        cfg = preset(args.preset)
-        if args.tiny:
-            cfg = _tiny_override(cfg)
-        if fam == "vit":
-            # must match the classifier head shape the training run used
-            n = args.num_classes or _num_classes_from_data(args.data)
-            if n:
-                cfg = dataclasses.replace(cfg, num_classes=n)
         dtype = jnp.bfloat16 if args.bf16 else jnp.float32
-        model = _model_cls(fam)(cfg, rngs=nnx.Rngs(0), dtype=dtype,
-                                param_dtype=dtype)
+        n = (args.num_classes or _num_classes_from_data(args.data)
+             if fam == "vit" else None)
+        if args.from_pretrained:
+            # the training run was `train --from-pretrained X`: rebuild the
+            # same architecture (incl. head swap) before restoring over it
+            model = _model_cls(fam).from_pretrained(
+                args.from_pretrained, dtype=dtype,
+                image_size=args.image_size)
+            if fam == "vit" and n and (
+                    not model.config.do_classification
+                    or n != model.config.num_classes):
+                _swap_classifier(model, n, dtype=dtype, seed=0)
+            elif fam == "vit" and not model.config.do_classification:
+                raise SystemExit("checkpoint has no classifier head; pass "
+                                 "--num-classes (or put classes.json next "
+                                 "to --data)")
+        else:
+            cfg = preset(args.preset)
+            if args.tiny:
+                cfg = _tiny_override(cfg)
+            if n:
+                # must match the classifier head the training run used
+                cfg = dataclasses.replace(cfg, num_classes=n)
+            model = _model_cls(fam)(cfg, rngs=nnx.Rngs(0), dtype=dtype,
+                                    param_dtype=dtype)
         from jimm_tpu.train import CheckpointManager
         step = CheckpointManager(args.ckpt_dir).restore(model)
         print(f"restored step {step} from {args.ckpt_dir}")
+        cfg = model.config
 
     # family-correct normalization, SAME helper as cmd_train's loaders —
     # eval must see the pixels training saw; square resize is the shared
@@ -813,6 +893,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--preset", required=True)
     sp.add_argument("--tiny", action="store_true",
                     help="shrink the preset to CPU-demo size")
+    sp.add_argument("--from-pretrained", default=None,
+                    help="fine-tune from an HF checkpoint (local file/dir "
+                         "or hub id); --preset then only names the family")
+    sp.add_argument("--image-size", type=int, default=None,
+                    help="with --from-pretrained: load at a different "
+                         "resolution (pos-embed grid interpolation)")
     sp.add_argument("--steps", type=int, default=100)
     sp.add_argument("--batch-size", type=int, default=32)
     sp.add_argument("--data", default=None,
@@ -901,6 +987,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--tiny", action="store_true")
     sp.add_argument("--ckpt-dir", default=None,
                     help="orbax training checkpoint (with --preset)")
+    sp.add_argument("--from-pretrained", default=None,
+                    help="with --ckpt-dir: the HF checkpoint the training "
+                         "run fine-tuned from (rebuilds that architecture)")
+    sp.add_argument("--image-size", type=int, default=None,
+                    help="with --from-pretrained: the --image-size the "
+                         "training run used")
     sp.add_argument("--num-classes", type=int, default=None,
                     help="classifier width of the trained head (vit + "
                          "--ckpt-dir; default: classes.json next to --data)")
