@@ -10,6 +10,7 @@ Subcommands::
     python -m jimm_tpu evaluate --data ...          # accuracy / retrieval metrics
     python -m jimm_tpu prepare-data SRC OUT         # raw images -> tfrecord shards
     python -m jimm_tpu export SRC OUT               # HF checkpoint -> safetensors dir
+    python -m jimm_tpu export-run OUT --ckpt-dir D  # training run -> HF safetensors
     python -m jimm_tpu inspect FILE.safetensors     # tensor names/shapes/dtypes
     python -m jimm_tpu bench-forward --preset ...   # jitted forward throughput
     python -m jimm_tpu profile-analyze DIR          # per-op trace summary
@@ -135,6 +136,63 @@ def _swap_classifier(model, n_target: int, *, dtype, seed: int,
         shard_model(model, mesh, rules)
 
 
+def _fit_head(model, n: int | None, *, dtype, seed: int = 0,
+              mesh=None, rules=None) -> None:
+    """Make a loaded ViT's classifier match the task: swap in a fresh
+    ``n``-wide head when the count differs (or the checkpoint is headless),
+    error when headless with no count known. One decision shared by train,
+    evaluate, and export-run — they must rebuild identical architectures."""
+    cfg = model.config
+    if n and (not cfg.do_classification or n != cfg.num_classes):
+        _swap_classifier(model, n, dtype=dtype, seed=seed, mesh=mesh,
+                         rules=rules)
+        print(f"fresh classifier head: {n} classes")
+    elif not cfg.do_classification:
+        raise SystemExit("checkpoint has no classifier head; pass "
+                         "--num-classes (or put classes.json next to "
+                         "--data)")
+
+
+def _restore_run(args: argparse.Namespace):
+    """Rebuild the architecture a training run used (--preset [+--tiny] or
+    --from-pretrained [+--image-size], with the vit head swap) and restore
+    its orbax checkpoint over it. Shared by `evaluate` and `export-run` —
+    they must reconstruct the exact same model to load the weights."""
+    import jax.numpy as jnp
+    from flax import nnx
+
+    from jimm_tpu import preset
+
+    fam = _family(args.preset)
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    data = getattr(args, "data", None)
+    n = (args.num_classes or (_num_classes_from_data(data) if data else None)
+         if fam == "vit" else None)
+    if args.from_pretrained:
+        if args.tiny:
+            raise SystemExit("--tiny conflicts with --from-pretrained "
+                             "(the checkpoint defines the architecture)")
+        # the training run was `train --from-pretrained X`: rebuild the
+        # same architecture (incl. head swap) before restoring over it
+        model = _model_cls(fam).from_pretrained(
+            args.from_pretrained, dtype=dtype, image_size=args.image_size)
+        if fam == "vit":
+            _fit_head(model, n, dtype=dtype)
+    else:
+        cfg = preset(args.preset)
+        if args.tiny:
+            cfg = _tiny_override(cfg)
+        if n:
+            # must match the classifier head the training run used
+            cfg = dataclasses.replace(cfg, num_classes=n)
+        model = _model_cls(fam)(cfg, rngs=nnx.Rngs(0), dtype=dtype,
+                                param_dtype=dtype)
+    from jimm_tpu.train import CheckpointManager
+    step = CheckpointManager(args.ckpt_dir).restore(model)
+    print(f"restored step {step} from {args.ckpt_dir}")
+    return fam, model
+
+
 def _tiny_override(cfg: Any) -> Any:
     """Shrink any preset to CPU-demo size, keeping its architecture class."""
     from jimm_tpu.configs import CLIPConfig, SigLIPConfig, ViTConfig
@@ -193,19 +251,29 @@ def cmd_train(args: argparse.Namespace) -> int:
     fam = _family(args.preset)
     cfg = preset(args.preset)
     if args.tiny:
+        if args.from_pretrained:
+            # --tiny shrinks the PRESET; with --from-pretrained the
+            # architecture comes from the checkpoint, so the flag would be
+            # silently ignored — refuse the contradiction
+            raise SystemExit("--tiny conflicts with --from-pretrained "
+                             "(the checkpoint defines the architecture)")
         cfg = _tiny_override(cfg)
+
+    # execution-strategy overrides, built ONCE: the preset path applies
+    # them to cfg, the fine-tune path passes them to from_pretrained
+    rt: dict[str, Any] = {}
     if args.attn_impl:
-        cfg = _replace_towers(cfg, attn_impl=args.attn_impl)
+        rt["attn_impl"] = args.attn_impl
     if args.remat:
         from jimm_tpu.configs import parse_remat
         try:
-            cfg = _replace_towers(cfg, **parse_remat(args.remat))
+            rt.update(parse_remat(args.remat))
         except ValueError as e:
             raise SystemExit(f"--remat: {e}")
     if args.ln_impl:
-        cfg = _replace_towers(cfg, ln_impl=args.ln_impl)
+        rt["ln_impl"] = args.ln_impl
     if args.fused_qkv:
-        cfg = _replace_towers(cfg, fused_qkv=True)
+        rt["fused_qkv"] = True
     mesh = _parse_mesh(args.mesh)
     pp_extra = {}
     if args.pipeline_virtual > 1:
@@ -221,18 +289,23 @@ def cmd_train(args: argparse.Namespace) -> int:
         if args.rules != "pp":
             raise SystemExit("--pipeline-microbatches needs --rules pp "
                              "(layers sharded over the 'stage' mesh axis)")
-        cfg = _replace_towers(cfg, pipeline=True, **pp_extra,
-                              pp_microbatches=args.pipeline_microbatches)
+        rt.update(pipeline=True, **pp_extra,
+                  pp_microbatches=args.pipeline_microbatches)
     elif args.rules == "pp":
         # --rules pp without the flag: default to the config's microbatch
         # count rather than silently running the unpipelined scan with
         # stage-sharded params (correct but all-gathers every layer)
-        cfg = _replace_towers(cfg, pipeline=True, **pp_extra)
-    if args.scan_unroll != 1:
+        rt.update(pipeline=True, **pp_extra)
+    if args.scan_unroll > 1:
+        rt["scan_unroll"] = args.scan_unroll
+    elif args.scan_unroll == 0 and not args.from_pretrained:
+        # auto: full unroll on TPU, resolved against the preset's depth
+        # (a checkpoint's depth is unknown here — explicit unrolls only)
         import jax as _jax
-        unroll = args.scan_unroll or (
-            cfg.vision.depth if _jax.default_backend() == "tpu" else 1)
-        cfg = _replace_towers(cfg, scan_unroll=unroll)
+        if _jax.default_backend() == "tpu":
+            rt["scan_unroll"] = cfg.vision.depth
+    if rt and not args.from_pretrained:
+        cfg = _replace_towers(cfg, **rt)
     n_classes = None
     if fam == "vit":
         n_classes = args.num_classes or (
@@ -248,44 +321,15 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     if args.from_pretrained:
         # fine-tune: architecture from the checkpoint, execution strategy
-        # from the flags (the preset only names the model family here)
-        rt: dict[str, Any] = {}
-        if args.attn_impl:
-            rt["attn_impl"] = args.attn_impl
-        if args.ln_impl:
-            rt["ln_impl"] = args.ln_impl
-        if args.fused_qkv:
-            rt["fused_qkv"] = True
-        if args.remat:
-            from jimm_tpu.configs import parse_remat
-            rt.update(parse_remat(args.remat))
-        if args.pipeline_microbatches or args.rules == "pp":
-            rt["pipeline"] = True
-            rt.update(pp_extra)
-            if args.pipeline_microbatches:
-                rt["pp_microbatches"] = args.pipeline_microbatches
-        if args.scan_unroll > 1:
-            # 0 = auto resolves against the PRESET depth, which need not
-            # match the checkpoint's: only explicit unrolls pass through
-            rt["scan_unroll"] = args.scan_unroll
+        # from the SAME rt dict the preset path applies (built above)
         model = _model_cls(fam).from_pretrained(
             args.from_pretrained, mesh=mesh,
             rules=rules if rules is not None else "replicated",
             dtype=dtype, runtime=rt or None, image_size=args.image_size)
-        cfg = model.config
         if fam == "vit":
-            if (n_classes and (not cfg.do_classification
-                               or n_classes != cfg.num_classes)):
-                # standard fine-tune head swap: pretrained backbone,
-                # freshly-initialized classifier of the task's width
-                _swap_classifier(model, n_classes, dtype=dtype,
-                                 seed=args.seed, mesh=mesh, rules=rules)
-                cfg = model.config
-                print(f"fresh classifier head: {n_classes} classes")
-            elif not cfg.do_classification:
-                raise SystemExit(
-                    "checkpoint has no classifier head; pass --num-classes "
-                    "(or put classes.json next to --data)")
+            _fit_head(model, n_classes, dtype=dtype, seed=args.seed,
+                      mesh=mesh, rules=rules)
+        cfg = model.config
     else:
         model = _model_cls(fam)(cfg, rngs=nnx.Rngs(args.seed), mesh=mesh,
                                 rules=rules, dtype=dtype, param_dtype=dtype)
@@ -490,36 +534,7 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
     else:
         if not (args.preset and args.ckpt_dir):
             raise SystemExit("need --ckpt, or --preset with --ckpt-dir")
-        fam = _family(args.preset)
-        dtype = jnp.bfloat16 if args.bf16 else jnp.float32
-        n = (args.num_classes or _num_classes_from_data(args.data)
-             if fam == "vit" else None)
-        if args.from_pretrained:
-            # the training run was `train --from-pretrained X`: rebuild the
-            # same architecture (incl. head swap) before restoring over it
-            model = _model_cls(fam).from_pretrained(
-                args.from_pretrained, dtype=dtype,
-                image_size=args.image_size)
-            if fam == "vit" and n and (
-                    not model.config.do_classification
-                    or n != model.config.num_classes):
-                _swap_classifier(model, n, dtype=dtype, seed=0)
-            elif fam == "vit" and not model.config.do_classification:
-                raise SystemExit("checkpoint has no classifier head; pass "
-                                 "--num-classes (or put classes.json next "
-                                 "to --data)")
-        else:
-            cfg = preset(args.preset)
-            if args.tiny:
-                cfg = _tiny_override(cfg)
-            if n:
-                # must match the classifier head the training run used
-                cfg = dataclasses.replace(cfg, num_classes=n)
-            model = _model_cls(fam)(cfg, rngs=nnx.Rngs(0), dtype=dtype,
-                                    param_dtype=dtype)
-        from jimm_tpu.train import CheckpointManager
-        step = CheckpointManager(args.ckpt_dir).restore(model)
-        print(f"restored step {step} from {args.ckpt_dir}")
+        fam, model = _restore_run(args)
         cfg = model.config
 
     # family-correct normalization, SAME helper as cmd_train's loaders —
@@ -568,6 +583,20 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
                    "retrieval_r1_text_to_image": round(t2i / n, 4)}
     print(json.dumps({"examples": n, "batch_size": args.batch_size,
                       **metrics}))
+    return 0
+
+
+def cmd_export_run(args: argparse.Namespace) -> int:
+    """Export a TRAINING RUN (orbax checkpoint) as an HF-interoperable
+    safetensors directory — the fine-tune → share loop: the output loads in
+    `transformers` and back through `from_pretrained`. (`export` converts
+    HF checkpoints; this converts this framework's own runs.)"""
+    _configure_backend(args)
+    from jimm_tpu.weights.export import save_pretrained
+
+    _, model = _restore_run(args)
+    save_pretrained(model, args.out)
+    print(f"exported {args.ckpt_dir} -> {args.out}")
     return 0
 
 
@@ -1044,6 +1073,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--bf16", action="store_true")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_export)
+
+    sp = sub.add_parser("export-run",
+                        help="export a training run (orbax) as HF safetensors")
+    sp.add_argument("out", help="output directory")
+    sp.add_argument("--ckpt-dir", required=True,
+                    help="orbax checkpoint directory of the run")
+    sp.add_argument("--preset", required=True,
+                    help="preset the run trained (or its family, with "
+                         "--from-pretrained)")
+    sp.add_argument("--tiny", action="store_true")
+    sp.add_argument("--from-pretrained", default=None,
+                    help="HF checkpoint the run fine-tuned from")
+    sp.add_argument("--image-size", type=int, default=None)
+    sp.add_argument("--num-classes", type=int, default=None)
+    sp.add_argument("--bf16", action="store_true")
+    _add_backend_flags(sp)
+    sp.set_defaults(fn=cmd_export_run)
 
     sp = sub.add_parser("inspect", help="list tensors in a safetensors file")
     sp.add_argument("file")

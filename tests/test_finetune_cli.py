@@ -2,7 +2,6 @@
 optional resolution change and classifier head swap."""
 
 import numpy as np
-import pytest
 
 from jimm_tpu.cli import main
 
@@ -56,6 +55,27 @@ def test_evaluate_finetuned_run(tmp_path, rng, capsys):
                  "--batch-size", "4", "--platform", "cpu"]) == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["examples"] == 8
+
+
+def test_export_run_roundtrips_through_hf(tmp_path):
+    """fine-tune -> export-run -> the output loads in transformers AND back
+    through from_pretrained with the trained head."""
+    from transformers import ViTForImageClassification
+
+    from jimm_tpu import VisionTransformer
+    ckpt = save_tiny_vit(tmp_path / "ckpt")
+    ck, out = tmp_path / "run", tmp_path / "exported"
+    assert main(["train", "--preset", "vit-base-patch16-224",
+                 "--from-pretrained", str(ckpt), "--num-classes", "3",
+                 "--steps", "2", "--batch-size", "4", "--platform", "cpu",
+                 "--ckpt-dir", str(ck), "--save-every", "1"]) == 0
+    assert main(["export-run", str(out), "--ckpt-dir", str(ck),
+                 "--preset", "vit-base-patch16-224", "--from-pretrained",
+                 str(ckpt), "--num-classes", "3", "--platform", "cpu"]) == 0
+    again = VisionTransformer.from_pretrained(str(out))
+    assert again.config.num_classes == 3
+    hf = ViTForImageClassification.from_pretrained(str(out)).eval()
+    assert hf.config.num_labels == 3
 
 
 def test_vit_finetune_keeps_matching_head(tmp_path, capsys):
