@@ -78,7 +78,9 @@ def _relayout(state, saved: dict | None, current: dict | None):
         tower = next((t for t in perms if t in keys), None)
         if tower is not None and "blocks" in keys:
             perm = perms[tower]
-            val = leaf.value if hasattr(leaf, "value") else leaf
+            # get_value(): flax 0.12 deprecates .value access on Variables
+            val = (leaf.get_value() if hasattr(leaf, "get_value")
+                   else leaf)
             if getattr(val, "ndim", 0) >= 1 and val.shape[0] == len(perm):
                 new = val[perm]
                 if getattr(val, "sharding", None) is not None:
