@@ -3,8 +3,10 @@
 The reference delegates all tokenization to `transformers` processors in its
 examples (e.g. ref `examples/clip_inference.py`), making torch-free zero-shot
 use impossible without the full HF stack. This implements CLIP's tokenizer
-(lowercase + whitespace cleanup, byte-level BPE with ``</w>`` end-of-word
-marks, ``<|startoftext|>``/``<|endoftext|>`` specials, endoftext padding)
+(control-char dropping, CJK spacing, NFC normalization, lowercase +
+whitespace cleanup — the exact ``transformers.CLIPTokenizer`` no-ftfy
+preprocessing — then byte-level BPE with ``</w>`` end-of-word marks,
+``<|startoftext|>``/``<|endoftext|>`` specials, endoftext padding)
 from the ``vocab.json`` + ``merges.txt`` files that ship inside every CLIP
 checkpoint — so ``CLIP.from_pretrained(dir)`` + `CLIPTokenizer.from_dir(dir)`
 is a complete offline zero-shot pipeline.
@@ -20,9 +22,42 @@ from __future__ import annotations
 
 import functools
 import json
+import unicodedata
 from pathlib import Path
 
 import numpy as np
+
+#: BasicTokenizer's CJK ranges (spaced out before BPE, HF parity)
+_CJK = ((0x4E00, 0x9FFF), (0x3400, 0x4DBF), (0x20000, 0x2A6DF),
+        (0x2A700, 0x2B73F), (0x2B740, 0x2B81F), (0x2B820, 0x2CEAF),
+        (0xF900, 0xFAFF), (0x2F800, 0x2FA1F))
+
+
+def _basic_clean(text: str) -> str:
+    """Mirror ``transformers.CLIPTokenizer``'s no-ftfy preprocessing
+    (BasicTokenizer with strip_accents=False, do_split_on_punc=False):
+    drop NUL/replacement/control chars, map whitespace to spaces, space out
+    CJK chars, NFC-normalize, collapse whitespace, lowercase."""
+    out = []
+    for ch in text:
+        cp = ord(ch)
+        if cp in (0, 0xFFFD):
+            continue
+        cat = unicodedata.category(ch)
+        # any C* category (control/format/unassigned/private/surrogate)
+        # except the whitespace trio is dropped, like HF's _is_control
+        if cat.startswith("C") and ch not in "\t\n\r":
+            continue
+        if ch in "\t\n\r" or cat == "Zs":
+            out.append(" ")
+        elif cp >= 0x3400 and any(lo <= cp <= hi for lo, hi in _CJK):
+            # guarded: every CJK range starts >= 0x3400, so the common
+            # Latin-dominant caption never scans the ranges
+            out.append(f" {ch} ")
+        else:
+            out.append(ch)
+    text = unicodedata.normalize("NFC", "".join(out))
+    return " ".join(t.lower() for t in text.split())
 
 
 @functools.lru_cache()
@@ -131,8 +166,7 @@ class CLIPTokenizer:
 
     def encode(self, text: str) -> list[int]:
         """Text -> token ids, WITH the sot/eot specials (HF parity)."""
-        import re
-        text = re.sub(r"\s+", " ", text.strip()).lower()
+        text = _basic_clean(text)
         ids = [self.sot_id]
         for token in self._pat.findall(text):
             if token in (self.SOT, self.EOT):

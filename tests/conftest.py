@@ -30,3 +30,28 @@ def eight_devices():
     if len(devs) < 8:
         pytest.skip("needs 8 virtual devices")
     return devs
+
+
+@pytest.fixture(scope="session")
+def clip_vocab_dir(tmp_path_factory):
+    """Synthetic CLIP vocab/merges in the real layout (byte alphabet, </w>
+    variants, merged tokens, specials last) — shared by the tokenizer
+    parity suites."""
+    import json
+
+    from jimm_tpu.data.clip_tokenizer import bytes_to_unicode
+    d = tmp_path_factory.mktemp("clip_vocab")
+    alphabet = list(bytes_to_unicode().values())
+    merges = [("t", "h"), ("th", "e</w>"), ("c", "a"), ("ca", "t</w>"),
+              ("p", "h"), ("ph", "o"), ("o", "f</w>"), ("4", "2</w>"),
+              ("i", "n"), ("a", "n"), ("an", "d</w>"), ("e", "r</w>")]
+    vocab_tokens = (alphabet + [ch + "</w>" for ch in alphabet]
+                    + ["".join(m) for m in merges]
+                    + ["<|startoftext|>", "<|endoftext|>"])
+    (d / "vocab.json").write_text(
+        json.dumps({tok: i for i, tok in enumerate(vocab_tokens)}),
+        encoding="utf-8")
+    (d / "merges.txt").write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges) + "\n",
+        encoding="utf-8")
+    return d

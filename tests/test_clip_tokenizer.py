@@ -1,33 +1,18 @@
 """Pure-python CLIP tokenizer vs the transformers oracle: identical ids on
 the same vocab/merges files."""
 
-import json
-
 import numpy as np
 import pytest
 
 transformers = pytest.importorskip("transformers")
 
-from jimm_tpu.data.clip_tokenizer import CLIPTokenizer, bytes_to_unicode
+from jimm_tpu.data.clip_tokenizer import CLIPTokenizer
 
 
 @pytest.fixture(scope="module")
-def vocab_dir(tmp_path_factory):
-    """Synthetic vocab/merges in the real CLIP layout: byte alphabet, </w>
-    variants, merged tokens, then the specials last."""
-    d = tmp_path_factory.mktemp("clip_vocab")
-    alphabet = list(bytes_to_unicode().values())
-    merges = [("t", "h"), ("th", "e</w>"), ("c", "a"), ("ca", "t</w>"),
-              ("p", "h"), ("ph", "o"), ("o", "f</w>"), ("4", "2</w>")]
-    vocab_tokens = (alphabet + [c + "</w>" for c in alphabet]
-                    + ["".join(m) for m in merges]
-                    + ["<|startoftext|>", "<|endoftext|>"])
-    vocab = {tok: i for i, tok in enumerate(vocab_tokens)}
-    (d / "vocab.json").write_text(json.dumps(vocab), encoding="utf-8")
-    (d / "merges.txt").write_text(
-        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges) + "\n",
-        encoding="utf-8")
-    return d
+def vocab_dir(clip_vocab_dir):
+    # shared synthetic vocab/merges builder: tests/conftest.py
+    return clip_vocab_dir
 
 
 PROMPTS = [
