@@ -40,38 +40,54 @@ def _block(q, k, v, mask):
     return m, l, pv
 
 
-def _ring_local_flash(q, k, v, *, axis_name: str):
+def _ring_local_flash(q, k, v, *, axis_name: str, causal: bool = False):
     """Ring step where each local (q x kv-chunk) product is the Pallas flash
     kernel (`flash_attention_lse`); chunk results are merged by logsumexp
-    reweighting. Non-causal only (vision towers): a causal version needs a
-    per-chunk static mask switch, which the einsum path provides."""
+    reweighting.
+
+    Causal decomposes per chunk pair (block-causal ring attention): the OWN
+    chunk is a causal flash call (q/k positions align), chunks from EARLIER
+    ring owners attend in full, and later owners' chunks are skipped
+    entirely (``lax.cond`` keeps the carry) — no masked flops, and the skip
+    halves the average work like the dense causal case."""
     from jimm_tpu.ops.flash_attention import flash_attention_lse
 
     n_dev = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
     b, sq, n, d = q.shape
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
-    def combine(k_cur, v_cur, lse, acc):
-        o_blk, lse_blk = flash_attention_lse(q, k_cur, v_cur)  # (B,Sq,N,D), (B,N,Sq)
+    def combine(k_cur, v_cur, lse, acc, *, is_causal=False):
+        o_blk, lse_blk = flash_attention_lse(q, k_cur, v_cur,
+                                             is_causal=is_causal)
         lse_new = jnp.logaddexp(lse, lse_blk)
         w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
         w_blk = jnp.exp(lse_blk - lse_new).transpose(0, 2, 1)[..., None]
         return lse_new, acc * w_old + o_blk.astype(jnp.float32) * w_blk
 
-    def step(carry, _):
-        k_cur, v_cur, lse, acc = carry
-        lse, acc = combine(k_cur, v_cur, lse, acc)
-        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, lse, acc), None
-
+    # own chunk first (the only causal-masked pair), then n_dev-1
+    # permute+combine steps — no wasted final permute
     lse0 = jnp.full((b, n, sq), NEG_INF, jnp.float32)
     acc0 = jnp.zeros((b, sq, n, d), jnp.float32)
-    # n_dev-1 permuting steps, then the final chunk without the (wasted)
-    # last permute
-    (k, v, lse, acc), _ = jax.lax.scan(step, (k, v, lse0, acc0),
-                                       jnp.arange(n_dev - 1))
-    _, acc = combine(k, v, lse, acc)
+    lse, acc = combine(k, v, lse0, acc0, is_causal=causal)
+
+    def step(carry, j):
+        k_cur, v_cur, lse, acc = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        if causal:
+            src = (idx - j) % n_dev  # ring owner of this kv chunk
+            lse, acc = jax.lax.cond(
+                src < idx,  # strictly earlier positions: full attention
+                lambda args: combine(k_cur, v_cur, *args),
+                lambda args: args,
+                (lse, acc))
+        else:
+            lse, acc = combine(k_cur, v_cur, lse, acc)
+        return (k_cur, v_cur, lse, acc), None
+
+    (_, _, _, acc), _ = jax.lax.scan(step, (k, v, lse, acc),
+                                     jnp.arange(1, n_dev))
     return acc.astype(q.dtype)
 
 
@@ -131,8 +147,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     ``impl="flash"`` runs each local (q x kv-chunk) product through the
     Pallas flash kernel and merges chunks by logsumexp reweighting — flash
-    blocks within the chip, the ring blocks across chips. Non-causal only.
-    ``impl="auto"`` picks flash on TPU for non-causal, einsum otherwise.
+    blocks within the chip, the ring blocks across chips; causal runs
+    block-causally (own chunk causal, earlier chunks full, later skipped).
+    ``impl="auto"`` picks flash on TPU, einsum otherwise.
     """
     if mesh is None:
         # Works both outside and inside jit: the abstract mesh mirrors the
@@ -153,14 +170,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # worth blocking; everything else takes the einsum path.
         shape = dict((mesh or jax.sharding.get_abstract_mesh()).shape)
         local_seq = q.shape[1] // shape[axis_name]
-        flash_ok = (not is_causal and jax.default_backend() == "tpu"
+        flash_ok = (jax.default_backend() == "tpu"
                     and q.shape[-1] in (64, 128, 256) and local_seq >= 128)
         impl = "flash" if flash_ok else "einsum"
     if impl == "flash":
-        if is_causal:
-            raise ValueError("impl='flash' ring attention is non-causal only; "
-                             "use impl='einsum' for causal")
-        local = partial(_ring_local_flash, axis_name=axis_name)
+        local = partial(_ring_local_flash, axis_name=axis_name,
+                        causal=is_causal)
     elif impl == "einsum":
         local = partial(_ring_local, axis_name=axis_name, causal=is_causal)
     else:

@@ -56,17 +56,20 @@ def test_gradients_match_full_attention(rng, mesh, causal):
         np.testing.assert_allclose(a, b, atol=1e-4, err_msg=f"d{name}")
 
 
-def test_flash_ring_matches_full_attention(rng, mesh):
-    """Flash-within-chip x ring-across-chips composition (non-causal)."""
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_ring_matches_full_attention(rng, mesh, causal):
+    """Flash-within-chip x ring-across-chips composition; causal runs
+    block-causally (own chunk causal, earlier full, later skipped)."""
     q, k, v = (jnp.asarray(rng.randn(2, 64, 2, 16).astype(np.float32) * 0.5)
                for _ in range(3))
-    out = ring_attention(q, k, v, mesh=mesh, impl="flash")
-    ref = reference_attention(q, k, v)
+    out = ring_attention(q, k, v, mesh=mesh, impl="flash", is_causal=causal)
+    ref = reference_attention(q, k, v, is_causal=causal)
     np.testing.assert_allclose(out, ref, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.slow
-def test_flash_ring_gradients_match(rng, mesh):
+def test_flash_ring_gradients_match(rng, mesh, causal):
     q, k, v = (jnp.asarray(rng.randn(1, 32, 2, 8).astype(np.float32) * 0.5)
                for _ in range(3))
 
@@ -76,16 +79,11 @@ def test_flash_ring_gradients_match(rng, mesh):
         )(q, k, v)
 
     gr = loss(lambda q, k, v: ring_attention(q, k, v, mesh=mesh,
-                                             impl="flash"))
-    gf = loss(reference_attention)
+                                             impl="flash", is_causal=causal))
+    gf = loss(lambda q, k, v: reference_attention(q, k, v,
+                                                  is_causal=causal))
     for name, a, b in zip("qkv", gr, gf):
         np.testing.assert_allclose(a, b, atol=1e-4, err_msg=f"d{name}")
-
-
-def test_flash_ring_rejects_causal(rng, mesh):
-    q = jnp.zeros((1, 16, 2, 8))
-    with pytest.raises(ValueError, match="non-causal"):
-        ring_attention(q, q, q, mesh=mesh, is_causal=True, impl="flash")
 
 
 def test_transformer_ring_impl_matches_xla(rng, mesh):
