@@ -12,9 +12,10 @@ Outage-proofing: the TPU tunnel in this environment fails by HANGING (not
 erroring) — round 1 lost its perf datapoint to exactly that. So the actual
 benchmark runs in a child process killed after --timeout seconds; on
 failure/timeout the parent retries once, then still prints a parseable JSON
-line (with an "error" field) and exits 0. The child additionally arms a
-SIGALRM around backend init + a probe matmul to fail fast when the tunnel is
-down, rather than burning the whole timeout.
+line (with an "error" field) and exits 0. The child additionally arms
+SIGALRM watchdogs around (a) backend init + a probe matmul (exit 17) and
+(b) the first, compiling, train step (exit 18) — both observed tunnel hang
+points — to fail fast rather than burning the whole timeout.
 """
 
 from __future__ import annotations
@@ -61,6 +62,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="watchdog: kill the child after this many seconds")
     p.add_argument("--probe-timeout", type=int, default=150,
                    help="child: SIGALRM around backend init + probe matmul")
+    p.add_argument("--compile-timeout", type=int, default=600,
+                   help="child: SIGALRM around the first (compiling) train "
+                        "step — the tunnel has been seen hanging at compile "
+                        "time, after a healthy init probe")
     p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
     # fail malformed --remat at parse time, not minutes later in the child's
@@ -147,21 +152,26 @@ def parent_main(args: argparse.Namespace) -> int:
 # Child: the actual benchmark
 # ---------------------------------------------------------------------------
 
+def _watchdog(seconds: int, exit_code: int, what: str):
+    """SIGALRM guard: interrupts a tunnel-blocked syscall where a python-
+    level timeout can't. Call the returned disarm() on success."""
+    def on_alarm(signum, frame):
+        print(f"{what} watchdog: no progress after {seconds}s",
+              file=sys.stderr)
+        os._exit(exit_code)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    return lambda: signal.alarm(0)
+
+
 def child_main(args: argparse.Namespace) -> int:
     import jimm_tpu.utils.env
     jimm_tpu.utils.env.configure_platform()
 
     import pathlib
 
-    # fail fast when the tunnel hangs: SIGALRM can interrupt the blocked
-    # backend-init / first-execute syscall where a python-level timeout can't
-    def on_alarm(signum, frame):
-        print(f"probe watchdog: backend unresponsive after "
-              f"{args.probe_timeout}s", file=sys.stderr)
-        os._exit(17)
-
-    signal.signal(signal.SIGALRM, on_alarm)
-    signal.alarm(args.probe_timeout)
+    disarm = _watchdog(args.probe_timeout, 17, "backend probe")
 
     import jax
     jax.config.update("jax_compilation_cache_dir",
@@ -174,7 +184,7 @@ def child_main(args: argparse.Namespace) -> int:
 
     probe = (jnp.ones((1024, 1024)) @ jnp.ones((1024, 1024)))
     float(probe[0, 0])  # forces backend init + one real execute round-trip
-    signal.alarm(0)
+    disarm()
 
     from jimm_tpu import SigLIP, preset
     from jimm_tpu.configs import (SigLIPConfig, TextConfig,
@@ -236,7 +246,13 @@ def child_main(args: argparse.Namespace) -> int:
         float(metrics["loss"])
         float(nnx.state(model, nnx.Param)["logit_scale"].get_value())
 
-    for _ in range(args.warmup):
+    # second watchdog: the 2026-07-30 outage hung at COMPILE time, after a
+    # healthy init probe — bound the first (compiling) step too
+    disarm = _watchdog(args.compile_timeout, 18, "first-step compile")
+    metrics = step_fn(model, optimizer, images, text)
+    sync_all()
+    disarm()
+    for _ in range(max(args.warmup - 1, 0)):
         metrics = step_fn(model, optimizer, images, text)
     sync_all()
 
