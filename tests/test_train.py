@@ -112,12 +112,17 @@ def test_contrastive_ring_train_step(rng, eight_devices):
 
 
 @pytest.mark.slow
-def test_ring_equals_dense_train_step(rng, eight_devices):
+def test_ring_equals_dense_train_step(eight_devices):
     """Ring-loss model gradients == dense-loss model gradients (same init,
     same batch). Gradient equality implies identical optimizer steps, so the
     full dense-vs-ring train-step pair isn't traced separately (it cost 2
     more 8-device compiles for no extra coverage; post-Adam params can also
-    drift — the normalized update amplifies fp32 reduction-order noise)."""
+    drift — the normalized update amplifies fp32 reduction-order noise).
+
+    Owns its rng (NOT the session fixture): the comparison sits near fp32
+    reduction-order noise (measured up to ~1.4e-5 abs on O(10) gradients
+    across seeds), so the data must not shift with suite composition."""
+    rng = np.random.RandomState(0)
     mesh = make_mesh({"data": 8})
     images = rng.randn(8, 16, 16, 3).astype(np.float32)
     text = rng.randint(1, 64, size=(8, 8))
@@ -134,7 +139,7 @@ def test_ring_equals_dense_train_step(rng, eight_devices):
     for (kd, vd), (kr, vr) in zip(nnx.to_flat_state(gd),
                                   nnx.to_flat_state(gr)):
         np.testing.assert_allclose(np.asarray(vr.get_value()),
-                                   np.asarray(vd.get_value()), atol=1e-5,
+                                   np.asarray(vd.get_value()), atol=5e-5,
                                    err_msg=str(kd))
 
 
