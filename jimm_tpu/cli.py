@@ -221,14 +221,29 @@ def _tiny_override(cfg: Any) -> Any:
 # ---------------------------------------------------------------------------
 
 def cmd_presets(args: argparse.Namespace) -> int:
+    _configure_backend(args)
+    import math
+
+    from flax import nnx
+
     from jimm_tpu.configs import PRESETS
+
+    def params_m(name: str, cfg: Any) -> str:
+        # abstract construction: shapes only, nothing allocated
+        model = nnx.eval_shape(
+            lambda: _model_cls(_family(name))(cfg, rngs=nnx.Rngs(0)))
+        n = sum(math.prod(v.shape)
+                for _, v in nnx.to_flat_state(nnx.state(model, nnx.Param)))
+        return f"{n / 1e6:8.1f}M"
+
     for name, cfg in PRESETS.items():
         v = cfg.vision
         extra = ""
         if hasattr(cfg, "text"):
             extra = (f" text(width={cfg.text.width} depth={cfg.text.depth} "
                      f"vocab={cfg.text.vocab_size})")
-        print(f"{name:32s} vision(width={v.width} depth={v.depth} "
+        print(f"{name:32s} {params_m(name, cfg)} "
+              f"vision(width={v.width} depth={v.depth} "
               f"img={v.image_size} patch={v.patch_size}){extra}")
     return 0
 
@@ -916,6 +931,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     sp = sub.add_parser("presets", help="list named model presets")
+    _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_presets)
 
     sp = sub.add_parser("train", help="train on synthetic data (offline)")
