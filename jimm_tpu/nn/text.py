@@ -40,7 +40,18 @@ class TextTower(nnx.Module):
     def __call__(self, text: jax.Array) -> jax.Array:
         """(B, S) int token ids -> (B, S, width) final hidden states."""
         seq_len = text.shape[1]
-        x = self.token_embed(text)
+        # Under FSDP rules the table's embed dim is sharded over "data"; a
+        # direct gather then yields width-sharded activations that XLA cannot
+        # reshard to the batch layout on a hybrid mesh without a full
+        # replicate ("[SPMD] Involuntary full rematerialization", r2 dryrun).
+        # Constrain the table to vocab-sharding only — the standard FSDP
+        # gather-on-use — so the lookup inherits the batch sharding from the
+        # indices instead.
+        table = self.token_embed.embedding[...]
+        if self.token_embed.dtype is not None:
+            table = table.astype(self.token_embed.dtype)
+        table = logical_constraint(table, "vocab", None)
+        x = jnp.take(table, text, axis=0)
         x = x + self.pos_embed[...][:seq_len].astype(x.dtype)
         x = logical_constraint(x, "batch", "seq", None)
         x = self.encoder(x)

@@ -20,15 +20,16 @@ The reference locks attention to ``flax.nnx.MultiHeadAttention``'s einsum path
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 
-@functools.cache
 def _default_backend() -> str:
+    # Deliberately NOT cached: a script may dispatch once (initializing the
+    # default platform) and then reconfigure jax.config / JAX_PLATFORMS; a
+    # cached answer would lock "auto" onto the stale backend forever (same
+    # reasoning as flash_attention._interpret).
     return jax.default_backend()
 
 
