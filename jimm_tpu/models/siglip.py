@@ -7,6 +7,13 @@ last-token pooling and *biased* text projection, ``logit_scale`` and
 ``in_proj_weight`` q/k/v split for the MAP head (ref `siglip.py:352-363`).
 Unlike the reference, ``intermediate_size`` is read from config, so
 So400m-class checkpoints (non-4x MLP) load (SURVEY §2.4).
+
+``Siglip2Model``-flavored checkpoints (ref `README.md:13-14` "any non-NaFlex
+variant") load through the same mapping: they differ only in the vision
+embeddings — a NaFlex Linear patch embedding (handled by ``T.patch``) and a
+``num_patches``-sized position table (grid-resampled to the fixed-resolution
+grid at load). Parity vs the HF ``Siglip2Model`` oracle is tested in
+`tests/test_siglip2.py`.
 """
 
 from __future__ import annotations
@@ -92,12 +99,18 @@ class SigLIP(nnx.Module):
                           if k.startswith("vision_model.encoder.layers."))
         t_depth = 1 + max(int(k.split(".")[3]) for k in w
                           if k.startswith("text_model.encoder.layers."))
-        patch = w["vision_model.embeddings.patch_embedding.weight"].shape[-1]
+        vc = (config or {}).get("vision_config", {})
+        tc = (config or {}).get("text_config", {})
+        pe = w["vision_model.embeddings.patch_embedding.weight"]
+        if pe.ndim == 4:  # SigLIP v1: Conv2d OIHW
+            patch = pe.shape[-1]
+        else:  # SigLIP2: NaFlex Linear (out, p*p*3) — ref `README.md:13-14`
+            patch = vc.get("patch_size", int(round((pe.shape[-1] // 3) ** 0.5)))
         n_pos = w["vision_model.embeddings.position_embedding.weight"].shape[0]
         vocab, _ = w["text_model.embeddings.token_embedding.weight"].shape
         ctx = w["text_model.embeddings.position_embedding.weight"].shape[0]
-        vc = (config or {}).get("vision_config", {})
-        tc = (config or {}).get("text_config", {})
+        # SigLIP2 vision configs carry num_patches instead of image_size;
+        # the fallback (square grid of the position table) covers them
         image = vc.get("image_size", int(round(n_pos ** 0.5)) * patch)
         vision = VisionConfig(
             image_size=image, patch_size=patch, width=v_width, depth=v_depth,
@@ -147,7 +160,7 @@ class SigLIP(nnx.Module):
               "vision_model.embeddings.position_embedding.weight",
               T.unsqueeze),
             M("vision.patch_embed.conv.kernel",
-              "vision_model.embeddings.patch_embedding.weight", T.conv),
+              "vision_model.embeddings.patch_embedding.weight", T.patch),
             M("vision.patch_embed.conv.bias",
               "vision_model.embeddings.patch_embedding.bias"),
             M("vision.ln_post.scale", "vision_model.post_layernorm.weight"),
@@ -206,11 +219,20 @@ class SigLIP(nnx.Module):
             # (remat/pipeline/attn_impl/... — configs.RUNTIME_FIELDS)
             cfg = with_runtime(cfg, **runtime)
         # higher-res fine-tune: bilinear pos-embed grid resample
-        from jimm_tpu.weights.surgery import apply_image_size
+        from jimm_tpu.weights.surgery import (apply_image_size,
+                                              resize_checkpoint_pos_embed)
+        pos_key = "vision_model.embeddings.position_embedding.weight"
         weights, cfg = apply_image_size(
             weights, cfg, image_size,
-            key="vision_model.embeddings.position_embedding.weight",
-            n_prefix=0)  # MAP pooling: pure grid, no class token
+            key=pos_key, n_prefix=0)  # MAP pooling: pure grid, no class token
+        # SigLIP2 position tables are sized by num_patches (the NaFlex
+        # maximum), which can differ from the fixed-resolution grid; resample
+        # like the HF runtime's resize_positional_embeddings does (bilinear)
+        grid = cfg.vision.image_size // cfg.vision.patch_size
+        if weights[pos_key].shape[0] != grid * grid:
+            weights = resize_checkpoint_pos_embed(
+                weights, pos_key, patch_size=cfg.vision.patch_size,
+                image_size=cfg.vision.image_size, n_prefix=0)
         param_dtype = dtype if dtype is not None else jnp.float32
         model = cls(cfg, mesh=mesh, rules=rules, dtype=dtype,
                     param_dtype=param_dtype)

@@ -58,6 +58,18 @@ class Chunk(Transform):
         return self.then.inv(w) if self.then else w
 
 
+def _patch_linear_to_hwio(w: np.ndarray) -> np.ndarray:
+    """SigLIP2's NaFlex Linear patch embedding ``(out, p*p*C)`` -> flax conv
+    kernel HWIO. The flattened input ordering is (patch_row, patch_col,
+    channel) — transformers' ``convert_image_to_patches`` reshapes
+    ``(gh, p, gw, p, C)`` then transposes ``(0, 2, 1, 3, 4)``."""
+    out, flat = w.shape
+    p = int(round((flat // 3) ** 0.5))
+    if p * p * 3 != flat:
+        raise ValueError(f"patch linear input dim {flat} is not p*p*3")
+    return np.ascontiguousarray(w.reshape(out, p, p, 3).transpose(1, 2, 3, 0))
+
+
 class T:
     """Standard transforms (HF torch layout <-> jimm_tpu layout)."""
 
@@ -67,6 +79,13 @@ class T:
     #: torch Conv2d OIHW <-> flax HWIO (ref `models/vit.py:239-240`)
     conv = Transform(lambda w: np.ascontiguousarray(w.transpose(2, 3, 1, 0)),
                      lambda w: np.ascontiguousarray(w.transpose(3, 2, 0, 1)))
+    #: patch embedding -> flax conv HWIO, accepting either the Conv2d OIHW
+    #: layout (ViT/CLIP/SigLIP v1) or SigLIP2's NaFlex Linear (2-D). The
+    #: exporter always writes the v1 Conv2d layout.
+    patch = Transform(
+        lambda w: (np.ascontiguousarray(w.transpose(2, 3, 1, 0))
+                   if w.ndim == 4 else _patch_linear_to_hwio(w)),
+        lambda w: np.ascontiguousarray(w.transpose(3, 2, 0, 1)))
     unsqueeze = Transform(lambda w: w[None], lambda w: w[0])
     #: reshape to a scalar; exporter restores a rank-1 (1,) tensor iff the
     #: checkpoint had one (SigLIP's logit_scale/bias are (1,), CLIP's is ())

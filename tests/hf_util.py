@@ -51,6 +51,40 @@ def save_tiny_siglip(tmpdir, mlp_ratio_text: int = 2) -> str:
     return str(tmpdir)
 
 
+def save_tiny_siglip2(tmpdir, num_patches: int = 4) -> str:
+    """``Siglip2Model``-flavored checkpoint (VERDICT r3 item 5): NaFlex
+    Linear patch embedding + ``num_patches``-sized position table. With
+    ``num_patches == (image/patch)^2`` (the default: 2x2 grid at 32px/p16)
+    the oracle's positional-embedding resize is the identity, so parity is
+    exact rather than interpolation-dependent."""
+    from transformers import Siglip2Config, Siglip2Model
+    text = dict(TINY_TEXT, hidden_size=96, num_attention_heads=3,
+                intermediate_size=192)
+    vision = dict(hidden_size=96, intermediate_size=192, num_hidden_layers=3,
+                  num_attention_heads=3, patch_size=16,
+                  num_patches=num_patches)
+    cfg = Siglip2Config(text_config=text, vision_config=vision)
+    model = Siglip2Model(cfg).eval()
+    model.save_pretrained(tmpdir, safe_serialization=True)
+    return str(tmpdir)
+
+
+def siglip2_pixel_inputs(img_nhwc: np.ndarray, patch: int = 16) -> dict:
+    """Pack NHWC images the way Siglip2's processor does: flattened
+    (patch_row, patch_col, channel) patches + full attention mask + the
+    square spatial shape."""
+    import torch
+    from transformers.models.siglip2.image_processing_siglip2 import (
+        convert_image_to_patches)
+    patches = np.stack([convert_image_to_patches(im, patch)
+                        for im in img_nhwc])
+    b, n, _ = patches.shape
+    g = img_nhwc.shape[1] // patch
+    return dict(pixel_values=torch.tensor(patches),
+                pixel_attention_mask=torch.ones(b, n, dtype=torch.long),
+                spatial_shapes=torch.tensor([[g, g]] * b))
+
+
 def sample_image(rng: np.random.RandomState, n: int = 2, size: int = 32
                  ) -> np.ndarray:
     return rng.randn(n, size, size, 3).astype(np.float32)

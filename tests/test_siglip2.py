@@ -1,0 +1,97 @@
+"""SigLIP2 parity vs the HF ``Siglip2Model`` oracle (capability anchor:
+ref `README.md:13-14` "SigLIP v1 and v2, any non-NaFlex variant" — which the
+reference asserts but never tests; transformers ships a *distinct*
+``Siglip2Model`` class whose checkpoints differ from Siglip's).
+
+Checkpoint-format deltas covered here: NaFlex Linear patch embedding
+(out, p*p*3) instead of Conv2d OIHW, and a ``num_patches``-sized position
+table. The oracle is driven at the fixed square resolution (spatial shape ==
+native grid), where NaFlex packing reduces to v1 semantics.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jimm_tpu import SigLIP
+
+from hf_util import (sample_image, sample_text, save_tiny_siglip2,
+                     siglip2_pixel_inputs)
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return save_tiny_siglip2(tmp_path_factory.mktemp("siglip2"))
+
+
+@pytest.fixture(scope="module")
+def oracle(ckpt):
+    from transformers import Siglip2Model
+    return Siglip2Model.from_pretrained(ckpt).eval()
+
+
+def test_vision_tower_parity(ckpt, oracle, rng):
+    """MAP-head pooled output vs the Siglip2 pooler (three-stage parity,
+    stage 1 — ref `tests/test_siglip.py:36` shape)."""
+    import torch
+    model = SigLIP.from_pretrained(ckpt)
+    img = sample_image(rng)
+    inputs = siglip2_pixel_inputs(img)
+    with torch.no_grad():
+        # the vision submodule names the mask `attention_mask` (the
+        # top-level Siglip2Model calls it `pixel_attention_mask`)
+        ref = oracle.vision_model(
+            pixel_values=inputs["pixel_values"],
+            attention_mask=inputs["pixel_attention_mask"],
+            spatial_shapes=inputs["spatial_shapes"]).pooler_output.numpy()
+    np.testing.assert_allclose(np.asarray(model.encode_image(jnp.asarray(img))),
+                               ref, atol=1e-4)
+
+
+def test_text_tower_parity(ckpt, oracle, rng):
+    import torch
+    model = SigLIP.from_pretrained(ckpt)
+    txt = sample_text(rng)
+    with torch.no_grad():
+        ref = oracle.get_text_features(torch.tensor(txt)).numpy()
+    np.testing.assert_allclose(np.asarray(model.encode_text(jnp.asarray(txt))),
+                               ref, atol=1e-4)
+
+
+def test_logits_parity(ckpt, oracle, rng):
+    import torch
+    model = SigLIP.from_pretrained(ckpt)
+    img, txt = sample_image(rng), sample_text(rng)
+    ours = np.asarray(model(jnp.asarray(img), jnp.asarray(txt)))
+    with torch.no_grad():
+        theirs = oracle(input_ids=torch.tensor(txt),
+                        **siglip2_pixel_inputs(img)).logits_per_image.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+def test_num_patches_table_resamples_to_grid(tmp_path, rng):
+    """A v2 table sized by a LARGER num_patches than the load grid (the
+    NaFlex maximum) is bilinearly resampled at load instead of erroring."""
+    ckpt = save_tiny_siglip2(tmp_path, num_patches=16)  # 4x4 table
+    model = SigLIP.from_pretrained(ckpt)
+    # no image_size in v2 configs: inferred from the table (4*16 = 64px)
+    assert model.config.vision.image_size == 64
+    # and an explicit lower resolution forces the 4x4 -> 2x2 resample
+    small = SigLIP.from_pretrained(ckpt, image_size=32)
+    out = small(jnp.asarray(sample_image(rng)),
+                jnp.asarray(sample_text(rng)))
+    assert out.shape == (2, 2) and np.isfinite(np.asarray(out)).all()
+
+
+def test_shape_inference_without_config(ckpt, tmp_path, rng):
+    """Config-free load: patch size inferred from the 2-D Linear weight."""
+    import os
+    import shutil
+    d = tmp_path / "noconfig"
+    d.mkdir()
+    shutil.copy(os.path.join(ckpt, "model.safetensors"), d)
+    model = SigLIP.from_pretrained(str(d / "model.safetensors"))
+    assert model.config.vision.patch_size == 16
+    assert model.config.vision.pooling == "map"
+    out = model(jnp.asarray(sample_image(rng)), jnp.asarray(sample_text(rng)))
+    assert out.shape == (2, 2)
