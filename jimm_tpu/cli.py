@@ -374,16 +374,16 @@ def cmd_train(args: argparse.Namespace) -> int:
                    skip_examples=start_step * args.batch_size,
                    **_norm_for(fam))
 
-    grain_iter = None  # raw grain iterator, for checkpointable state
+    grain_stream = None  # consumed-state tracker for exact checkpoint/resume
 
     def _grain_data(task: str):
-        nonlocal grain_iter
+        nonlocal grain_stream
         if _is_tar_data(args.data):
             raise SystemExit("--loader grain reads tfrecord shards; tar "
                              "(webdataset) data uses --loader records")
         import base64
 
-        from jimm_tpu.data.grain_pipeline import (grain_batches,
+        from jimm_tpu.data.grain_pipeline import (CheckpointableGrainStream,
                                                   make_grain_loader)
         extra = ({"seq_len": cfg.text.context_length}
                  if task == "contrastive" else {})
@@ -397,15 +397,17 @@ def cmd_train(args: argparse.Namespace) -> int:
         saved = (ckpt.last_restored_extra.get("grain_state")
                  if ckpt is not None else None)
         if start_step and saved:
-            # exact position from the checkpoint — no decode replay.
-            # (Captured after the saved step's batch; under PrefetchIterator
-            # the producer may have pulled a couple of batches ahead, so up
-            # to `prefetch` batches are skipped, never repeated.)
+            # exact position from the checkpoint — no decode replay, no
+            # skipped batches: the saved state is the one captured with the
+            # last batch the train loop actually consumed (see
+            # CheckpointableGrainStream), so resume lands on the very next
+            # batch even though PrefetchIterator had read ahead.
             grain_iter.set_state(base64.b64decode(saved))
         else:
             for _ in range(start_step):  # pre-grain_state checkpoint:
                 next(grain_iter)         # replay (decodes) to position
-        return grain_batches(grain_iter)
+        grain_stream = CheckpointableGrainStream(grain_iter)
+        return grain_stream.batches()
 
     if fam == "vit":
         step_fn = make_classifier_train_step()
@@ -464,6 +466,10 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     data = PrefetchIterator(data, mesh=mesh, rules=rules) \
         if mesh is not None else map(place, data)
+    if grain_stream is not None:
+        # advance consumed_state batch-by-batch on THIS (consumer) side of
+        # the prefetch queue, so checkpoints record the trained-on position
+        data = grain_stream.track(data)
 
     # profile steps start+2..start+4 (past compile), falling back to the
     # whole run when it is shorter than that
@@ -488,10 +494,10 @@ def cmd_train(args: argparse.Namespace) -> int:
                            **{k: float(v) for k, v in metrics.items()})
                 if ckpt is not None:
                     extra = None
-                    if grain_iter is not None:
+                    if grain_stream is not None:
                         import base64
                         extra = {"grain_state": base64.b64encode(
-                            grain_iter.get_state()).decode("ascii")}
+                            grain_stream.consumed_state).decode("ascii")}
                     ckpt.save(step, model, optimizer, extra=extra)
                 if args.fake_failure_at_step is not None \
                         and step == args.fake_failure_at_step:
@@ -709,9 +715,16 @@ def cmd_prepare_data(args: argparse.Namespace) -> int:
                         from transformers import AutoTokenizer  # opt tooling
                         tok = AutoTokenizer.from_pretrained(args.tokenizer)
                     ids = tok(caption)["input_ids"]
+                if len(ids) > args.seq_len:
+                    # keep the FINAL token when truncating: CLIP pools the
+                    # text tower at the EOT position (argmax of ids), and a
+                    # plain tail-chop would drop it — `classify` refuses
+                    # exactly this silent truncation (see its context-length
+                    # guard); the training-data writer must not do it either
+                    ids = list(ids[:args.seq_len - 1]) + [ids[-1]]
                 writer.write(encode_example(
                     {"image": (src / rel).read_bytes(),
-                     "tokens": ids[:args.seq_len]}))
+                     "tokens": ids}))
     finally:
         writer.close()  # flush the open shard even on a mid-run error
     if not writer.total:

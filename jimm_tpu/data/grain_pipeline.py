@@ -181,3 +181,41 @@ def grain_batches(loader) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     stream the trainer consumes (`jimm_tpu.cli.cmd_train`)."""
     for batch in loader:
         yield tuple(np.asarray(b) for b in batch)
+
+
+class CheckpointableGrainStream:
+    """Exact resume under prefetch: pairs every produced batch with the
+    grain iterator state captured right after pulling it, and exposes
+    ``consumed_state`` — the state as of the last batch the *training loop*
+    received, not the producer's read-ahead position.
+
+    A ``PrefetchIterator`` runs the producer in a worker thread up to
+    ``prefetch`` batches ahead, so checkpointing ``grain_iter.get_state()``
+    directly skips those in-flight batches on resume (they were produced,
+    never trained on). Iterate ``.batches()`` as the producer, wrap the
+    consumer side with ``.track()``, and checkpoint ``consumed_state``.
+
+    Thread-safety: the producer appends and the consumer pops on a
+    ``deque`` — both operations are atomic, and batch order is preserved
+    end-to-end (the prefetch queue is FIFO), so state i always pairs with
+    batch i.
+    """
+
+    def __init__(self, grain_iter):
+        from collections import deque
+        self._it = grain_iter
+        self._produced: "deque[bytes]" = deque()
+        #: state to checkpoint; resumes at the batch AFTER the last consumed
+        self.consumed_state: bytes = grain_iter.get_state()
+
+    def batches(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """Producer side: (images, aux) tuples off the grain iterator."""
+        for batch in self._it:
+            self._produced.append(self._it.get_state())
+            yield tuple(np.asarray(b) for b in batch)
+
+    def track(self, iterator: Iterator) -> Iterator:
+        """Consumer side: pass batches through, advancing consumed_state."""
+        for batch in iterator:
+            self.consumed_state = self._produced.popleft()
+            yield batch

@@ -86,11 +86,14 @@ def native_codecs_available() -> bool:
 
 def decode_image_native(data: bytes) -> np.ndarray | None:
     """Decode JPEG/PNG bytes to uint8 [H, W, 3] RGB via the native library
-    (libjpeg/libpng). Returns None when the native path can't take it —
-    library not built, codecs absent, or an image class the C side doesn't
-    handle (alpha/palette/16-bit PNG, CMYK JPEG, decompression-bomb sizes)
-    — so callers fall back to PIL. Corrupt image bodies raise OSError like
-    PIL's loader does, so existing skip-bad-record handlers keep working."""
+    (libjpeg/libpng). Returns None whenever the native path can't or
+    shouldn't take it — library not built, codecs absent, an image class the
+    C side doesn't handle (alpha/palette/16-bit PNG, CMYK JPEG,
+    decompression-bomb sizes), libjpeg warnings (e.g. 'extraneous bytes
+    before marker', which PIL decodes fine), or outright corrupt bodies —
+    so callers fall back to PIL, which makes the final accept/reject call.
+    Files PIL would also reject then raise in PIL, keeping existing
+    skip-bad-record handlers working."""
     if not native_codecs_available():
         return None
     h, w = _I64(0), _I64(0)
@@ -100,7 +103,7 @@ def decode_image_native(data: bytes) -> np.ndarray | None:
         return None  # needs-PIL (1) or not an image (2: caller will raise)
     out = np.empty((h.value, w.value, 3), np.uint8)
     if _LIB.jimm_decode_image(data, len(data), out, h.value, w.value) != 0:
-        raise OSError("native image decode failed (corrupt data?)")
+        return None  # suspect (1) or corrupt (-1): let PIL decide
     return out
 
 

@@ -108,8 +108,13 @@ def initialize_distributed(coordinator_address: str | None = None,
     argument-free auto-detect path downgrades to a warning (it legitimately
     fails on non-pod environments).
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    # NB: no jax.process_count() pre-check — that call would itself
+    # initialize the XLA backend, after which jax.distributed.initialize
+    # hard-errors ("must be called before any JAX calls"); is_initialized()
+    # answers without touching the backend (found by
+    # tests/test_distributed.py's real two-process cluster).
+    if jax.distributed.is_initialized():
+        return
     explicit = (coordinator_address is not None or num_processes is not None
                 or process_id is not None)
     try:

@@ -89,7 +89,12 @@ int jimm_image_info(const uint8_t* data, int64_t n, int64_t* h, int64_t* w) {
     *h = be32(data + 20);
     int bit_depth = data[24];
     int color = data[25];
-    if (*h <= 0 || *w <= 0 || *h * *w > kMaxPixels) return 1;
+    // bound each dimension BEFORE multiplying: h/w come straight from
+    // attacker-controlled IHDR bytes (up to 2^32-1 each) and the int64
+    // product can overflow, wrapping negative and slipping past the guard
+    if (*h <= 0 || *w <= 0 || *h > kMaxPixels || *w > kMaxPixels ||
+        *h * *w > kMaxPixels)
+      return 1;
     // 0 = gray, 2 = truecolor RGB; everything else (palette, alpha,
     // 16-bit) takes the python path
     return (bit_depth == 8 && (color == 0 || color == 2)) ? 0 : 1;
@@ -97,7 +102,9 @@ int jimm_image_info(const uint8_t* data, int64_t n, int64_t* h, int64_t* w) {
   return 2;
 }
 
-// Decode into caller-allocated uint8 [h, w, 3] RGB. Returns 0 on success.
+// Decode into caller-allocated uint8 [h, w, 3] RGB. Returns 0 on success,
+// 1 when the image decoded but libjpeg warned (caller should prefer a
+// tolerant decoder's judgement), -1 on hard failure.
 int jimm_decode_image(const uint8_t* data, int64_t n, uint8_t* out,
                       int64_t h, int64_t w) {
   if (is_jpeg(data, n)) {
@@ -125,11 +132,14 @@ int jimm_decode_image(const uint8_t* data, int64_t n, uint8_t* out,
       jpeg_read_scanlines(&cinfo, &row, 1);
     }
     jpeg_finish_decompress(&cinfo);
-    // truncated bodies only WARN in libjpeg (it pads the missing data);
-    // surface them as decode failures like PIL's strict loader does
+    // libjpeg WARNS (rather than erroring) on recoverable oddities —
+    // truncated bodies it pads, but also harmless junk like "extraneous
+    // bytes before marker" that is common in real-world corpora and that
+    // PIL decodes fine. Report 1 (decoded-but-suspect) so the python
+    // wrapper re-decodes through PIL, which makes the accept/reject call.
     bool warned = cinfo.err->num_warnings > 0;
     jpeg_destroy_decompress(&cinfo);
-    return warned ? -1 : 0;
+    return warned ? 1 : 0;
   }
   if (is_png(data, n)) {
     png_image image;

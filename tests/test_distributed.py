@@ -1,0 +1,78 @@
+"""Two-process `jax.distributed` smoke (VERDICT r2 weak #7: every
+multi-device test ran in one process; `initialize_distributed` was never
+exercised even at 2 local processes).
+
+Spawns two real OS processes forming a local CPU cluster: asserts cluster
+formation, global mesh construction over non-addressable devices, a
+cross-process psum, and a process_allgather — the primitives multi-host
+training rests on (SURVEY §2.3 "collective communication backend" row).
+"""
+
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = r"""
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+addr, pid = sys.argv[1], int(sys.argv[2])
+from jimm_tpu.parallel import initialize_distributed, make_mesh
+initialize_distributed(coordinator_address=addr, num_processes=2,
+                       process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.process_index() == pid
+assert jax.device_count() == 4, jax.device_count()       # 2 global x 2 local
+assert jax.local_device_count() == 2
+
+# double-init must be a no-op (initialize_distributed's contract)
+initialize_distributed(coordinator_address=addr, num_processes=2,
+                       process_id=pid)
+
+import jax.numpy as jnp
+from jax import shard_map
+from jax.experimental import multihost_utils
+from jax.sharding import PartitionSpec as P
+
+# cross-process allgather: one value per process, ordered by process id
+got = multihost_utils.process_allgather(jnp.float32(pid + 1))
+assert got.tolist() == [1.0, 2.0], got
+
+# global mesh over all 4 devices (2 of them non-addressable here) + psum
+mesh = make_mesh({"data": -1})
+assert dict(mesh.shape) == {"data": 4}
+fn = shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+               in_specs=P(), out_specs=P())
+out = jax.jit(fn)(np.float32(1.0))
+assert float(out) == 4.0, float(out)
+print(f"WORKER_OK {pid}")
+"""
+
+
+@pytest.mark.slow
+def test_two_process_cluster(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    addr = f"127.0.0.1:{port}"
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", WORKER, addr, str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"worker {pid} rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
+        assert f"WORKER_OK {pid}" in out

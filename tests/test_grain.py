@@ -124,3 +124,27 @@ def test_checkpointable_resume(shards):
     it2.set_state(state)
     resumed = [t.tolist() for _, t in it2]
     assert resumed == rest
+
+
+def test_consumed_state_survives_prefetch_readahead(shards):
+    """ADVICE r2 #4: checkpointing the raw iterator's state after a
+    PrefetchIterator had read ahead silently skipped up to `prefetch`
+    batches on resume. CheckpointableGrainStream pairs states with batches
+    and exposes the state of the last CONSUMED one."""
+    from jimm_tpu.data.grain_pipeline import CheckpointableGrainStream
+    loader = make_grain_loader(shards, 2, task="contrastive", image_size=8,
+                               seq_len=3, seed=1, num_epochs=1)
+    stream = CheckpointableGrainStream(iter(loader))
+    producer = stream.batches()
+    # simulate a prefetcher that pulled 3 batches ahead of the trainer
+    buffered = [next(producer) for _ in range(3)]
+    consumer = stream.track(iter(buffered))
+    next(consumer)  # the trainer consumed exactly ONE batch
+    state = stream.consumed_state
+
+    it_truth = iter(loader)
+    next(it_truth)  # ground truth: everything after batch 0
+    want = [t.tolist() for _, t in it_truth]
+    it_resumed = iter(loader)
+    it_resumed.set_state(state)
+    assert [t.tolist() for _, t in it_resumed] == want

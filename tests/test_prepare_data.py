@@ -81,3 +81,17 @@ def test_text_captions_need_tokenizer(tmp_path, rng):
     with pytest.raises(SystemExit, match="tokenizer"):
         main(["prepare-data", str(src), str(tmp_path / "o"),
               "--task", "contrastive", "--captions", str(captions)])
+
+
+def test_contrastive_truncation_keeps_final_token(tmp_path, rng):
+    """ADVICE r2 #3: a plain tail-chop on over-length captions drops the
+    final EOT token CLIP's text tower pools at; truncation must keep it."""
+    src, out = tmp_path / "src", tmp_path / "out"
+    _write_png(src / "img.png", rng)
+    captions = tmp_path / "captions.tsv"
+    captions.write_text("img.png\t1 2 3 4 5 6 7 99\n")
+    assert main(["prepare-data", str(src), str(out), "--task", "contrastive",
+                 "--captions", str(captions), "--seq-len", "4"]) == 0
+    _, tokens = next(image_text_batches(
+        str(out), 1, image_size=8, seq_len=4, shuffle_buffer=0, repeat=False))
+    np.testing.assert_array_equal(tokens[0], [1, 2, 3, 99])
