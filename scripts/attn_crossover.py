@@ -31,13 +31,23 @@ def timeit(fn, *args, iters=10):
 
 
 def main():
+    import argparse
+    from functools import partial
+
     from jimm_tpu.ops.flash_attention import flash_attention
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--causal", action="store_true",
+                   help="also time causal flash: with skipped kv blocks "
+                        "eliding both compute AND their DMA, causal should "
+                        "approach half the non-causal time at long seq")
+    args = p.parse_args()
 
     print("backend:", jax.default_backend(), jax.devices()[0].device_kind)
     rng = np.random.RandomState(0)
     N, D = 12, 64
     total_tokens = 128 * 256  # constant B*S
-    for S in (64, 128, 256, 512, 1024, 2048, 4096):
+    for S in (64, 128, 256, 512, 1024, 2048, 4096, 8192):
         B = max(1, total_tokens // S)
         q = jnp.asarray(rng.randn(B, S, N, D), jnp.bfloat16)
         k = jnp.asarray(rng.randn(B, S, N, D), jnp.bfloat16)
@@ -53,9 +63,15 @@ def main():
         tx = timeit(loss_of(
             lambda q, k, v: jax.nn.dot_product_attention(q, k, v)), q, k, v)
         win = "flash" if tf < tx else "xla"
+        causal_col = ""
+        if args.causal:
+            tc = timeit(loss_of(partial(flash_attention, is_causal=True)),
+                        q, k, v)
+            causal_col = (f"  causal {tc*1e3:8.2f} ms "
+                          f"({tc/tf:4.2f}x of full)")
         print(f"  S={S:5d} B={B:4d}: flash {tf*1e3:8.2f} ms "
               f"({flops/tf/1e12:6.2f} TF/s)  xla {tx*1e3:8.2f} ms "
-              f"({flops/tx/1e12:6.2f} TF/s)  -> {win}")
+              f"({flops/tx/1e12:6.2f} TF/s)  -> {win}{causal_col}")
 
 
 if __name__ == "__main__":

@@ -1,31 +1,31 @@
 #!/bin/bash
-# Wait for the TPU tunnel to come back, then run the queued measurements
-# serially (the single chip must never be shared between processes).
+# Wait for the TPU tunnel to come back, then run the round-3 measurement
+# queue serially (the single chip must never be shared between processes).
+# Priority: the lever sweep first (VERDICT r2 item 2 — picks bench.py's
+# defaults), then the benchmark of record, then kernel microbenches.
+# Log everything: tee to /tmp/measure_r3.log for later mining.
 cd /root/repo
-for i in $(seq 1 90); do
+exec > >(tee -a /tmp/measure_r3.log) 2>&1
+for i in $(seq 1 120); do
   if timeout 90 python -c "
 import jax
 x = (jax.numpy.ones((256,256)) @ jax.numpy.ones((256,256)))
 assert float(x[0,0]) == 256.0" 2>/dev/null; then
-    echo "TPU alive after $i probes"
+    echo "TPU alive after $i probes at $(date -u +%H:%M:%S)"
     break
   fi
-  echo "probe $i: tunnel down, sleeping 120s"
+  echo "probe $i: tunnel down at $(date -u +%H:%M:%S), sleeping 120s"
   sleep 120
 done
 
-echo "=== 1. attention microbench (head-blocked kernels) ==="
-timeout 600 python -m scripts.perf_probe --mode attn 2>&1 | grep -v WARNING | tail -6
-echo "=== 2. crossover sweep ==="
-timeout 600 python -m scripts.attn_crossover 2>&1 | grep -v WARNING | tail -8
-echo "=== 2.5 fused-LN bench ==="
-timeout 600 python -m scripts.ln_bench 2>&1 | grep -v WARNING | tail -4
-echo "=== 3. train grid (attn x kernels at unroll 12) ==="
-timeout 900 python -m scripts.perf_probe --mode train --remat dots --unroll 12 2>&1 | grep -E "train remat" | tail -4
-echo "=== 3b. ln fused / qkv fused variants ==="
-timeout 900 python -m scripts.perf_probe --mode train --remat dots --unroll 12 --attn auto --ln fused 2>&1 | grep -E "train remat" | tail -2
-timeout 900 python -m scripts.perf_probe --mode train --remat dots --unroll 12 --attn auto --fused-qkv 2>&1 | grep -E "train remat" | tail -2
-timeout 900 python -m scripts.perf_probe --mode train --remat dots --unroll 12 --attn auto --ln fused --fused-qkv 2>&1 | grep -E "train remat" | tail -2
-echo "=== 4. bench.py (benchmark of record) ==="
-timeout 1550 python bench.py 2>&1 | tail -2
-echo "=== queue done ==="
+echo "=== 1. lever sweep (picks bench.py defaults; one process, cached) ==="
+timeout 3000 python -m scripts.bench_sweep --steps 30 2>&1 | grep -v WARNING
+echo "=== 2. bench.py (benchmark of record, current defaults) ==="
+BENCH_TIMEOUT_S=900 timeout 950 python bench.py 2>&1 | tail -2
+echo "=== 3. causal flash crossover (DMA-elision check) ==="
+timeout 900 python -m scripts.attn_crossover --causal 2>&1 | grep -v WARNING | tail -10
+echo "=== 4. long-context fwd+bwd ==="
+timeout 900 python -m scripts.longcontext_bench --bwd 2>&1 | grep -v WARNING | tail -8
+echo "=== 5. long-context causal (DMA elision at 8k-32k) ==="
+timeout 900 python -m scripts.longcontext_bench --bwd --causal 2>&1 | grep -v WARNING | tail -8
+echo "=== queue done at $(date -u +%H:%M:%S) ==="
