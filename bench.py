@@ -20,7 +20,10 @@ and the driver killed the whole bench at rc=124). The budget model is now:
   happens only if the remaining budget still fits a full second attempt.
   Without it there is exactly ONE attempt.
 - The parent prints a parseable JSON line (with an "error" field) and exits
-  0 on every failure path.
+  0 on every failure path. When every TPU attempt failed, it first re-runs
+  the child once on the CPU backend (``JIMM_PLATFORM=cpu``) so the driver
+  artifact carries a non-zero, clearly CPU-labeled smoke datapoint proving
+  the measurement path end-to-end (the error field stays).
 - The child arms SIGALRM watchdogs before anything that can touch the
   tunnel: (a) backend plugin import + init + a probe matmul (exit 17), and
   (b) the first, compiling, train step (exit 18) — both observed hang
@@ -106,28 +109,42 @@ def emit_error(msg: str, detail: str = "") -> None:
     }), flush=True)
 
 
+# Budget carved out of the total window for the CPU-smoke fallback: when no
+# TPU attempt produced a datapoint, one child re-run on the CPU backend
+# proves the measurement path end-to-end in the driver artifact (VERDICT r3
+# item 3). The smoke itself needs ~90 s (tiny-config compile + steps on this
+# 1-core host); the reserve adds the attempt/smoke timeout margins so the
+# granted window never drops below that even after a double hang.
+CPU_SMOKE_RESERVE = 110
+
+
 def resolve_budget(args: argparse.Namespace) -> tuple[int, int]:
     """(per-attempt timeout, total budget). ``BENCH_TIMEOUT_S`` is the total
-    window the driver gives us; without it, total = one attempt + slack so
-    there is never a blind retry (the r2 datapoint died to exactly that)."""
+    window the driver gives us; without it, total = one attempt + the CPU
+    fallback reserve + slack so there is never a blind retry (the r2
+    datapoint died to exactly that)."""
     total_env = int(os.environ.get("BENCH_TIMEOUT_S", "0") or 0)
     attempt = args.timeout
     if not attempt:
         attempt = min(420, total_env - 15) if total_env else 420
-    total = total_env if total_env else max(attempt, 10) + 15
+    total = total_env if total_env else max(attempt, 10) + CPU_SMOKE_RESERVE + 15
     # the attempt must NEVER exceed the driver's window — an overrun means
-    # the driver kills us before emit_error prints (the r2 rc=124 failure)
-    attempt = max(10, min(attempt, total - 5))
+    # the driver kills us before emit_error prints (the r2 rc=124 failure) —
+    # and must leave room for the CPU-smoke fallback after a hang
+    attempt = max(10, min(attempt, total - CPU_SMOKE_RESERVE - 5))
     return attempt, total
 
 
-def run_child(argv: list[str], timeout: int) -> tuple[int | None, str, str]:
+def run_child(argv: list[str], timeout: int,
+              extra_env: dict[str, str] | None = None
+              ) -> tuple[int | None, str, str]:
     """Returns (returncode | None on timeout, stdout, stderr)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--child-budget", str(timeout)] + argv
+    env = dict(os.environ, **extra_env) if extra_env else None
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout)
+                              timeout=timeout, env=env)
         return proc.returncode, proc.stdout, proc.stderr
     except subprocess.TimeoutExpired as e:
         out = e.stdout or b""
@@ -156,11 +173,18 @@ def parent_main(args: argparse.Namespace) -> int:
     argv = sys.argv[1:]
     start = time.monotonic()
     attempt_timeout, total = resolve_budget(args)
+    # retries exist ONLY when the driver told us its window via
+    # BENCH_TIMEOUT_S — without it the real window is unknown and a blind
+    # retry can overrun it and strand the artifact (the r2 rc=124 failure)
+    allow_retry = bool(int(os.environ.get("BENCH_TIMEOUT_S", "0") or 0))
     last_detail = ""
     while True:
         remaining = total - (time.monotonic() - start)
+        # every TPU attempt leaves the CPU-smoke reserve untouched; the
+        # resolve_budget cap guarantees the FIRST attempt runs at full size
         rc, out, err = run_child(
-            argv, int(max(10, min(attempt_timeout, remaining))))
+            argv, int(max(10, min(attempt_timeout,
+                                  remaining - CPU_SMOKE_RESERVE - 5))))
         # scan stdout on EVERY outcome: a child that measured a result and
         # then hung in backend teardown still produced the datapoint
         line = find_json_line(out)
@@ -175,11 +199,41 @@ def parent_main(args: argparse.Namespace) -> int:
         else:
             last_detail = f"child exited {rc}; stderr tail: {err[-1500:]}"
         remaining = total - (time.monotonic() - start)
-        if remaining < attempt_timeout + 15:  # no room for a full retry
+        # retry with whatever window remains after the fallback reserve — a
+        # TPU retry always outranks the CPU smoke — but only if that window
+        # still fits a realistic attempt (probe 120s + compile 240s slack)
+        if (not allow_retry
+                or min(attempt_timeout,
+                       remaining - CPU_SMOKE_RESERVE - 15) < 300):
             break
         time.sleep(5)
+    # No TPU datapoint. Print the guaranteed error line FIRST — a driver
+    # kill during the CPU smoke must never strand the artifact without a
+    # JSON line — then attempt the CPU-smoke fallback (VERDICT r3 item 3),
+    # whose line, if produced, supersedes it as the last parseable line.
+    # The child's CPU branch already uses a distinct metric name; the value
+    # is explicitly NOT the metric of record.
     emit_error("benchmark did not complete (backend unreachable or hung); "
                "see detail", last_detail)
+    remaining = total - (time.monotonic() - start)
+    if remaining >= 100:  # grants the smoke its documented ~90 s minimum
+        # minimal argv: the user's TPU-tuned flags (--batch-size 128,
+        # --attn flash, ...) could crash or overrun the smoke window on the
+        # CPU backend — the smoke only proves the measurement path
+        smoke_argv = ["--steps", "20", "--warmup", "1"]
+        rc, out, err = run_child(smoke_argv, int(min(240, remaining - 10)),
+                                 extra_env={"JIMM_PLATFORM": "cpu"})
+        line = find_json_line(out)
+        if line is not None:
+            rec = json.loads(line)
+            rec.pop("mfu", None)       # CPU mfu is meaningless vs TPU peak
+            rec.pop("mfu_crosscheck", None)
+            rec["vs_baseline"] = 0.0   # fallback never scores vs the bar
+            rec["error"] = ("TPU benchmark did not complete; value is a "
+                            "CPU-smoke fallback proving the measurement "
+                            "path, not the metric of record")
+            rec["detail"] = last_detail[-2000:]
+            print(json.dumps(rec), flush=True)
     return 0  # rc 0 semantics: the driver must always record the JSON line
 
 

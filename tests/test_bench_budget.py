@@ -1,0 +1,73 @@
+"""Unit tests for bench.py's parent-side budget and JSON-line logic.
+
+These never touch a backend: they exercise the outage-proofing math that
+decides whether the driver artifact gets a datapoint (VERDICT r3 item 3).
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+
+def _load_bench():
+    path = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+    spec = importlib.util.spec_from_file_location("bench_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench = _load_bench()
+
+
+def _args(**kw):
+    argv = []
+    for k, v in kw.items():
+        argv += [f"--{k.replace('_', '-')}", str(v)]
+    return bench.parse_args(argv, validate=False)
+
+
+def test_default_budget_reserves_cpu_smoke(monkeypatch):
+    monkeypatch.delenv("BENCH_TIMEOUT_S", raising=False)
+    attempt, total = bench.resolve_budget(_args())
+    # one attempt + the CPU fallback must both fit inside the total window
+    assert attempt + bench.CPU_SMOKE_RESERVE + 5 <= total
+    assert attempt >= 300  # the TPU attempt still gets a real window
+
+
+def test_env_total_budget_caps_attempt(monkeypatch):
+    monkeypatch.setenv("BENCH_TIMEOUT_S", "900")
+    attempt, total = bench.resolve_budget(_args())
+    assert total == 900
+    assert attempt == 420  # the r3 default per-attempt cap
+    monkeypatch.setenv("BENCH_TIMEOUT_S", "200")
+    attempt, total = bench.resolve_budget(_args())
+    # a small driver window shrinks the attempt, never overruns
+    assert attempt + bench.CPU_SMOKE_RESERVE + 5 <= 200
+
+
+def test_reserve_covers_documented_smoke_minimum():
+    # the smoke needs ~90 s; the reserve must cover that plus the attempt's
+    # -5 margin and the smoke's own -10 timeout margin (double-hang path)
+    assert bench.CPU_SMOKE_RESERVE >= 90 + 5 + 10
+
+
+def test_explicit_timeout_still_leaves_reserve(monkeypatch):
+    monkeypatch.delenv("BENCH_TIMEOUT_S", raising=False)
+    attempt, total = bench.resolve_budget(_args(timeout=60))
+    assert attempt == 60
+    assert total >= 60 + bench.CPU_SMOKE_RESERVE
+
+
+def test_find_json_line_requires_metric_schema():
+    out = "\n".join([
+        "some log line",
+        json.dumps({"not": "the schema"}),
+        "42",
+        json.dumps({"metric": "m", "value": 1.0}),
+        "trailing noise",
+    ])
+    line = bench.find_json_line(out)
+    assert json.loads(line)["metric"] == "m"
+    assert bench.find_json_line("no json here\n17\n[1,2]") is None
