@@ -216,7 +216,7 @@ def parent_main(args: argparse.Namespace) -> int:
     emit_error("benchmark did not complete (backend unreachable or hung); "
                "see detail", last_detail)
     remaining = total - (time.monotonic() - start)
-    if remaining >= 100:  # grants the smoke its documented ~90 s minimum
+    if remaining >= CPU_SMOKE_RESERVE:  # smoke needs its ~90s + margins
         # minimal argv: the user's TPU-tuned flags (--batch-size 128,
         # --attn flash, ...) could crash or overrun the smoke window on the
         # CPU backend — the smoke only proves the measurement path

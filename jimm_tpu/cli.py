@@ -428,9 +428,12 @@ def cmd_train(args: argparse.Namespace) -> int:
                                        num_classes=cfg.num_classes,
                                        seed=args.seed)
     else:
-        loss_kind = args.loss or ("clip" if fam == "clip" else
-                                  ("siglip_ring" if mesh is not None
-                                   else "siglip"))
+        if fam == "clip":
+            loss_kind = args.loss or ("clip_ring" if mesh is not None
+                                      else "clip")
+        else:
+            loss_kind = args.loss or ("siglip_ring" if mesh is not None
+                                      else "siglip")
         step_fn = make_contrastive_train_step(loss_kind, mesh=mesh)
         if args.data and args.loader == "grain":
             data = _grain_data("contrastive")
@@ -987,7 +990,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "fsdp_tp", "sp", "pp"],
                     help="sharding rules preset (requires --mesh)")
     sp.add_argument("--loss", default=None,
-                    choices=["clip", "siglip", "siglip_ring"])
+                    choices=["clip", "clip_ring", "siglip", "siglip_ring"])
     sp.add_argument("--attn-impl", default=None,
                     choices=["auto", "xla", "flash", "ring", "saveable"],
                     help="attention kernel for both towers "

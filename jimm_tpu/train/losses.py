@@ -1,5 +1,5 @@
-"""Contrastive losses: CLIP softmax and SigLIP sigmoid, plus the ICI ring
-implementation of the sigmoid all-pairs loss.
+"""Contrastive losses: CLIP softmax and SigLIP sigmoid, plus ICI ring
+implementations of both (chunked sigmoid, and streaming-logsumexp InfoNCE).
 
 The reference has no training losses for its dual-tower models at all (only
 the MNIST example's cross-entropy, ref `examples/vit_training.py:76`). The
@@ -88,6 +88,91 @@ def _ring_sigmoid_local(img: jax.Array, txt: jax.Array, scale: jax.Array,
     # average over the *global* batch like the dense reference
     total = jax.lax.psum(total, axis_name)
     return total / (b * n_dev)
+
+
+def _ring_infonce_local(img: jax.Array, txt: jax.Array, scale: jax.Array,
+                        *, axis_name) -> jax.Array:
+    """Per-device body of the ring InfoNCE (CLIP) loss.
+
+    Same ring topology as ``_ring_sigmoid_local``: local images stay put,
+    text chunks ride the ``ppermute`` ring. Softmax needs a *global*
+    normalizer in both directions, so two streaming logsumexps run at once:
+
+    - image→text: each device keeps a running (max, sumexp) over every text
+      chunk that visits its local image rows.
+    - text→image: a running (max, sumexp) *travels with the text chunk* —
+      each visited device folds in its local images' logits, so when the
+      chunk has gone all the way around, its column normalizer has seen the
+      whole global image batch. One extra ``ppermute`` at the end brings the
+      finished column stats home.
+
+    The positive logit is the diagonal of the step-0 (own-chunk) block. No
+    device ever materializes more than its local b x b logit tile.
+    """
+    n_dev = jax.lax.axis_size(axis_name)
+    b = img.shape[0]
+    img = img / jnp.linalg.norm(img, axis=-1, keepdims=True)
+    txt = txt / jnp.linalg.norm(txt, axis=-1, keepdims=True)
+    s = jnp.exp(scale)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    ring = partial(jax.lax.ppermute, axis_name=axis_name, perm=perm)
+
+    logits0 = s * img @ txt.T
+    pos = jnp.diagonal(logits0)
+    row_m = jnp.max(logits0, axis=1)
+    row_s = jnp.sum(jnp.exp(logits0 - row_m[:, None]), axis=1)
+    col_m = jnp.max(logits0, axis=0)
+    col_s = jnp.sum(jnp.exp(logits0 - col_m[None, :]), axis=0)
+
+    def fold(m, se, logits, axis):
+        """Streaming logsumexp update: fold a new logit block into (m, se)."""
+        m_new = jnp.maximum(m, jnp.max(logits, axis=axis))
+        expand = (lambda a: a[:, None]) if axis == 1 else (lambda a: a[None, :])
+        se = se * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - expand(m_new)), axis=axis)
+        return m_new, se
+
+    def step(carry, _):
+        txt_c, col_m_c, col_s_c, row_m_a, row_s_a = carry
+        txt_c, col_m_c, col_s_c = jax.tree.map(ring, (txt_c, col_m_c, col_s_c))
+        logits = s * img @ txt_c.T
+        row_m_a, row_s_a = fold(row_m_a, row_s_a, logits, axis=1)
+        col_m_c, col_s_c = fold(col_m_c, col_s_c, logits, axis=0)
+        return (txt_c, col_m_c, col_s_c, row_m_a, row_s_a), None
+
+    carry = (txt, col_m, col_s, row_m, row_s)
+    (_, col_m, col_s, row_m, row_s), _ = jax.lax.scan(
+        step, carry, jnp.arange(n_dev - 1))
+    # after n_dev-1 hops, chunk d's column stats sit on device d-1 — one
+    # final hop (texts themselves no longer needed) brings them home
+    col_m, col_s = jax.tree.map(ring, (col_m, col_s))
+    row_lse = row_m + jnp.log(row_s)
+    col_lse = col_m + jnp.log(col_s)
+    li = -jnp.sum(pos - row_lse)  # image→text CE over the global text axis
+    lt = -jnp.sum(pos - col_lse)  # text→image CE over the global image axis
+    total = jax.lax.psum(li + lt, axis_name)
+    return total / (2 * b * n_dev)
+
+
+def ring_clip_infonce_loss(img: jax.Array, txt: jax.Array,
+                           logit_scale: jax.Array, *, mesh: Mesh,
+                           axis_name: str | tuple[str, ...] = "data"
+                           ) -> jax.Array:
+    """Symmetric CLIP InfoNCE over a batch sharded on ``axis_name``, computed
+    as a ``ppermute`` ring with streaming (carried-max) logsumexps so no
+    device ever holds the global text batch or the full B x B logit matrix —
+    the softmax counterpart of ``ring_sigmoid_loss`` (the dense
+    ``clip_softmax_loss`` all-gathers the global batch, which stops scaling
+    at pod batch sizes). Numerically identical to the dense loss and
+    differentiable end-to-end; ``axis_name`` may be a tuple of mesh axes for
+    hybrid DCN x ICI meshes."""
+    fn = shard_map(
+        partial(_ring_infonce_local, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P()),
+        out_specs=P(),
+        check_vma=False)
+    return fn(img, txt, logit_scale)
 
 
 def ring_sigmoid_loss(img: jax.Array, txt: jax.Array, logit_scale: jax.Array,

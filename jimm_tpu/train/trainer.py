@@ -20,8 +20,8 @@ import jax.numpy as jnp
 import optax
 from flax import nnx
 
-from jimm_tpu.train.losses import (clip_softmax_loss, ring_sigmoid_loss,
-                                   sigmoid_pairwise_loss)
+from jimm_tpu.train.losses import (clip_softmax_loss, ring_clip_infonce_loss,
+                                   ring_sigmoid_loss, sigmoid_pairwise_loss)
 
 
 @dataclass(frozen=True)
@@ -123,6 +123,8 @@ def contrastive_loss_fn(model, images: jax.Array, text: jax.Array, *,
     """Shared loss dispatch for CLIP/SigLIP models.
 
     - ``"clip"``: symmetric softmax InfoNCE (needs ``logit_scale``).
+    - ``"clip_ring"``: ppermute-ring InfoNCE over ``axis_name`` — streaming
+      logsumexp, never materializes the global logit matrix.
     - ``"siglip"``: dense sigmoid all-pairs (oracle / single chip).
     - ``"siglip_ring"``: ppermute-ring sigmoid over ``axis_name`` —
       the north-star loss.
@@ -132,6 +134,9 @@ def contrastive_loss_fn(model, images: jax.Array, text: jax.Array, *,
     scale = model.logit_scale[...]
     if kind == "clip":
         return clip_softmax_loss(img, txt, scale)
+    if kind == "clip_ring":
+        return ring_clip_infonce_loss(img, txt, scale, mesh=mesh,
+                                      axis_name=axis_name)
     bias = model.logit_bias[...]
     if kind == "siglip":
         return sigmoid_pairwise_loss(img, txt, scale, bias)

@@ -21,7 +21,9 @@ mkdir -p "$STATE"
 exec >> "$LOG" 2>&1
 
 probe() {
-  flock "$LOCK" timeout 90 python -c "
+  # -w: a hung lock holder (tunnel-blocked interactive run) must read as
+  # "tunnel down", not block the watcher forever
+  flock -w 60 "$LOCK" timeout 90 python -c "
 import jax
 x = (jax.numpy.ones((256,256)) @ jax.numpy.ones((256,256)))
 assert float(x[0,0]) == 256.0" 2>/dev/null
@@ -79,7 +81,7 @@ run_phase() {  # run_phase <name> <timeout_s> <cmd...>; bench needs a clean rec
   echo $((tries + 1)) > "$STATE/$name.tries"
   echo "=== phase $name attempt $((tries + 1)) start $(date -u +%H:%M:%S) ==="
   local plog="$STATE/$name.log"
-  flock "$LOCK" timeout "$tmo" "$@" > "$plog" 2>&1
+  flock -w 120 "$LOCK" timeout "$tmo" "$@" > "$plog" 2>&1
   local rc=$?
   cat "$plog"
   persist "$name" "$plog" "$((tries + 1))" "$rc"
