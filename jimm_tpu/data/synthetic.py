@@ -37,15 +37,31 @@ def blob_classification(batch_size: int, *, image_size: int = 28,
 
 def contrastive_pairs(batch_size: int, *, image_size: int = 32,
                       vocab_size: int = 64, seq_len: int = 8,
-                      channels: int = 3, seed: int = 0
+                      channels: int = 3, seed: int = 0,
+                      shard_index: int = 0, shard_count: int = 1
                       ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     """Image/text pairs with shared latent structure: the text tokens encode
-    the blob quadrant, so contrastive training has signal to align on."""
+    the blob quadrant, so contrastive training has signal to align on.
+
+    ``shard_index/shard_count`` (pass ``jax.process_index()/count()``) give
+    multi-host data loading: ``batch_size`` stays the GLOBAL batch; every
+    process draws the identical global stream (same seed) and yields only
+    its contiguous row block, so the shards reassemble — e.g. via
+    ``jax.make_array_from_process_local_data`` — into exactly the batch a
+    single-process run would see."""
+    if batch_size % shard_count:
+        raise ValueError(f"batch_size={batch_size} not divisible by "
+                         f"shard_count={shard_count}")
+    if not 0 <= shard_index < shard_count:
+        raise ValueError(f"shard_index={shard_index} outside "
+                         f"[0, {shard_count})")
     rng = np.random.RandomState(seed)
     img_gen = blob_classification(batch_size, image_size=image_size,
                                   num_classes=4, channels=channels, seed=seed)
+    lo = shard_index * (batch_size // shard_count)
+    hi = lo + batch_size // shard_count
     while True:
         images, labels = next(img_gen)
         text = rng.randint(4, vocab_size, size=(batch_size, seq_len))
         text[:, 0] = labels  # class token leads the caption
-        yield images, text.astype(np.int32)
+        yield images[lo:hi], text[lo:hi].astype(np.int32)

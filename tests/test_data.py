@@ -51,3 +51,29 @@ def test_contrastive_pairs_encode_class_in_text():
     _, text = next(gen)
     assert text.shape == (8, 4)
     assert (text[:, 0] < 4).all()  # class token leads the caption
+
+
+def test_contrastive_pairs_shards_reassemble_to_global_batch():
+    """Multi-host contract: per-process shards are contiguous row blocks of
+    the identical global stream, so concatenating them in shard order gives
+    exactly the single-process batch — for several consecutive batches."""
+    kw = dict(image_size=16, vocab_size=32, seq_len=4, seed=7)
+    full = contrastive_pairs(8, **kw)
+    shards = [contrastive_pairs(8, shard_index=i, shard_count=2, **kw)
+              for i in range(2)]
+    for _ in range(3):
+        images, text = next(full)
+        parts = [next(s) for s in shards]
+        assert parts[0][0].shape == (4, 16, 16, 3)
+        np.testing.assert_array_equal(
+            np.concatenate([p[0] for p in parts]), images)
+        np.testing.assert_array_equal(
+            np.concatenate([p[1] for p in parts]), text)
+
+
+def test_contrastive_pairs_rejects_bad_sharding():
+    import pytest
+    with pytest.raises(ValueError, match="not divisible"):
+        next(contrastive_pairs(9, shard_count=2))
+    with pytest.raises(ValueError, match="outside"):
+        next(contrastive_pairs(8, shard_index=2, shard_count=2))

@@ -5,13 +5,18 @@ exercised even at 2 local processes).
 Spawns two real OS processes forming a local CPU cluster: asserts cluster
 formation, global mesh construction over non-addressable devices, a
 cross-process psum, and a process_allgather — the primitives multi-host
-training rests on (SURVEY §2.3 "collective communication backend" row).
+training rests on (SURVEY §2.3 "collective communication backend" row) —
+and then a full cross-process TRAIN STEP: FSDP+TP params laid out over
+non-addressable devices, the ring sigmoid loss crossing the process
+boundary, and per-process data loading reassembled into the global batch
+(VERDICT r3 item 4).
 """
 
 import socket
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 WORKER = r"""
@@ -54,25 +59,126 @@ print(f"WORKER_OK {pid}")
 """
 
 
-@pytest.mark.slow
-def test_two_process_cluster(tmp_path):
+def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    addr = f"127.0.0.1:{port}"
+        return s.getsockname()[1]
+
+
+def _run_two_workers(script: str, timeout: int = 600):
+    addr = f"127.0.0.1:{_free_port()}"
     procs = [subprocess.Popen(
-        [sys.executable, "-c", WORKER, addr, str(pid)],
+        [sys.executable, "-c", script, addr, str(pid)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for pid in range(2)]
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=600)
+            out, err = p.communicate(timeout=timeout)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
     for pid, (rc, out, err) in enumerate(outs):
-        assert rc == 0, f"worker {pid} rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
+        assert rc == 0, (f"worker {pid} rc={rc}\nstdout:{out}\n"
+                         f"stderr:{err[-2000:]}")
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_cluster():
+    outs = _run_two_workers(WORKER)
+    for pid, (rc, out, err) in enumerate(outs):
         assert f"WORKER_OK {pid}" in out
+
+
+# Tiny SigLIP + 2-step ring-loss training over a global (data=2, model=2)
+# mesh. Both the worker pair and the single-process oracle run THIS code —
+# only the device/process topology differs, so the printed losses must
+# match to float32 tolerance.
+TRAIN_BODY = r"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from flax import nnx
+
+from jimm_tpu import SigLIP
+from jimm_tpu.configs import SigLIPConfig, TextConfig, VisionConfig
+from jimm_tpu.data.synthetic import contrastive_pairs
+from jimm_tpu.parallel import FSDP_TP, create_sharded, use_sharding
+from jimm_tpu.train import (OptimizerConfig, make_contrastive_train_step,
+                            make_optimizer)
+
+
+def train_losses(devices, shard_index, shard_count):
+    mesh = Mesh(np.asarray(devices).reshape(2, 2), ("data", "model"))
+    cfg = SigLIPConfig(
+        vision=VisionConfig(image_size=16, patch_size=8, width=32, depth=2,
+                            num_heads=2, mlp_dim=64, act="gelu_tanh",
+                            pooling="map"),
+        text=TextConfig(vocab_size=64, context_length=8, width=32, depth=2,
+                        num_heads=2, mlp_dim=64, act="gelu_tanh",
+                        causal=False, pooling="last", proj_bias=True),
+        projection_dim=32)
+    model = create_sharded(lambda: SigLIP(cfg, rngs=nnx.Rngs(0)), mesh,
+                           FSDP_TP)
+    opt = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
+    step = make_contrastive_train_step("siglip_ring", mesh=mesh)
+    stream = contrastive_pairs(8, image_size=16, seq_len=8, seed=3,
+                               shard_index=shard_index,
+                               shard_count=shard_count)
+    batch_sharding = NamedSharding(mesh, P("data"))
+    losses = []
+    with use_sharding(mesh, FSDP_TP):
+        for _ in range(2):
+            images, text = next(stream)
+            gi = jax.make_array_from_process_local_data(batch_sharding,
+                                                        images)
+            gt = jax.make_array_from_process_local_data(batch_sharding, text)
+            losses.append(float(step(model, opt, gi, gt)["loss"]))
+    return losses
+"""
+
+TRAIN_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+addr, pid = sys.argv[1], int(sys.argv[2])
+from jimm_tpu.parallel import initialize_distributed
+initialize_distributed(coordinator_address=addr, num_processes=2,
+                       process_id=pid)
+assert jax.device_count() == 4
+
+""" + TRAIN_BODY + r"""
+losses = train_losses(jax.devices(), jax.process_index(),
+                      jax.process_count())
+print("TRAIN_LOSSES", pid, " ".join(f"{l:.6f}" for l in losses))
+"""
+
+
+@pytest.mark.slow
+def test_two_process_train_step_matches_single_process(eight_devices):
+    """FSDP+TP ring-loss training, 2 processes x 2 devices: params laid out
+    over non-addressable devices, the ring crossing the process boundary
+    (data-axis groups are {dev0,dev2}/{dev1,dev3} — one device from each
+    process), per-process `contrastive_pairs` shards reassembled with
+    `make_array_from_process_local_data`. Loss trajectory must equal the
+    single-process 4-device run of the identical code."""
+    import jax
+
+    ns = {"__name__": "train_oracle"}
+    exec(TRAIN_BODY, ns)  # the oracle runs literally the same code
+    expected = ns["train_losses"](jax.devices()[:4], 0, 1)
+    assert all(np.isfinite(l) for l in expected), expected
+
+    outs = _run_two_workers(TRAIN_WORKER)
+    for pid, (rc, out, err) in enumerate(outs):
+        line = [l for l in out.splitlines()
+                if l.startswith(f"TRAIN_LOSSES {pid}")]
+        assert line, f"worker {pid} printed no losses\nstdout:{out}"
+        got = [float(t) for t in line[0].split()[2:]]
+        np.testing.assert_allclose(got, expected, atol=1e-5)
