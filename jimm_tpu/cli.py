@@ -321,6 +321,28 @@ def cmd_train(args: argparse.Namespace) -> int:
             rt["scan_unroll"] = cfg.vision.depth
     if rt and not args.from_pretrained:
         cfg = _replace_towers(cfg, **rt)
+    def _validate_pp(cfg_obj) -> None:
+        # fail bad pipeline configs before any compile, with the exact
+        # message the shard_map trace would produce minutes in — preset
+        # path pre-model, fine-tune path right after the checkpoint load
+        if args.rules != "pp":
+            return
+        from jimm_tpu.configs import validate_pipeline
+        mesh_shape = dict(mesh.shape) if mesh is not None else {}
+        local_batch = args.batch_size // mesh_shape.get("data", 1)
+        try:
+            for tname in ("vision", "text"):
+                tower = getattr(cfg_obj, tname, None)
+                if tower is not None:
+                    validate_pipeline(tower,
+                                      n_stages=mesh_shape.get("stage", 0),
+                                      local_batch=local_batch,
+                                      tower_name=tname)
+        except ValueError as e:
+            raise SystemExit(f"pipeline config: {e}")
+
+    if not args.from_pretrained:
+        _validate_pp(cfg)
     n_classes = None
     if fam == "vit":
         n_classes = args.num_classes or (
@@ -337,14 +359,25 @@ def cmd_train(args: argparse.Namespace) -> int:
     if args.from_pretrained:
         # fine-tune: architecture from the checkpoint, execution strategy
         # from the SAME rt dict the preset path applies (built above)
-        model = _model_cls(fam).from_pretrained(
-            args.from_pretrained, mesh=mesh,
-            rules=rules if rules is not None else "replicated",
-            dtype=dtype, runtime=rt or None, image_size=args.image_size)
+        try:
+            model = _model_cls(fam).from_pretrained(
+                args.from_pretrained, mesh=mesh,
+                rules=rules if rules is not None else "replicated",
+                dtype=dtype, runtime=rt or None, image_size=args.image_size)
+        except ValueError as e:
+            # a checkpoint depth incompatible with the stage/virtual layout
+            # raises during construction (interleaved placement is baked
+            # into storage) — give it the same fast, clean exit as the
+            # parse-time checks; any OTHER load error keeps its traceback
+            if (args.rules == "pp" and "divisible" in str(e)
+                    and "stage" in str(e)):
+                raise SystemExit(f"pipeline config: {e}")
+            raise
         if fam == "vit":
             _fit_head(model, n_classes, dtype=dtype, seed=args.seed,
                       mesh=mesh, rules=rules)
         cfg = model.config
+        _validate_pp(cfg)
     else:
         model = _model_cls(fam)(cfg, rngs=nnx.Rngs(args.seed), mesh=mesh,
                                 rules=rules, dtype=dtype, param_dtype=dtype)
@@ -428,11 +461,13 @@ def cmd_train(args: argparse.Namespace) -> int:
                                        num_classes=cfg.num_classes,
                                        seed=args.seed)
     else:
+        # ring losses shard the batch over the "data" axis — on a mesh
+        # without one (e.g. model-only TP) the dense loss is the default
+        ring_ok = mesh is not None and "data" in mesh.shape
         if fam == "clip":
-            loss_kind = args.loss or ("clip_ring" if mesh is not None
-                                      else "clip")
+            loss_kind = args.loss or ("clip_ring" if ring_ok else "clip")
         else:
-            loss_kind = args.loss or ("siglip_ring" if mesh is not None
+            loss_kind = args.loss or ("siglip_ring" if ring_ok
                                       else "siglip")
         step_fn = make_contrastive_train_step(loss_kind, mesh=mesh)
         if args.data and args.loader == "grain":

@@ -243,28 +243,26 @@ class Transformer(nnx.Module):
                                                 pipeline_forward)
         from jimm_tpu.parallel.sharding import current_rules
 
+        from jimm_tpu.configs import validate_pipeline
+
         mesh = jax.sharding.get_abstract_mesh()
-        if mesh is None or "stage" not in mesh.shape:
-            raise ValueError("pipeline=True needs an ambient mesh with a "
-                             "'stage' axis (use use_sharding(mesh, PIPELINE))")
-        n_stage = dict(mesh.shape)["stage"]
+        n_stage = (dict(mesh.shape).get("stage", 0)
+                   if mesh is not None else 0)
+        # shared checks (stage axis present, depth divisibility, pp_stages
+        # match) — identical function and messages as the parse-time path
+        validate_pipeline(self.cfg, n_stages=n_stage)
         n_virtual = self.cfg.pp_virtual
-        if self.cfg.depth % (n_stage * n_virtual):
-            raise ValueError(f"depth {self.cfg.depth} not divisible by "
-                             f"{n_stage} stages x {n_virtual} virtual chunks")
         rules = current_rules()
         batch_axis = rules.batch if rules is not None else None
         if isinstance(batch_axis, str) and batch_axis not in mesh.shape:
             batch_axis = None
         graphdef, state = nnx.split(self.blocks)
         if n_virtual > 1 and self.cfg.pp_stages != n_stage:
-            if self.cfg.pp_stages:
-                raise ValueError(
-                    f"model was built for pp_stages={self.cfg.pp_stages} "
-                    f"but the mesh has {n_stage} stages")
-            # pp_stages unknown at construction: fall back to permuting per
-            # call — correct, but a cross-stage all-to-all each step; set
-            # cfg.pp_stages to bake the placement into storage instead
+            # a truthy-but-mismatched pp_stages was already rejected by
+            # validate_pipeline above; pp_stages unknown at construction:
+            # fall back to permuting per call — correct, but a cross-stage
+            # all-to-all each step; set cfg.pp_stages to bake the placement
+            # into storage instead
             order = circular_layer_order(self.cfg.depth, n_stage, n_virtual)
             state = jax.tree.map(lambda p: p[order], state)
 

@@ -90,23 +90,17 @@ def pipeline_forward(stage_apply: Callable, stage_params, x: jax.Array, *,
     - ``batch_axis``: optional mesh axis the batch dim is sharded over
       (pipeline x data parallelism).
     """
+    from jimm_tpu.configs import check_pp_schedule
+
     M, V = n_microbatches, n_virtual
-    if M < 1:
-        raise ValueError(f"n_microbatches must be >= 1, got {M}")
-    if V < 1:
-        raise ValueError(f"n_virtual must be >= 1, got {V}")
+    check_pp_schedule(M, V)
     x_spec = P(batch_axis) if batch_axis else P()
 
     def local(params_local, x_local):
         stage = jax.lax.axis_index(axis_name)
         S = jax.lax.axis_size(axis_name)
         b = x_local.shape[0]
-        if b % M:
-            raise ValueError(f"local batch {b} not divisible by "
-                             f"{M} microbatches")
-        if V > 1 and M % S:
-            raise ValueError(f"interleaved schedule needs microbatches {M} "
-                             f"divisible by {S} stages")
+        check_pp_schedule(M, V, n_stages=S, local_batch=b)
         micro = x_local.reshape(M, b // M, *x_local.shape[1:])
         # chunked params: leading dim (V * layers_per_chunk) -> (V, chunk)
         params_v = jax.tree.map(

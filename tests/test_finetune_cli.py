@@ -86,3 +86,21 @@ def test_vit_finetune_keeps_matching_head(tmp_path, capsys):
                "--log-every", "1"])
     assert rc == 0
     assert "fresh classifier head" not in capsys.readouterr().out
+
+
+def test_finetune_rejects_bad_pipeline_config_before_compile(
+        tmp_path, eight_devices):
+    """The parse-time pipeline validation must also cover the fine-tune
+    path: runtime pp flags are applied to the loaded checkpoint's config,
+    so bad values used to surface only inside the shard_map trace."""
+    import pytest
+
+    ckpt = save_tiny_siglip(tmp_path / "ckpt")  # depth-3 towers
+    with pytest.raises(SystemExit,
+                       match="not divisible by 2 stages x 2 virtual"):
+        main(["train", "--preset", "siglip-base-patch16-256",
+              "--from-pretrained", str(ckpt), "--steps", "1",
+              "--batch-size", "8", "--platform", "cpu",
+              "--host-devices", "8", "--mesh", "data=4,stage=2",
+              "--rules", "pp", "--pipeline-microbatches", "3",
+              "--pipeline-virtual", "2"])
