@@ -217,5 +217,12 @@ class CheckpointableGrainStream:
     def track(self, iterator: Iterator) -> Iterator:
         """Consumer side: pass batches through, advancing consumed_state."""
         for batch in iterator:
+            if not self._produced:
+                # a batch this stream never produced would silently mispair
+                # state i with batch i+1 from here on — fail loudly instead
+                raise RuntimeError(
+                    "track() received a batch not produced by batches(): "
+                    "the consumer iterator must be fed (possibly via "
+                    "prefetch) from this stream's batches() only")
             self.consumed_state = self._produced.popleft()
             yield batch

@@ -240,6 +240,10 @@ class SigLIP(nnx.Module):
                       num_layers=cfg.vision.depth,
                       num_layers_by_prefix={"text.": cfg.text.depth},
                       param_dtype=param_dtype, layer_order=layer_orders(cfg))
+        # remember the source flavor: a SigLIP2 (NaFlex Linear patch embed)
+        # origin changes what save_pretrained can round-trip
+        pe = weights["vision_model.embeddings.patch_embedding.weight"]
+        model._hf_source_flavor = "siglip2" if pe.ndim == 2 else "siglip"
         return model
 
     # ------------------------------------------------------------------
@@ -276,5 +280,19 @@ class SigLIP(nnx.Module):
         }
 
     def save_pretrained(self, save_dir) -> None:
+        """Export in HF SiglipModel (v1) format: Conv2d OIHW patch embed,
+        fixed-grid position table. A model loaded FROM a ``Siglip2Model``
+        checkpoint also exports as v1 (its NaFlex position table was already
+        resampled to the fixed grid at load) — transformers'
+        ``Siglip2Model`` cannot reload the exported file; ``SiglipModel``
+        can."""
+        if getattr(self, "_hf_source_flavor", None) == "siglip2":
+            import warnings
+            warnings.warn(
+                "this model was loaded from a Siglip2Model checkpoint but "
+                "exports in SiglipModel (v1) format — the NaFlex Linear "
+                "patch embed becomes Conv2d OIHW and the position table was "
+                "resampled at load. Reload the export with SiglipModel / "
+                "SigLIP.from_pretrained, not Siglip2Model.", stacklevel=2)
         from jimm_tpu.weights.export import save_pretrained
         save_pretrained(self, save_dir)

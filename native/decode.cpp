@@ -131,15 +131,19 @@ int jimm_decode_image(const uint8_t* data, int64_t n, uint8_t* out,
       JSAMPROW row = out + int64_t(cinfo.output_scanline) * w * 3;
       jpeg_read_scanlines(&cinfo, &row, 1);
     }
-    jpeg_finish_decompress(&cinfo);
     // libjpeg WARNS (rather than erroring) on recoverable oddities —
     // truncated bodies it pads, but also harmless junk like "extraneous
     // bytes before marker" that is common in real-world corpora and that
-    // PIL decodes fine. Report 1 (decoded-but-suspect) so the python
-    // wrapper re-decodes through PIL, which makes the accept/reject call.
-    bool warned = cinfo.err->num_warnings > 0;
+    // PIL decodes fine. Warnings raised during header/scanline decode mean
+    // the pixels may differ from a tolerant decoder's: report 1
+    // (decoded-but-suspect) so the python wrapper re-decodes through PIL.
+    // Warnings first raised at finish (trailing junk AFTER every scanline
+    // was produced) cannot change pixels already decoded — keep those a
+    // clean 0 and spare the double decode on dirty-but-complete files.
+    bool warned_during_scan = cinfo.err->num_warnings > 0;
+    jpeg_finish_decompress(&cinfo);
     jpeg_destroy_decompress(&cinfo);
-    return warned ? 1 : 0;
+    return warned_during_scan ? 1 : 0;
   }
   if (is_png(data, n)) {
     png_image image;

@@ -148,3 +148,15 @@ def test_consumed_state_survives_prefetch_readahead(shards):
     it_resumed = iter(loader)
     it_resumed.set_state(state)
     assert [t.tolist() for _, t in it_resumed] == want
+
+
+def test_track_rejects_foreign_batch(shards):
+    """ADVICE r3 #3: a batch the stream never produced must fail loudly,
+    not popleft an empty deque / mispair states with batches."""
+    from jimm_tpu.data.grain_pipeline import CheckpointableGrainStream
+    loader = make_grain_loader(shards, 2, task="contrastive", image_size=8,
+                               seq_len=3, seed=1, num_epochs=1)
+    stream = CheckpointableGrainStream(iter(loader))
+    foreign = [("not", "ours")]
+    with pytest.raises(RuntimeError, match="not produced by batches"):
+        next(stream.track(iter(foreign)))

@@ -176,10 +176,11 @@ def test_native_decode_truncated_body_defers_to_pil(rng):
 
 
 @needs_codecs
-def test_native_decode_warned_jpeg_falls_back_not_raises(rng):
-    """Junk before EOI triggers libjpeg's 'extraneous bytes before marker'
-    warning — common in real corpora, and PIL decodes such files fine. The
-    native path must decline (PIL fallback), not kill the data stream."""
+def test_native_decode_trailing_junk_keeps_pixels(rng):
+    """Junk before EOI trips libjpeg's 'extraneous bytes before marker'
+    warning only at finish, AFTER every scanline was produced — common in
+    real corpora. The native path keeps those pixels (ADVICE r3: no double
+    decode for dirty-but-complete files) and must match PIL exactly."""
     from PIL import Image
 
     from jimm_tpu.data.records import decode_image
@@ -191,9 +192,26 @@ def test_native_decode_warned_jpeg_falls_back_not_raises(rng):
     # NB: low-valued bytes get consumed as entropy data without complaint;
     # these trip libjpeg's "extraneous bytes before marker 0xd9" warning
     data = data[:-2] + b"junkjunk" + data[-2:]
-    assert pp.decode_image_native(data) is None
-    # the pipeline-level decode still yields the image via PIL
+    out = pp.decode_image_native(data)
+    assert out is not None, "trailing-junk-only warning must keep pixels"
+    ref = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    np.testing.assert_array_equal(out, ref)
     assert decode_image(data).shape == (16, 16, 3)
+
+
+@needs_codecs
+def test_native_decode_scan_warning_falls_back(rng):
+    """A truncated entropy stream makes libjpeg warn DURING the scanline
+    loop (it pads the missing rows) — those pixels are suspect, so the
+    native path must decline and let PIL make the accept/reject call."""
+    from PIL import Image
+
+    img = rng.randint(0, 255, size=(64, 64, 3)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG")
+    data = buf.getvalue()
+    truncated = data[:int(len(data) * 0.6)] + b"\xff\xd9"
+    assert pp.decode_image_native(truncated) is None
 
 
 @needs_codecs

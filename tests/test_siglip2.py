@@ -95,3 +95,16 @@ def test_shape_inference_without_config(ckpt, tmp_path, rng):
     assert model.config.vision.pooling == "map"
     out = model(jnp.asarray(sample_image(rng)), jnp.asarray(sample_text(rng)))
     assert out.shape == (2, 2)
+
+
+def test_save_pretrained_warns_v1_export(ckpt, tmp_path):
+    """ADVICE r3 #1: a Siglip2-origin model exports in SiglipModel v1
+    format (patch embed back to Conv2d OIHW, position table already
+    resampled) — the user must be told Siglip2Model cannot reload it."""
+    model = SigLIP.from_pretrained(ckpt)
+    assert model._hf_source_flavor == "siglip2"
+    with pytest.warns(UserWarning, match="Siglip2Model checkpoint"):
+        model.save_pretrained(tmp_path / "export")
+    # the export itself must stay valid v1 and reload cleanly
+    again = SigLIP.from_pretrained(str(tmp_path / "export"))
+    assert again._hf_source_flavor == "siglip"
