@@ -89,9 +89,13 @@ def decode_image_native(data: bytes) -> np.ndarray | None:
     (libjpeg/libpng). Returns None whenever the native path can't or
     shouldn't take it — library not built, codecs absent, an image class the
     C side doesn't handle (alpha/palette/16-bit PNG, CMYK JPEG,
-    decompression-bomb sizes), libjpeg warnings (e.g. 'extraneous bytes
-    before marker', which PIL decodes fine), or outright corrupt bodies —
-    so callers fall back to PIL, which makes the final accept/reject call.
+    decompression-bomb sizes), libjpeg warnings raised during header or
+    scanline decode (truncated/padded bodies whose pixels are suspect), or
+    outright corrupt bodies — so callers fall back to PIL, which makes the
+    final accept/reject call. Warnings first raised at finish (e.g.
+    'extraneous bytes before marker' from trailing junk, AFTER every
+    scanline was produced) keep the native pixels: they are bit-identical
+    to PIL's and skipping the re-decode is the point of the native path.
     Files PIL would also reject then raise in PIL, keeping existing
     skip-bad-record handlers working."""
     if not native_codecs_available():

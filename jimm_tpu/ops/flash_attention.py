@@ -305,16 +305,23 @@ def _causal_q_index(block_q: int, block_k: int, lse_layout: bool = False):
 _VMEM_BUDGET = 8 * 1024 * 1024
 
 
-def _pick_hb(bn: int, block_q: int, block_k: int, d: int) -> int:
-    """Heads per grid cell: the per-head (S, 64) matmuls are too small to
-    hide the ~us grid-step sequencing cost, so each cell processes `hb`
-    heads back to back (measured ~2x on ViT-shape attention on v5e)."""
-    per_head = (
+def _per_head_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
+    """Estimated resident VMEM per head in one grid cell — the model behind
+    `_pick_hb`, exposed for `scripts/vmem_probe.py` to validate against
+    Mosaic's compile-time accounting (one shared formula, no drift)."""
+    return (
         3 * block_k * d * 2            # k/v in + one of q/do
         + 2 * block_q * d * 2          # q tile + bf16 out tile
         + 2 * block_q * _LANES * 4     # m/l stats scratch
         + 2 * block_q * d * 4          # fp32 accumulators
         + block_q * block_k * 6)       # s fp32 + p bf16 intermediate
+
+
+def _pick_hb(bn: int, block_q: int, block_k: int, d: int) -> int:
+    """Heads per grid cell: the per-head (S, 64) matmuls are too small to
+    hide the ~us grid-step sequencing cost, so each cell processes `hb`
+    heads back to back (measured ~2x on ViT-shape attention on v5e)."""
+    per_head = _per_head_vmem_bytes(block_q, block_k, d)
     for hb in (8, 4, 2):
         if bn % hb == 0 and hb * per_head <= _VMEM_BUDGET:
             return hb
