@@ -30,9 +30,16 @@ from typing import Any
 
 
 def _configure_backend(args: argparse.Namespace) -> None:
+    import os
+
     import jimm_tpu.utils.env as env
     env.configure_platform(platform=getattr(args, "platform", None),
                            host_devices=getattr(args, "host_devices", None))
+    if os.environ.get("JIMM_NUM_PROCESSES"):
+        # running under `python -m jimm_tpu.launch` (or a hand-exported
+        # process group): join the cluster before any backend use
+        from jimm_tpu.parallel import initialize_distributed
+        initialize_distributed()
 
 
 def _parse_mesh(spec: str | None):

@@ -102,12 +102,29 @@ def initialize_distributed(coordinator_address: str | None = None,
     """Multi-host bootstrap. On Cloud TPU the arguments are auto-detected from
     the metadata server; pass them explicitly elsewhere. Safe to call twice.
 
+    Arguments left ``None`` fall back to the ``JIMM_COORDINATOR`` /
+    ``JIMM_NUM_PROCESSES`` / ``JIMM_PROCESS_ID`` env vars that
+    ``python -m jimm_tpu.launch`` exports into its children, so a launched
+    worker bootstraps with a bare ``initialize_distributed()`` (platform
+    overrides from ``JIMM_PLATFORM``/``JIMM_HOST_DEVICES`` are applied
+    first — they must land before the backend initializes).
+
     Errors are surfaced, not swallowed: when the caller passed explicit
     coordinator arguments a failure means a real multi-host misconfiguration,
     and degrading to single-process would train silently wrong. Only the
     argument-free auto-detect path downgrades to a warning (it legitimately
     fails on non-pod environments).
     """
+    import os
+
+    from jimm_tpu.utils.env import configure_platform
+    configure_platform()
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JIMM_COORDINATOR")
+    if num_processes is None and os.environ.get("JIMM_NUM_PROCESSES"):
+        num_processes = int(os.environ["JIMM_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JIMM_PROCESS_ID"):
+        process_id = int(os.environ["JIMM_PROCESS_ID"])
     # NB: no jax.process_count() pre-check — that call would itself
     # initialize the XLA backend, after which jax.distributed.initialize
     # hard-errors ("must be called before any JAX calls"); is_initialized()
