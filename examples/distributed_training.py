@@ -1,0 +1,89 @@
+"""Multi-process (multi-host-shaped) contrastive training.
+
+One process per host, launched with `python -m jimm_tpu.launch` (or by the
+Cloud TPU pod runtime, which starts the processes for you — then
+`initialize_distributed()` auto-detects and the rest is identical):
+
+  python -m jimm_tpu.launch --nproc 2 --platform cpu --host-devices 2 -- \
+      python examples/distributed_training.py --steps 5 --batch-size 8
+
+What the reference cannot do at all (single-process GSPMD only,
+ref `examples/vit_training.py`), demonstrated end to end:
+  - `initialize_distributed()` joins the launcher's process group;
+  - one global FSDP mesh spans every process's devices;
+  - each process loads only ITS shard of the global batch
+    (`contrastive_pairs(shard_index=...)`) and the shards are assembled
+    into one global array with `jax.make_array_from_process_local_data`;
+  - the ring sigmoid loss ppermutes text chunks across the process
+    boundary; gradients/optimizer state update under FSDP layouts that
+    include non-addressable devices.
+"""
+
+from __future__ import annotations
+
+from jimm_tpu.parallel import initialize_distributed
+
+initialize_distributed()  # env (launcher) or TPU-pod auto-detect
+
+import argparse  # noqa: E402
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+from flax import nnx  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from jimm_tpu import SigLIP  # noqa: E402
+from jimm_tpu.configs import (SigLIPConfig, TextConfig,  # noqa: E402
+                              VisionConfig)
+from jimm_tpu.data import contrastive_pairs  # noqa: E402
+from jimm_tpu.parallel import (FSDP, create_sharded, make_mesh,  # noqa: E402
+                               use_sharding)
+from jimm_tpu.train import (OptimizerConfig,  # noqa: E402
+                            make_contrastive_train_step, make_optimizer)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=16,
+                   help="GLOBAL batch (split across processes)")
+    args = p.parse_args()
+
+    rank, world = jax.process_index(), jax.process_count()
+    mesh = make_mesh({"data": -1})  # every device in the cluster
+    if rank == 0:
+        print(f"cluster: {world} processes, {jax.device_count()} devices, "
+              f"mesh {dict(mesh.shape)}")
+
+    cfg = SigLIPConfig(
+        vision=VisionConfig(image_size=16, patch_size=8, width=64, depth=2,
+                            num_heads=2, mlp_dim=128, act="gelu_tanh",
+                            pooling="map"),
+        text=TextConfig(vocab_size=64, context_length=8, width=64, depth=2,
+                        num_heads=2, mlp_dim=128, act="gelu_tanh",
+                        causal=False, pooling="last", proj_bias=True),
+        projection_dim=64)
+    # init under jit with sharding constraints: parameters are born on the
+    # global mesh, never materialized on one host
+    model = create_sharded(lambda: SigLIP(cfg, rngs=nnx.Rngs(0)), mesh, FSDP)
+    opt = make_optimizer(model, OptimizerConfig(learning_rate=3e-3))
+    step = make_contrastive_train_step("siglip_ring", mesh=mesh)
+
+    stream = contrastive_pairs(args.batch_size, image_size=16, seq_len=8,
+                               shard_index=rank, shard_count=world)
+    batch_sharding = NamedSharding(mesh, P("data"))
+    with use_sharding(mesh, FSDP):
+        for i in range(args.steps):
+            images, text = next(stream)
+            gi = jax.make_array_from_process_local_data(batch_sharding,
+                                                        images)
+            gt = jax.make_array_from_process_local_data(batch_sharding, text)
+            loss = float(step(model, opt, gi, gt)["loss"])
+            if rank == 0:
+                print(f"step {i}: loss={loss:.4f}")
+    assert np.isfinite(loss)
+    print(f"rank {rank} done, final loss {loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -35,9 +35,15 @@ def _configure_backend(args: argparse.Namespace) -> None:
     import jimm_tpu.utils.env as env
     env.configure_platform(platform=getattr(args, "platform", None),
                            host_devices=getattr(args, "host_devices", None))
-    if os.environ.get("JIMM_NUM_PROCESSES"):
-        # running under `python -m jimm_tpu.launch` (or a hand-exported
-        # process group): join the cluster before any backend use
+    # join the cluster before any backend use when (a) running under
+    # `python -m jimm_tpu.launch` (or a hand-exported process group), or
+    # (b) the environment looks like a multi-host TPU pod — skipping init
+    # there would silently train an independent copy per host. The pod
+    # path uses jax's argless auto-detect (metadata server).
+    pod_markers = ("TPU_WORKER_ID", "CLOUD_TPU_TASK_ID",
+                   "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES")
+    if (os.environ.get("JIMM_NUM_PROCESSES")
+            or any(m in os.environ for m in pod_markers)):
         from jimm_tpu.parallel import initialize_distributed
         initialize_distributed()
 

@@ -161,8 +161,9 @@ def main(argv: list[str] | None = None) -> int:
             time.sleep(0.2)
     for t in pumps:
         t.join(timeout=5)
-    if state["interrupted"]:
-        return 130  # operator stop, not a rank failure
+    if state["interrupted"] and not rc:
+        return 130  # operator stop, not a rank failure (a failure that
+        # preceded the interrupt keeps its code)
     return rc
 
 

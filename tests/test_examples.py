@@ -29,3 +29,18 @@ def test_pipelined_finetune_example():
 def test_siglip_training_example():
     proc = _run("siglip_training.py", "--steps", "3", "--batch-size", "16")
     assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_distributed_training_example():
+    """Launcher + example: 2 processes x 2 devices, ring loss across the
+    process boundary, per-process data shards."""
+    env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin", "HOME": "/tmp"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "jimm_tpu.launch", "--nproc", "2",
+         "--platform", "cpu", "--host-devices", "2", "--",
+         sys.executable, str(REPO / "examples" / "distributed_training.py"),
+         "--steps", "3", "--batch-size", "8"],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "[rank 0] step 2: loss=" in proc.stdout
+    assert "[rank 1] rank 1 done" in proc.stdout
