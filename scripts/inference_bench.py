@@ -1,0 +1,128 @@
+"""Inference throughput for the BASELINE tracked inference configs.
+
+`bench.py` (the metric of record) covers training; this measures the two
+inference rows of `BASELINE.json`'s tracked configs on one chip:
+
+  #1 ViT-B/16-224 classification  (ref `examples/vit_inference.py` flow)
+  #2 CLIP-B/32 zero-shot image+text (ref `examples/clip_inference.py` flow)
+
+Prints one JSON line per config: images/sec, ms/batch, and fwd MFU with
+the FLOP count taken from XLA's own cost analysis of the compiled forward
+(no analytic formula to drift). Random-init weights — throughput does not
+depend on values. Off-TPU it shrinks to tiny shapes and labels the metric
+"(cpu smoke)" the same way bench.py does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_forward(label: str, forward, args, batch: int, steps: int,
+                  warmup: int, peak_flops) -> dict:
+    import jax
+
+    out = forward(*args)
+    lowered = None
+    try:
+        from jimm_tpu.train.metrics import compiled_flops
+        import flax.nnx  # noqa: F401  (forward is an nnx.jit partial)
+        lowered = forward.func.lower(*forward.args, *args).compile()
+        flops = compiled_flops(lowered)
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        flops = None
+    jax.tree.map(lambda x: x.block_until_ready(), out)
+    for _ in range(max(warmup - 1, 0)):
+        out = forward(*args)
+    jax.tree.map(lambda x: x.block_until_ready(), out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = forward(*args)
+    jax.tree.map(lambda x: x.block_until_ready(), out)
+    dt = (time.perf_counter() - t0) / steps
+    rec = {
+        "metric": label,
+        "value": round(batch / dt, 2),
+        "unit": "images/sec/chip",
+        "ms_per_batch": round(dt * 1e3, 3),
+        "batch_size": batch,
+    }
+    if flops and peak_flops:
+        rec["fwd_mfu"] = round(flops / dt / peak_flops, 4)
+    return rec
+
+
+def main() -> int:
+    import jimm_tpu.utils.env
+    jimm_tpu.utils.env.configure_platform()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import CLIP, VisionTransformer, preset
+    from jimm_tpu.train.metrics import device_peak_tflops
+    from jimm_tpu.utils import jit_forward
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=0, help="0 = auto")
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=3)
+    args = p.parse_args()
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch = args.batch or (256 if on_tpu else 4)
+    suffix = "" if on_tpu else " (cpu smoke)"
+    peak = device_peak_tflops(jax.devices()[0]) * 1e12
+    rng = np.random.RandomState(0)
+
+    # BASELINE config #1: ViT-B/16-224 classification forward
+    vit_preset = ("vit-base-patch16-224" if on_tpu else "vit-tiny-patch16-224")
+    vcfg = preset(vit_preset, num_classes=1000)
+    vit = VisionTransformer(vcfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
+                            param_dtype=jnp.bfloat16)
+    images = jnp.asarray(rng.randn(batch, vcfg.vision.image_size,
+                                   vcfg.vision.image_size, 3), jnp.bfloat16)
+    print(json.dumps(bench_forward(
+        f"vit_b16_224_infer_images_per_sec{suffix}" if on_tpu
+        else f"vit_tiny_infer_images_per_sec{suffix}",
+        jit_forward(vit), (images,), batch, args.steps, args.warmup, peak)),
+        flush=True)
+
+    # BASELINE config #2: CLIP-B/32 zero-shot (image + 8 prompts per batch)
+    if on_tpu:
+        ccfg = preset("clip-vit-base-patch32")
+    else:  # tiny CLIP-shaped config: same flow, smoke-compile sized
+        from jimm_tpu.configs import CLIPConfig, TextConfig, VisionConfig
+        ccfg = CLIPConfig(
+            vision=VisionConfig(image_size=32, patch_size=16, width=64,
+                                depth=2, num_heads=2, mlp_dim=128,
+                                act="quick_gelu", ln_eps=1e-5, pooling="cls",
+                                pre_norm=True, patch_bias=False),
+            text=TextConfig(vocab_size=64, context_length=8, width=64,
+                            depth=2, num_heads=2, mlp_dim=128,
+                            act="quick_gelu", ln_eps=1e-5, causal=True,
+                            pooling="eot", proj_bias=False),
+            projection_dim=64)
+    clip = CLIP(ccfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
+                param_dtype=jnp.bfloat16)
+    cb = batch if on_tpu else 2
+    cimg = jnp.asarray(rng.randn(cb, ccfg.vision.image_size,
+                                 ccfg.vision.image_size, 3), jnp.bfloat16)
+    # CLIP text pooling reads the EOT (max-id) token: put it once per row
+    text = rng.randint(1, ccfg.text.vocab_size - 1,
+                       size=(8, ccfg.text.context_length))
+    text[:, -1] = ccfg.text.vocab_size - 1
+    ctxt = jnp.asarray(text, jnp.int32)
+    print(json.dumps(bench_forward(
+        f"clip_b32_zeroshot_images_per_sec{suffix}",
+        jit_forward(clip), (cimg, ctxt), cb, args.steps, args.warmup, peak)),
+        flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -117,6 +117,7 @@ while true; do
   run_phase crossover   900 python -m scripts.attn_crossover --causal || continue
   run_phase longctx     900 python -m scripts.longcontext_bench --bwd || continue
   run_phase longctx_c   900 python -m scripts.longcontext_bench --bwd --causal || continue
+  run_phase inference   900 python -m scripts.inference_bench || continue
   if [ -f scripts/vmem_probe.py ]; then
     run_phase vmem      600 python -m scripts.vmem_probe || continue
   fi
