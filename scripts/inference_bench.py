@@ -17,22 +17,34 @@ from __future__ import annotations
 
 import argparse
 import json
+import signal
 import time
 
 
+def _soft_alarm(seconds: int):
+    """Recoverable SIGALRM (bench.py pattern): the optional cost-analysis
+    lower+compile can HANG on the tunnel — no exception to catch — and must
+    never strand the already-measured datapoint."""
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"soft alarm after {seconds}s")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+
+    def disarm():
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+    return disarm
+
+
 def bench_forward(label: str, forward, args, batch: int, steps: int,
-                  warmup: int, peak_flops) -> dict:
+                  warmup: int) -> None:
+    """Time the forward, PRINT the throughput record immediately, then try
+    to enrich it with fwd MFU from XLA's cost analysis (a second line
+    supersedes the first — consumers take the last record per metric)."""
     import jax
 
-    out = forward(*args)
-    lowered = None
-    try:
-        from jimm_tpu.train.metrics import compiled_flops
-        import flax.nnx  # noqa: F401  (forward is an nnx.jit partial)
-        lowered = forward.func.lower(*forward.args, *args).compile()
-        flops = compiled_flops(lowered)
-    except Exception:  # noqa: BLE001 — cost analysis is best-effort
-        flops = None
+    out = forward(*args)  # compile
     jax.tree.map(lambda x: x.block_until_ready(), out)
     for _ in range(max(warmup - 1, 0)):
         out = forward(*args)
@@ -49,9 +61,25 @@ def bench_forward(label: str, forward, args, batch: int, steps: int,
         "ms_per_batch": round(dt * 1e3, 3),
         "batch_size": batch,
     }
-    if flops and peak_flops:
-        rec["fwd_mfu"] = round(flops / dt / peak_flops, 4)
-    return rec
+    print(json.dumps({**rec, "fwd_mfu": "pending"}), flush=True)
+
+    from jimm_tpu.train.metrics import compiled_flops, mfu
+    flops = None
+    disarm = _soft_alarm(120)
+    try:
+        # AOT re-compile round-trip (jit call cache does not share with it);
+        # bounded because its tunnel failure mode is a hang, not an error
+        lowered = forward.func.lower(*forward.args, *args).compile()
+        flops = compiled_flops(lowered)
+    except Exception:  # noqa: BLE001 — enrichment is best-effort
+        flops = None
+    finally:
+        disarm()
+    if flops:
+        rec["fwd_mfu"] = round(mfu(flops, dt, n_devices=1), 4)
+    else:
+        rec["fwd_mfu"] = "unavailable"
+    print(json.dumps(rec), flush=True)
 
 
 def main() -> int:
@@ -64,7 +92,6 @@ def main() -> int:
     from flax import nnx
 
     from jimm_tpu import CLIP, VisionTransformer, preset
-    from jimm_tpu.train.metrics import device_peak_tflops
     from jimm_tpu.utils import jit_forward
 
     p = argparse.ArgumentParser()
@@ -75,8 +102,6 @@ def main() -> int:
 
     on_tpu = jax.default_backend() == "tpu"
     batch = args.batch or (256 if on_tpu else 4)
-    suffix = "" if on_tpu else " (cpu smoke)"
-    peak = device_peak_tflops(jax.devices()[0]) * 1e12
     rng = np.random.RandomState(0)
 
     # BASELINE config #1: ViT-B/16-224 classification forward
@@ -86,11 +111,10 @@ def main() -> int:
                             param_dtype=jnp.bfloat16)
     images = jnp.asarray(rng.randn(batch, vcfg.vision.image_size,
                                    vcfg.vision.image_size, 3), jnp.bfloat16)
-    print(json.dumps(bench_forward(
-        f"vit_b16_224_infer_images_per_sec{suffix}" if on_tpu
-        else f"vit_tiny_infer_images_per_sec{suffix}",
-        jit_forward(vit), (images,), batch, args.steps, args.warmup, peak)),
-        flush=True)
+    bench_forward(
+        "vit_b16_224_infer_images_per_sec" if on_tpu
+        else "vit_tiny_infer_images_per_sec (cpu smoke)",
+        jit_forward(vit), (images,), batch, args.steps, args.warmup)
 
     # BASELINE config #2: CLIP-B/32 zero-shot (image + 8 prompts per batch)
     if on_tpu:
@@ -117,10 +141,10 @@ def main() -> int:
                        size=(8, ccfg.text.context_length))
     text[:, -1] = ccfg.text.vocab_size - 1
     ctxt = jnp.asarray(text, jnp.int32)
-    print(json.dumps(bench_forward(
-        f"clip_b32_zeroshot_images_per_sec{suffix}",
-        jit_forward(clip), (cimg, ctxt), cb, args.steps, args.warmup, peak)),
-        flush=True)
+    bench_forward(
+        "clip_b32_zeroshot_images_per_sec" if on_tpu
+        else "clip_tiny_zeroshot_images_per_sec (cpu smoke)",
+        jit_forward(clip), (cimg, ctxt), cb, args.steps, args.warmup)
     return 0
 
 
