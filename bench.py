@@ -255,18 +255,11 @@ def _watchdog(seconds: int, exit_code: int, what: str):
 
 
 def _soft_alarm(seconds: int):
-    """Recoverable SIGALRM: raises TimeoutError in the main thread instead
-    of exiting — for optional work that must not strand the datapoint."""
-    def on_alarm(signum, frame):
-        raise TimeoutError(f"soft alarm after {seconds}s")
-
-    old = signal.signal(signal.SIGALRM, on_alarm)
-    signal.alarm(seconds)
-
-    def disarm():
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old)
-    return disarm
+    """Recoverable SIGALRM for optional work that must not strand the
+    datapoint — shared implementation in jimm_tpu.utils.alarm (safe to
+    import here: the child only reaches this after the jimm imports)."""
+    from jimm_tpu.utils.alarm import soft_alarm
+    return soft_alarm(seconds)
 
 
 def child_main(args: argparse.Namespace, disarm_probe) -> int:

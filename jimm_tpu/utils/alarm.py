@@ -1,0 +1,29 @@
+"""Recoverable SIGALRM guard for optional work that must not strand an
+already-measured result.
+
+The axon TPU tunnel's failure mode is a HANG inside a syscall — no
+exception to catch, no Python-level timeout that fires. A soft alarm
+raises ``TimeoutError`` in the main thread so callers can bound an
+optional lower/compile round-trip (used by ``bench.py`` and
+``scripts/inference_bench.py``).
+"""
+
+from __future__ import annotations
+
+import signal
+
+
+def soft_alarm(seconds: int):
+    """Arm SIGALRM to raise ``TimeoutError`` after ``seconds``; returns a
+    ``disarm()`` that also restores the previous handler. Main thread only
+    (signal delivery requirement)."""
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"soft alarm after {seconds}s")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+
+    def disarm():
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+    return disarm

@@ -19,7 +19,8 @@ import time
 
 
 VARIANT_KEYS = frozenset(
-    {"remat", "ln", "fused_qkv", "unroll", "moment", "donate", "attn"})
+    {"remat", "ln", "fused_qkv", "unroll", "moment", "donate", "attn",
+     "batch"})
 
 
 def parse_variant(s: str) -> dict:
@@ -32,7 +33,14 @@ def parse_variant(s: str) -> dict:
             # misleading datapoint in the tool that picks bench defaults
             raise SystemExit(f"unknown variant key {k!r} in {s!r}; "
                              f"allowed: {sorted(VARIANT_KEYS)}")
-        out[k] = v.strip()
+        v = v.strip()
+        if k in ("batch", "unroll"):
+            try:
+                int(v)
+            except ValueError:
+                raise SystemExit(f"variant key {k!r} needs an integer, "
+                                 f"got {v!r} in {s!r}")
+        out[k] = v
     return out
 
 
@@ -47,6 +55,12 @@ STANDARD_GRID = [
     "remat=dots,moment=bf16",
     "remat=dots+attn,attn=saveable",
     "remat=dots+ln+act+attn,attn=saveable",
+    # batch scaling: larger per-chip batch amortizes fixed per-step cost
+    # and can lift MFU directly if HBM allows (aggressive remat frees the
+    # activation memory the bigger batch needs)
+    "remat=dots,batch=192",
+    "remat=dots,batch=256",
+    "remat=dots+ln+act,batch=256",
 ]
 
 
@@ -59,7 +73,7 @@ def main():
                    help="default scan unroll for variants that don't set it")
     p.add_argument("--variant", action="append", default=None,
                    help="comma-separated k=v list; repeatable. Keys: remat, "
-                        "attn, ln, fused_qkv, unroll, moment, donate")
+                        "attn, ln, fused_qkv, unroll, moment, donate, batch")
     p.add_argument("--tiny", action="store_true",
                    help="smoke-test the whole grid on a tiny model (CPU "
                         "validation of the sweep itself)")
@@ -100,12 +114,17 @@ def main():
         args.unroll = min(args.unroll, 2)
     else:
         base = preset("siglip-base-patch16-256")
-    images_np = rng.randn(args.batch, base.vision.image_size,
+    max_batch = max([args.batch] + [int(v["batch"]) for v in variants
+                                    if "batch" in v])
+    if args.tiny:
+        max_batch = min(max_batch, 8)
+    images_np = rng.randn(max_batch, base.vision.image_size,
                           base.vision.image_size, 3)
     text_np = rng.randint(1, base.text.vocab_size,
-                          size=(args.batch, base.text.context_length))
+                          size=(max_batch, base.text.context_length))
 
     for v in variants:
+        vb = min(int(v.get("batch", args.batch)), max_batch)
         cfg = with_runtime(
             base,
             **parse_remat(v.get("remat", "dots")),
@@ -129,8 +148,8 @@ def main():
                 learning_rate=1e-3, moment_dtype=moment))
             step_fn = make_contrastive_train_step(
                 "siglip", donate=v.get("donate", "1") in ("1", "true"))
-            images = jnp.asarray(images_np, jnp.bfloat16)
-            text = jnp.asarray(text_np, jnp.int32)
+            images = jnp.asarray(images_np[:vb], jnp.bfloat16)
+            text = jnp.asarray(text_np[:vb], jnp.int32)
 
             t_c0 = time.perf_counter()
             for _ in range(args.warmup):
@@ -150,11 +169,12 @@ def main():
             # drop this variant's buffers even on failure, so an OOM'd
             # variant doesn't double-book HBM under the next one
             del model, optimizer, step_fn, metrics
-        flops = train_step_flops(cfg, args.batch)
+        flops = train_step_flops(cfg, vb)
         print(json.dumps({
             "variant": v,
+            "batch": vb,
             "step_time_ms": round(dt * 1e3, 2),
-            "images_per_sec": round(args.batch / dt, 1),
+            "images_per_sec": round(vb / dt, 1),
             "mfu": round(mfu(flops, dt, n_devices=1), 4),
             "warmup_s": round(compile_s, 1),
         }), flush=True)

@@ -17,24 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
-import signal
 import time
-
-
-def _soft_alarm(seconds: int):
-    """Recoverable SIGALRM (bench.py pattern): the optional cost-analysis
-    lower+compile can HANG on the tunnel — no exception to catch — and must
-    never strand the already-measured datapoint."""
-    def on_alarm(signum, frame):
-        raise TimeoutError(f"soft alarm after {seconds}s")
-
-    old = signal.signal(signal.SIGALRM, on_alarm)
-    signal.alarm(seconds)
-
-    def disarm():
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old)
-    return disarm
 
 
 def bench_forward(label: str, forward, args, batch: int, steps: int,
@@ -64,8 +47,9 @@ def bench_forward(label: str, forward, args, batch: int, steps: int,
     print(json.dumps({**rec, "fwd_mfu": "pending"}), flush=True)
 
     from jimm_tpu.train.metrics import compiled_flops, mfu
+    from jimm_tpu.utils.alarm import soft_alarm
     flops = None
-    disarm = _soft_alarm(120)
+    disarm = soft_alarm(120)
     try:
         # AOT re-compile round-trip (jit call cache does not share with it);
         # bounded because its tunnel failure mode is a hang, not an error

@@ -112,7 +112,9 @@ while true; do
     continue
   fi
   echo "probe $i: TPU ALIVE $(date -u +%H:%M:%S)"
-  run_phase sweep      3000 python -m scripts.bench_sweep --steps 30 || continue
+  # 13 variants x (compile + 30 steps); partial JSON lines are persisted
+  # even on timeout, and .jax_cache makes a retry's compiles cheap
+  run_phase sweep      4500 python -m scripts.bench_sweep --steps 30 || continue
   run_phase bench       950 env BENCH_TIMEOUT_S=900 python bench.py || continue
   run_phase crossover   900 python -m scripts.attn_crossover --causal || continue
   run_phase longctx     900 python -m scripts.longcontext_bench --bwd || continue
