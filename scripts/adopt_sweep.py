@@ -1,0 +1,107 @@
+"""Pick the measured-best sweep variant and print the bench.py defaults
+to adopt (VERDICT r3 item 2: "adopt the measured-best combo as bench.py
+defaults").
+
+Reads sweep records from MEASUREMENTS.jsonl (phase "sweep", as persisted
+by scripts/tpu_measure_r4.sh) or from a bench_sweep output file passed
+with --from. Only records with a real mfu field count; error records and
+CPU-smoke runs are ignored. Prints the winner, the full ranking, and the
+exact flag spelling for bench.py / docs.
+
+    python -m scripts.adopt_sweep              # from MEASUREMENTS.jsonl
+    python -m scripts.adopt_sweep --from /tmp/sweep.log
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def load_records(path: pathlib.Path, phase_filter: bool) -> list[dict]:
+    recs = []
+    for line in path.read_text(errors="replace").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if phase_filter and rec.get("phase") != "sweep":
+            continue
+        if "variant" not in rec or not isinstance(rec.get("mfu"), float):
+            continue
+        # fidelity: a --tiny validation or CPU run must never supersede a
+        # real TPU measurement of the same variant in the ranking
+        if rec.get("tiny") or "cpu" in str(rec.get("device", "")).lower():
+            continue
+        recs.append(rec)
+    return recs
+
+
+def flags_for(variant: dict) -> str:
+    """bench.py flag spelling for a sweep variant dict."""
+    parts = []
+    if "remat" in variant:
+        parts.append(f"--remat {variant['remat']}")
+    if "attn" in variant:
+        parts.append(f"--attn {variant['attn']}")
+    if variant.get("ln") == "fused":
+        parts.append("--ln fused")
+    if variant.get("fused_qkv") in ("1", "true"):
+        parts.append("--fused-qkv")
+    if variant.get("moment") == "bf16":
+        parts.append("--moment-dtype bf16")
+    if "unroll" in variant:
+        parts.append(f"--unroll {variant['unroll']}")
+    if "batch" in variant:
+        parts.append(f"--batch-size {variant['batch']}")
+    if variant.get("donate") in ("0", "false"):
+        parts.append("--no-donate")
+    return " ".join(parts)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--from", dest="src", default=None,
+                   help="bench_sweep output file (default: repo "
+                        "MEASUREMENTS.jsonl, sweep phase)")
+    p.add_argument("--top", type=int, default=5)
+    args = p.parse_args()
+
+    path = pathlib.Path(args.src) if args.src else REPO / "MEASUREMENTS.jsonl"
+    if not path.exists():
+        print(f"no records: {path} does not exist", file=sys.stderr)
+        return 1
+    recs = load_records(path, phase_filter=args.src is None)
+    if not recs:
+        print(f"no usable sweep records (variant + float mfu) in {path}",
+              file=sys.stderr)
+        return 1
+    # last record per variant wins (later attempts supersede partials)
+    by_variant: dict[str, dict] = {}
+    for rec in recs:
+        by_variant[json.dumps(rec["variant"], sort_keys=True)] = rec
+    ranked = sorted(by_variant.values(), key=lambda r: -r["mfu"])
+
+    print(f"{len(by_variant)} variants measured; top {args.top}:")
+    for rec in ranked[:args.top]:
+        print(f"  mfu={rec['mfu']:.4f}  "
+              f"step={rec.get('step_time_ms', '?')}ms  "
+              f"img/s={rec.get('images_per_sec', '?')}  "
+              f"{json.dumps(rec['variant'])}")
+    best = ranked[0]
+    print("\nadopt as bench.py defaults / run as:")
+    print(f"  python bench.py {flags_for(best['variant'])}")
+    if isinstance(best.get("mfu"), float) and best["mfu"] >= 0.50:
+        print(f"\nNORTH STAR MET: mfu={best['mfu']:.4f} >= 0.50")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,10 +36,12 @@ def parse_variant(s: str) -> dict:
         v = v.strip()
         if k in ("batch", "unroll"):
             try:
-                int(v)
+                ok = int(v) > 0
             except ValueError:
-                raise SystemExit(f"variant key {k!r} needs an integer, "
-                                 f"got {v!r} in {s!r}")
+                ok = False
+            if not ok:
+                raise SystemExit(f"variant key {k!r} needs a positive "
+                                 f"integer, got {v!r} in {s!r}")
         out[k] = v
     return out
 
@@ -118,8 +120,12 @@ def main():
                                     if "batch" in v])
     if args.tiny:
         max_batch = min(max_batch, 8)
-    images_np = rng.randn(max_batch, base.vision.image_size,
-                          base.vision.image_size, 3)
+    # Generator API: float32 straight off (randn would transiently allocate
+    # a float64 copy — ~400 MB at the batch-256 grid entries)
+    gen = np.random.default_rng(0)
+    images_np = gen.standard_normal(
+        (max_batch, base.vision.image_size, base.vision.image_size, 3),
+        dtype=np.float32)
     text_np = rng.randint(1, base.text.vocab_size,
                           size=(max_batch, base.text.context_length))
 
@@ -177,6 +183,10 @@ def main():
             "images_per_sec": round(vb / dt, 1),
             "mfu": round(mfu(flops, dt, n_devices=1), 4),
             "warmup_s": round(compile_s, 1),
+            # fidelity markers: scripts/adopt_sweep.py must never rank a
+            # CPU/tiny validation record against a real TPU measurement
+            "device": jax.devices()[0].device_kind,
+            **({"tiny": True} if args.tiny else {}),
         }), flush=True)
 
 
