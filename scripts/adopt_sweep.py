@@ -44,6 +44,15 @@ def load_records(path: pathlib.Path, phase_filter: bool) -> list[dict]:
     return recs
 
 
+def rank_records(recs: list[dict]) -> list[dict]:
+    """Best-first ranking with last-record-per-variant-wins (later attempts
+    supersede partial earlier ones)."""
+    by_variant: dict[str, dict] = {}
+    for rec in recs:
+        by_variant[json.dumps(rec["variant"], sort_keys=True)] = rec
+    return sorted(by_variant.values(), key=lambda r: -r["mfu"])
+
+
 def flags_for(variant: dict) -> str:
     """bench.py flag spelling for a sweep variant dict."""
     parts = []
@@ -83,13 +92,9 @@ def main() -> int:
         print(f"no usable sweep records (variant + float mfu) in {path}",
               file=sys.stderr)
         return 1
-    # last record per variant wins (later attempts supersede partials)
-    by_variant: dict[str, dict] = {}
-    for rec in recs:
-        by_variant[json.dumps(rec["variant"], sort_keys=True)] = rec
-    ranked = sorted(by_variant.values(), key=lambda r: -r["mfu"])
+    ranked = rank_records(recs)
 
-    print(f"{len(by_variant)} variants measured; top {args.top}:")
+    print(f"{len(ranked)} variants measured; top {args.top}:")
     for rec in ranked[:args.top]:
         print(f"  mfu={rec['mfu']:.4f}  "
               f"step={rec.get('step_time_ms', '?')}ms  "

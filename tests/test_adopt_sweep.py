@@ -1,0 +1,84 @@
+"""scripts/adopt_sweep.py: ranking, fidelity filters, flag spelling —
+and the shared soft-alarm guard."""
+
+import json
+import time
+
+import scripts.adopt_sweep as adopt
+
+
+def _write(tmp_path, recs):
+    p = tmp_path / "sweep.log"
+    p.write_text("\n".join(json.dumps(r) for r in recs) + "\nnot json\n")
+    return p
+
+
+def test_ranking_filters_low_fidelity_records(tmp_path):
+    path = _write(tmp_path, [
+        {"variant": {"remat": "dots"}, "mfu": 0.45, "device": "TPU v5 lite"},
+        # tiny/CPU validation lines must never outrank real measurements
+        {"variant": {"remat": "dots"}, "mfu": 0.93, "device": "cpu"},
+        {"variant": {"remat": "dots", "ln": "fused"}, "mfu": 0.91,
+         "tiny": True, "device": "TPU v5 lite"},
+        {"variant": {"remat": "dots", "ln": "fused"}, "mfu": 0.47,
+         "device": "TPU v5 lite"},
+        {"variant": {"remat": "dots"}, "error": "boom"},
+    ])
+    recs = adopt.load_records(path, phase_filter=False)
+    assert all(isinstance(r["mfu"], float) for r in recs)
+    assert sorted(r["mfu"] for r in recs) == [0.45, 0.47]
+
+
+def test_last_record_per_variant_wins(tmp_path):
+    path = _write(tmp_path, [
+        {"variant": {"remat": "dots"}, "mfu": 0.40, "device": "TPU"},
+        # key order must not split the variant into two entries
+        {"variant": {"ln": "fused", "remat": "dots"}, "mfu": 0.30,
+         "device": "TPU"},
+        {"variant": {"remat": "dots", "ln": "fused"}, "mfu": 0.42,
+         "device": "TPU"},
+        {"variant": {"remat": "dots"}, "mfu": 0.46, "device": "TPU"},
+    ])
+    ranked = adopt.rank_records(adopt.load_records(path, phase_filter=False))
+    assert [r["mfu"] for r in ranked] == [0.46, 0.42]
+
+
+def test_flags_for_reproduces_measured_config():
+    v = {"remat": "dots+ln", "ln": "fused", "fused_qkv": "1",
+         "moment": "bf16", "unroll": "6", "batch": "256", "donate": "0",
+         "attn": "saveable"}
+    flags = adopt.flags_for(v)
+    for expect in ("--remat dots+ln", "--ln fused", "--fused-qkv",
+                   "--moment-dtype bf16", "--unroll 6", "--batch-size 256",
+                   "--no-donate", "--attn saveable"):
+        assert expect in flags, flags
+
+
+def test_soft_alarm_interrupts_and_restores():
+    from jimm_tpu.utils.alarm import soft_alarm
+    import signal
+
+    before = signal.getsignal(signal.SIGALRM)
+    disarm = soft_alarm(1)
+    try:
+        time.sleep(5)
+        raise AssertionError("alarm did not fire")
+    except TimeoutError:
+        pass
+    finally:
+        disarm()
+    assert signal.getsignal(signal.SIGALRM) is before
+
+    # disarm before expiry must CANCEL the pending alarm, not just restore
+    # the handler — otherwise SIGALRM would land on the restored default
+    # handler and kill the process
+    fired = []
+    old = signal.signal(signal.SIGALRM, lambda s, f: fired.append(s))
+    try:
+        disarm = soft_alarm(1)
+        disarm()
+        # disarm restored OUR recording handler; any leaked alarm -> fired
+        time.sleep(1.2)
+        assert not fired, "disarm() left the alarm pending"
+    finally:
+        signal.signal(signal.SIGALRM, old)
