@@ -1,5 +1,7 @@
-"""Benchmark of record: SigLIP-B/16-256 contrastive training throughput on
-one chip (images/sec/chip) + MFU.
+"""Benchmarks of record (BASELINE.md "Targets"): by default SigLIP-B/16-256
+contrastive training throughput on one chip (images/sec/chip) + MFU; with
+``--model vit_l16_384``, the second metric of record — ViT-L/16-384 ImageNet
+classifier train MFU (VERDICT r4 item 3).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -43,8 +45,14 @@ import time
 
 def parse_args(argv=None, validate: bool = True) -> argparse.Namespace:
     p = argparse.ArgumentParser()
+    p.add_argument("--model", default="siglip_b16_256",
+                   choices=["siglip_b16_256", "vit_l16_384"],
+                   help="benchmark config: siglip_b16_256 (metric of record "
+                        "#1, contrastive train images/sec/chip) or "
+                        "vit_l16_384 (metric of record #2, ImageNet-shape "
+                        "classifier train MFU)")
     p.add_argument("--batch-size", type=int, default=0,
-                   help="0 = auto (TPU: 128, CPU: 8)")
+                   help="0 = auto (TPU: 128 siglip / 32 vit-L, CPU: 8)")
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--remat", default="dots",
@@ -57,9 +65,12 @@ def parse_args(argv=None, validate: bool = True) -> argparse.Namespace:
                    choices=["auto", "xla", "flash", "saveable"],
                    help="attention kernel (saveable = einsum with "
                         "checkpoint-named probs, pair with --remat dots+attn)")
-    p.add_argument("--unroll", type=int, default=12,
-                   help="layer-scan unroll factor (12 = full for ViT-B: XLA "
-                        "fuses the stacked-grad updates, ~+5 MFU points)")
+    p.add_argument("--unroll", type=int, default=0,
+                   help="layer-scan unroll factor; 0 = auto: full unroll for "
+                        "the model's depth (12 ViT-B towers / 24 ViT-L — XLA "
+                        "fuses the stacked-grad updates, ~+5 MFU points, and "
+                        "full unroll enables the analytic-vs-XLA MFU "
+                        "crosscheck)")
     p.add_argument("--ln", choices=["xla", "fused"], default="xla",
                    help="LayerNorm kernel (fused = one-pass Pallas)")
     p.add_argument("--fused-qkv", action="store_true",
@@ -98,11 +109,21 @@ def parse_args(argv=None, validate: bool = True) -> argparse.Namespace:
 # Parent: watchdog + budget-aware retry + guaranteed JSON
 # ---------------------------------------------------------------------------
 
-def emit_error(msg: str, detail: str = "") -> None:
+#: (TPU metric name, unit) per --model; the CPU-smoke twin names live in
+#: child_main so a fallback record can never impersonate the real metric.
+METRICS = {
+    "siglip_b16_256": ("siglip_b16_256_train_images_per_sec_per_chip",
+                       "images/sec/chip"),
+    "vit_l16_384": ("vit_l16_384_train_mfu", "mfu"),
+}
+
+
+def emit_error(model: str, msg: str, detail: str = "") -> None:
+    metric, unit = METRICS[model]
     print(json.dumps({
-        "metric": "siglip_b16_256_train_images_per_sec_per_chip",
+        "metric": metric,
         "value": 0.0,
-        "unit": "images/sec/chip",
+        "unit": unit,
         "vs_baseline": 0.0,
         "error": msg,
         "detail": detail[-2000:],
@@ -213,14 +234,14 @@ def parent_main(args: argparse.Namespace) -> int:
     # whose line, if produced, supersedes it as the last parseable line.
     # The child's CPU branch already uses a distinct metric name; the value
     # is explicitly NOT the metric of record.
-    emit_error("benchmark did not complete (backend unreachable or hung); "
-               "see detail", last_detail)
+    emit_error(args.model, "benchmark did not complete (backend unreachable "
+               "or hung); see detail", last_detail)
     remaining = total - (time.monotonic() - start)
     if remaining >= CPU_SMOKE_RESERVE:  # smoke needs its ~90s + margins
         # minimal argv: the user's TPU-tuned flags (--batch-size 128,
         # --attn flash, ...) could crash or overrun the smoke window on the
         # CPU backend — the smoke only proves the measurement path
-        smoke_argv = ["--steps", "20", "--warmup", "1"]
+        smoke_argv = ["--model", args.model, "--steps", "20", "--warmup", "1"]
         rc, out, err = run_child(smoke_argv, int(min(240, remaining - 10)),
                                  extra_env={"JIMM_PLATFORM": "cpu"})
         line = find_json_line(out)
@@ -282,8 +303,8 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
     float(probe[0, 0])  # forces backend init + one real execute round-trip
     disarm_probe()
 
-    from jimm_tpu import SigLIP, preset
-    from jimm_tpu.configs import (SigLIPConfig, TextConfig,
+    from jimm_tpu import SigLIP, VisionTransformer, preset
+    from jimm_tpu.configs import (SigLIPConfig, TextConfig, ViTConfig,
                                   VisionConfig, with_runtime)
     from jimm_tpu.train import OptimizerConfig, make_optimizer, mfu
     from jimm_tpu.train.metrics import compiled_flops, train_step_flops
@@ -291,48 +312,86 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
     from jimm_tpu.configs import parse_remat
 
     on_tpu = jax.default_backend() == "tpu"
-    batch = args.batch_size or (128 if on_tpu else 8)
-
-    if on_tpu:
-        cfg = preset("siglip-base-patch16-256")
-        # remat: without it the scan saves every layer's activations and a
-        # big-batch training step overflows one chip's 16G HBM. Policy
-        # "dots" keeps matmul outputs and recomputes only elementwise ops —
-        # far cheaper than full recompute (VERDICT r1 weak #1).
-        cfg = with_runtime(cfg, **parse_remat(args.remat),
-                           attn_impl=args.attn, scan_unroll=args.unroll,
-                           ln_impl=args.ln, fused_qkv=args.fused_qkv)
-    else:  # smoke-test shape so the script runs anywhere; same runtime flags
-        # as the TPU branch so the reported JSON matches what actually ran
-        cfg = SigLIPConfig(
-            vision=VisionConfig(image_size=32, patch_size=16, width=64,
-                                depth=2, num_heads=2, mlp_dim=128,
-                                act="gelu_tanh", pooling="map"),
-            text=TextConfig(vocab_size=64, context_length=8, width=64, depth=2,
-                            num_heads=2, mlp_dim=128, act="gelu_tanh",
-                            causal=False, pooling="last", proj_bias=True),
-            projection_dim=64)
-        cfg = with_runtime(cfg, **parse_remat(args.remat),
-                           attn_impl=args.attn,
-                           ln_impl=args.ln, fused_qkv=args.fused_qkv,
-                           scan_unroll=min(args.unroll, 2))
-
-    model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
-                   param_dtype=jnp.bfloat16)
-    moment_dtype = "bfloat16" if args.moment_dtype == "bf16" else None
-    optimizer = make_optimizer(model, OptimizerConfig(
-        learning_rate=1e-3, moment_dtype=moment_dtype))
-
-    from jimm_tpu.train import make_contrastive_train_step
-    step_fn = make_contrastive_train_step("siglip", donate=not args.no_donate)
-
+    # auto-unroll = the model's full depth, so the MFU crosscheck (which
+    # needs a fully-unrolled scan) guards every default run of either metric
+    unroll = args.unroll or (24 if args.model == "vit_l16_384" else 12)
+    runtime = dict(**parse_remat(args.remat), attn_impl=args.attn,
+                   ln_impl=args.ln, fused_qkv=args.fused_qkv)
     rng = np.random.RandomState(0)
-    images = jnp.asarray(rng.randn(batch, cfg.vision.image_size,
-                                   cfg.vision.image_size, 3),
-                         jnp.bfloat16)
-    text = jnp.asarray(rng.randint(1, cfg.text.vocab_size,
-                                   size=(batch, cfg.text.context_length)),
-                       jnp.int32)
+
+    if args.model == "vit_l16_384":
+        # Metric of record #2 (BASELINE.md): ViT-L/16-384 ImageNet-shape
+        # classifier fine-tune step, bf16. Batch auto 32: ~1.1 TFLOP/image,
+        # activations with remat fit one chip's 16G HBM comfortably.
+        batch = args.batch_size or (32 if on_tpu else 8)
+        if on_tpu:
+            cfg = preset("vit-large-patch16-384")
+            cfg = with_runtime(cfg, **runtime, scan_unroll=unroll)
+        else:  # tiny smoke shape; same runtime flags as the TPU branch
+            cfg = ViTConfig(
+                vision=VisionConfig(image_size=32, patch_size=16, width=64,
+                                    depth=2, num_heads=2, mlp_dim=128,
+                                    ln_eps=1e-12),
+                num_classes=16)
+            cfg = with_runtime(cfg, **runtime,
+                               scan_unroll=max(min(unroll, 2), 1))
+    else:
+        batch = args.batch_size or (128 if on_tpu else 8)
+        if on_tpu:
+            cfg = preset("siglip-base-patch16-256")
+            # remat: without it the scan saves every layer's activations and
+            # a big-batch training step overflows one chip's 16G HBM. Policy
+            # "dots" keeps matmul outputs and recomputes only elementwise
+            # ops — far cheaper than full recompute (VERDICT r1 weak #1).
+            cfg = with_runtime(cfg, **runtime, scan_unroll=unroll)
+        else:  # smoke-test shape so the script runs anywhere; same runtime
+            # flags as the TPU branch so the JSON matches what actually ran
+            cfg = SigLIPConfig(
+                vision=VisionConfig(image_size=32, patch_size=16, width=64,
+                                    depth=2, num_heads=2, mlp_dim=128,
+                                    act="gelu_tanh", pooling="map"),
+                text=TextConfig(vocab_size=64, context_length=8, width=64,
+                                depth=2, num_heads=2, mlp_dim=128,
+                                act="gelu_tanh", causal=False, pooling="last",
+                                proj_bias=True),
+                projection_dim=64)
+            cfg = with_runtime(cfg, **runtime,
+                               scan_unroll=max(min(unroll, 2), 1))
+
+    moment_dtype = "bfloat16" if args.moment_dtype == "bf16" else None
+    opt_cfg = OptimizerConfig(learning_rate=1e-3, moment_dtype=moment_dtype)
+    if args.model == "vit_l16_384":
+        from jimm_tpu.train import make_classifier_train_step
+        model = VisionTransformer(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
+                                  param_dtype=jnp.bfloat16)
+        optimizer = make_optimizer(model, opt_cfg)
+        step_fn = make_classifier_train_step(donate=not args.no_donate)
+        data = (
+            jnp.asarray(rng.randn(batch, cfg.vision.image_size,
+                                  cfg.vision.image_size, 3), jnp.bfloat16),
+            jnp.asarray(rng.randint(0, cfg.num_classes, size=(batch,)),
+                        jnp.int32))
+
+        def sync_param() -> float:  # depends on the last optimizer update
+            return float(nnx.state(model, nnx.Param)
+                         ["classifier"]["kernel"].get_value()[0, 0])
+    else:
+        from jimm_tpu.train import make_contrastive_train_step
+        model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
+                       param_dtype=jnp.bfloat16)
+        optimizer = make_optimizer(model, opt_cfg)
+        step_fn = make_contrastive_train_step("siglip",
+                                              donate=not args.no_donate)
+        data = (
+            jnp.asarray(rng.randn(batch, cfg.vision.image_size,
+                                  cfg.vision.image_size, 3), jnp.bfloat16),
+            jnp.asarray(rng.randint(1, cfg.text.vocab_size,
+                                    size=(batch, cfg.text.context_length)),
+                        jnp.int32))
+
+        def sync_param() -> float:
+            return float(nnx.state(model, nnx.Param)["logit_scale"]
+                         .get_value())
 
     def sync_all() -> None:
         # host materialization, NOT block_until_ready: on remote-tunnel TPU
@@ -340,16 +399,16 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
         # actually executes; fetching a value that depends on the last
         # optimizer update cannot lie
         float(metrics["loss"])
-        float(nnx.state(model, nnx.Param)["logit_scale"].get_value())
+        sync_param()
 
     # second watchdog: the 2026-07-30 outage hung at COMPILE time, after a
     # healthy init probe — bound the first (compiling) step too
     disarm = _watchdog(args.compile_timeout, 18, "first-step compile")
-    metrics = step_fn(model, optimizer, images, text)
+    metrics = step_fn(model, optimizer, *data)
     sync_all()
     disarm()
     for _ in range(max(args.warmup - 1, 0)):
-        metrics = step_fn(model, optimizer, images, text)
+        metrics = step_fn(model, optimizer, *data)
     sync_all()
 
     # total time over a long chain of state-dependent steps, full param sync
@@ -357,7 +416,7 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
     # materialize before the optimizer update completes)
     t0 = time.perf_counter()
     for _ in range(args.steps):
-        metrics = step_fn(model, optimizer, images, text)
+        metrics = step_fn(model, optimizer, *data)
     sync_all()
     dt = (time.perf_counter() - t0) / args.steps
 
@@ -366,18 +425,30 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
     flops = train_step_flops(cfg, batch)
     achieved_mfu = mfu(flops, dt, n_devices=1)
 
+    if on_tpu:
+        metric, unit = METRICS[args.model]
+        # for vit the metric of record IS the MFU (BASELINE.md "ViT-L/16
+        # ImageNet train MFU"); throughput rides along as a field
+        value = (round(achieved_mfu, 4) if args.model == "vit_l16_384"
+                 else round(images_per_sec, 2))
+    else:
+        metric = ("vit_tiny_train_images_per_sec (cpu smoke)"
+                  if args.model == "vit_l16_384"
+                  else "siglip_tiny_train_images_per_sec (cpu smoke)")
+        value, unit = round(images_per_sec, 2), "images/sec/chip"
     result = {
-        "metric": "siglip_b16_256_train_images_per_sec_per_chip"
-                  if on_tpu else "siglip_tiny_train_images_per_sec (cpu smoke)",
-        "value": round(images_per_sec, 2),
-        "unit": "images/sec/chip",
+        "metric": metric,
+        "value": value,
+        "unit": unit,
         "vs_baseline": round(achieved_mfu / 0.50, 4),
         "mfu": round(achieved_mfu, 4),
+        "images_per_sec": round(images_per_sec, 2),
         "step_time_ms": round(dt * 1e3, 2),
         "batch_size": batch,
         "steps_timed": args.steps,
         "remat": args.remat,
         "attn": args.attn,
+        "unroll": unroll,
         "ln": args.ln,
         "fused_qkv": args.fused_qkv,
         "moment_dtype": args.moment_dtype,
@@ -402,7 +473,8 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
     # re-trace can never strand the datapoint.
     crosscheck = None
     full_unroll = (cfg.vision.scan_unroll >= cfg.vision.depth
-                   and cfg.text.scan_unroll >= cfg.text.depth)
+                   and (not hasattr(cfg, "text")
+                        or cfg.text.scan_unroll >= cfg.text.depth))
     budget_left = ((args.child_budget - (time.monotonic() - t_child0))
                    if args.child_budget else 1e9)
     if not full_unroll:
@@ -413,7 +485,7 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
         disarm_soft = _soft_alarm(min(120, int(budget_left - 20)))
         try:
             cflops = compiled_flops(
-                step_fn.lower(model, optimizer, images, text).compile())
+                step_fn.lower(model, optimizer, *data).compile())
         except Exception as e:  # noqa: BLE001 — optional check, never fatal
             cflops = None
             crosscheck = f"unavailable: {type(e).__name__}"
@@ -430,6 +502,8 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
         # number cannot be trusted, so don't report one
         del result["mfu"]
         result["vs_baseline"] = 0.0
+        if args.model == "vit_l16_384" and on_tpu:
+            result["value"] = 0.0  # only on TPU does value hold the mfu
         result["mfu_error"] = (
             f"analytic train_step_flops is {crosscheck}x XLA cost analysis "
             "(tolerance [0.5, 2.0]); mfu withheld")

@@ -83,11 +83,13 @@ def make_optimizer(model: nnx.Module, cfg: OptimizerConfig) -> nnx.Optimizer:
 # Step builders
 # ---------------------------------------------------------------------------
 
-def make_classifier_train_step() -> Callable:
+def make_classifier_train_step(*, donate: bool = False) -> Callable:
     """Cross-entropy classification step (ref `examples/vit_training.py:81-102`
-    semantics: value_and_grad over model, accuracy metric, optimizer update)."""
+    semantics: value_and_grad over model, accuracy metric, optimizer update).
+    ``donate=True`` donates model+optimizer buffers so params/m/v update in
+    place (same HBM rationale as ``make_contrastive_train_step``)."""
 
-    @nnx.jit
+    @partial(nnx.jit, donate_argnums=(0, 1) if donate else ())
     def train_step(model: nnx.Module, optimizer: nnx.Optimizer,
                    images: jax.Array, labels: jax.Array) -> dict[str, jax.Array]:
         def loss_fn(model):

@@ -1,0 +1,158 @@
+#!/bin/bash
+# Round-5 resident TPU measurement watcher (VERDICT r4 item 1).
+#
+# Runs from round start. Probes the axon tunnel every 120 s; whenever it is
+# up, runs the next not-yet-done phase of the measurement queue. Each phase
+# is marked done in $STATE so a tunnel drop mid-queue resumes at the next
+# phase in the next window. Every JSON line a tool prints is appended to
+# the committed /root/repo/MEASUREMENTS.jsonl, tagged with timestamp, phase,
+# attempt number and the attempt's exit code (so superseded partial results
+# from a timed-out attempt are distinguishable from the final ones).
+#
+# Queue order (VERDICT r4 item 1): lever sweep -> adopt into defaults ->
+# bench.py -> ViT-L/16-384 train MFU -> compiled-flash parity -> vmem probe
+# -> inference bench -> attn crossover -> long-context. New-in-r5 phases are
+# gated on their script existing so the watcher can run before they land.
+#
+# The single chip must never be shared between processes: all TPU work
+# (this watcher and any interactive run) must hold flock on $LOCK.
+set -u
+cd /root/repo
+LOG=/tmp/measure_r5.log
+LOCK=/tmp/tpu.lock
+STATE=/tmp/measure_r5_state
+MAX_TRIES=5    # per phase; a phase failing this often is broken, not unlucky
+LOCK_BUSY=200  # flock -E code: lock held elsewhere — not the phase's fault
+mkdir -p "$STATE"
+exec >> "$LOG" 2>&1
+
+probe() {
+  # -w: a hung lock holder (tunnel-blocked interactive run) must read as
+  # "tunnel down", not block the watcher forever
+  flock -w 60 "$LOCK" timeout 90 python -c "
+import jax
+x = (jax.numpy.ones((256,256)) @ jax.numpy.ones((256,256)))
+assert float(x[0,0]) == 256.0" 2>/dev/null
+}
+
+persist() {  # persist <phase> <logfile> <attempt> <rc>
+  python - "$1" "$2" "$3" "$4" <<'EOF'
+import json, sys, time
+phase, path, attempt, rc = sys.argv[1:5]
+out = open("/root/repo/MEASUREMENTS.jsonl", "a")
+for line in open(path, errors="replace"):
+    line = line.strip()
+    if not (line.startswith("{") and line.endswith("}")):
+        continue
+    try:
+        rec = json.loads(line)
+    except Exception:
+        continue
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "phase": phase, "attempt": int(attempt), "rc": int(rc), **rec}
+    out.write(json.dumps(rec) + "\n")
+out.close()
+EOF
+}
+
+bench_clean() {  # did the bench phase log produce a real TPU datapoint?
+  python - "$1" <<'EOF'
+import json, sys
+ok = False
+for line in open(sys.argv[1], errors="replace"):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        rec = json.loads(line)
+    except Exception:
+        continue
+    if ("metric" in rec and "error" not in rec
+            and rec.get("value", 0) > 0 and "cpu" not in rec["metric"]):
+        ok = True
+sys.exit(0 if ok else 1)
+EOF
+}
+
+run_phase() {  # run_phase <name> <timeout_s> <cmd...>; bench needs a clean rec
+  local name=$1 tmo=$2; shift 2
+  [ -e "$STATE/$name.done" ] || [ -e "$STATE/$name.gave_up" ] && return 0
+  local tries
+  tries=$(cat "$STATE/$name.tries" 2>/dev/null || echo 0)
+  if [ "$tries" -ge "$MAX_TRIES" ]; then
+    echo "=== phase $name gave up after $tries tries ==="
+    touch "$STATE/$name.gave_up"
+    return 0
+  fi
+  echo $((tries + 1)) > "$STATE/$name.tries"
+  echo "=== phase $name attempt $((tries + 1)) start $(date -u +%H:%M:%S) ==="
+  local plog="$STATE/$name.log"
+  flock -w 120 -E "$LOCK_BUSY" "$LOCK" timeout "$tmo" "$@" > "$plog" 2>&1
+  local rc=$?
+  if [ $rc -eq "$LOCK_BUSY" ]; then
+    # ADVICE r4: lock contention means the workload never ran — refund the
+    # attempt so contention can't walk a phase to gave_up
+    echo "$tries" > "$STATE/$name.tries"
+    echo "=== phase $name lock busy (attempt refunded) $(date -u +%H:%M:%S) ==="
+    sleep 120
+    return 1
+  fi
+  cat "$plog"
+  persist "$name" "$plog" "$((tries + 1))" "$rc"
+  local ok=$rc
+  # bench.py exits 0 on every failure path by design — require a clean
+  # TPU record before declaring the metric-of-record phases done
+  if { [ "$name" = bench ] || [ "$name" = vit_train ]; } && [ $rc -eq 0 ] \
+      && ! bench_clean "$plog"; then
+    ok=99
+  fi
+  if [ $ok -eq 0 ]; then
+    touch "$STATE/$name.done"
+    echo "=== phase $name DONE $(date -u +%H:%M:%S) ==="
+  else
+    echo "=== phase $name rc=$rc ok=$ok (retry later) $(date -u +%H:%M:%S) ==="
+    # backoff so a fast-failing phase can't hot-loop probe/rerun on 1 core
+    sleep 120
+    return 1
+  fi
+}
+
+echo "watcher r5 started $(date -u +%F' '%H:%M:%S) head=$(git rev-parse --short HEAD)"
+i=0
+while true; do
+  i=$((i+1))
+  if ! probe; then
+    echo "probe $i: tunnel down $(date -u +%H:%M:%S)"
+    sleep 120
+    continue
+  fi
+  echo "probe $i: TPU ALIVE $(date -u +%H:%M:%S)"
+  # 14 variants x (compile + 30 steps); partial JSON lines are persisted
+  # even on timeout, and .jax_cache makes a retry's compiles cheap
+  run_phase sweep      4500 python -m scripts.bench_sweep --steps 30 || continue
+  # adoption runs on CPU off the sweep records; cheap, no chip time needed,
+  # but must precede bench so bench.py measures the adopted defaults
+  if [ -e "$STATE/sweep.done" ] && [ ! -e "$STATE/adopt.done" ]; then
+    run_phase adopt     300 env JIMM_PLATFORM=cpu python -m scripts.adopt_sweep --apply || continue
+  fi
+  run_phase bench       950 env BENCH_TIMEOUT_S=900 python bench.py || continue
+  if [ -f scripts/vit_train_bench.py ]; then
+    run_phase vit_train 950 env BENCH_TIMEOUT_S=900 python -m scripts.vit_train_bench || continue
+  fi
+  if [ -f scripts/flash_compiled_check.py ]; then
+    run_phase flashchk  900 python -m scripts.flash_compiled_check || continue
+  fi
+  run_phase vmem        600 python -m scripts.vmem_probe || continue
+  run_phase inference   900 python -m scripts.inference_bench || continue
+  run_phase crossover   900 python -m scripts.attn_crossover --causal || continue
+  run_phase longctx     900 python -m scripts.longcontext_bench --bwd || continue
+  run_phase longctx_c   900 python -m scripts.longcontext_bench --bwd --causal || continue
+  if [ -f scripts/dump_goldens.py ]; then
+    # needs network egress, not the chip; a blocked attempt still leaves
+    # tests/goldens/ATTEMPTS.log evidence (VERDICT r4 item 4)
+    run_phase goldens  1800 python -m scripts.dump_goldens --all || continue
+  fi
+  echo "=== queue complete $(date -u +%H:%M:%S); idle-probing every 10 min ==="
+  touch "$STATE/queue_complete"
+  sleep 600
+done

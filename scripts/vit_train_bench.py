@@ -1,0 +1,26 @@
+"""Metric of record #2 (BASELINE.md: "ViT-L/16 ImageNet train MFU").
+
+Thin entry point the measurement watcher queues: execs ``bench.py --model
+vit_l16_384`` so the ViT-L/16-384 classifier train-MFU bench shares every
+piece of bench.py's outage hardening (probe/compile watchdogs, budget-aware
+retry, CPU-smoke fallback, analytic-vs-XLA MFU cross-check). Extra argv is
+forwarded, so e.g. ``python -m scripts.vit_train_bench --batch-size 64``
+works.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+
+BENCH = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+
+
+def main() -> None:
+    os.execv(sys.executable, [sys.executable, str(BENCH),
+                              "--model", "vit_l16_384"] + sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()
