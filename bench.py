@@ -55,13 +55,13 @@ def parse_args(argv=None, validate: bool = True) -> argparse.Namespace:
                    help="0 = auto (TPU: 128 siglip / 32 vit-L, CPU: 8)")
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--remat", default="dots",
+    p.add_argument("--remat", default=None,
                    help="activation rematerialization inside the layer scan: "
                         "none (remat off), full (remat, recompute all), or "
                         "dots with +ln/+act/+attn suffixes (save matmul "
                         "[+layernorm][+activation][+attention-prob] outputs), "
                         "e.g. dots+ln+act")
-    p.add_argument("--attn", default="auto",
+    p.add_argument("--attn", default=None,
                    choices=["auto", "xla", "flash", "saveable"],
                    help="attention kernel (saveable = einsum with "
                         "checkpoint-named probs, pair with --remat dots+attn)")
@@ -71,13 +71,13 @@ def parse_args(argv=None, validate: bool = True) -> argparse.Namespace:
                         "fuses the stacked-grad updates, ~+5 MFU points, and "
                         "full unroll enables the analytic-vs-XLA MFU "
                         "crosscheck)")
-    p.add_argument("--ln", choices=["xla", "fused"], default="xla",
+    p.add_argument("--ln", choices=["xla", "fused"], default=None,
                    help="LayerNorm kernel (fused = one-pass Pallas)")
     p.add_argument("--fused-qkv", action="store_true",
                    help="q/k/v as one (H, 3H) matmul")
     p.add_argument("--no-donate", action="store_true",
                    help="disable model/optimizer buffer donation")
-    p.add_argument("--moment-dtype", choices=["f32", "bf16"], default="f32",
+    p.add_argument("--moment-dtype", choices=["f32", "bf16"], default=None,
                    help="Adam first-moment dtype (bf16 halves that buffer's "
                         "HBM traffic)")
     p.add_argument("--timeout", type=int, default=0,
@@ -94,7 +94,7 @@ def parse_args(argv=None, validate: bool = True) -> argparse.Namespace:
     p.add_argument("--child-budget", type=int, default=0,
                    help=argparse.SUPPRESS)  # parent tells child its window
     args = p.parse_args(argv)
-    if validate:
+    if validate and args.remat is not None:
         # fail malformed --remat at parse time, not minutes later in the
         # child's first jit trace
         from jimm_tpu.configs import parse_remat
@@ -116,6 +116,72 @@ METRICS = {
                        "images/sec/chip"),
     "vit_l16_384": ("vit_l16_384_train_mfu", "mfu"),
 }
+
+
+#: bench --model -> preset key in jimm_tpu/adopted_runtime.json
+BENCH_PRESET = {"siglip_b16_256": "siglip-base-patch16-256",
+                "vit_l16_384": "vit-large-patch16-384"}
+
+
+def resolve_adopted_defaults(args: argparse.Namespace, on_tpu: bool) -> bool:
+    """Fill flags left at their parser defaults (None/0) from the adopted
+    sweep winner (`scripts/adopt_sweep.py --apply`), then apply builtin
+    fallbacks. Adopted values are used on TPU only — that is where they
+    were measured. Returns True when any adopted value was used."""
+    adopted: dict = {}
+    if on_tpu:
+        try:
+            from jimm_tpu.configs import ADOPTED_RUNTIME_PATH
+            entry = (json.loads(ADOPTED_RUNTIME_PATH.read_text())
+                     ["presets"][BENCH_PRESET[args.model]])
+            adopted = dict(entry.get("variant", {}))
+        except (OSError, KeyError, ValueError):
+            adopted = {}
+    used = False
+
+    def fill(name: str, key: str, cast=str) -> None:
+        nonlocal used
+        if getattr(args, name) in (None, 0) and key in adopted:
+            setattr(args, name, cast(adopted[key]))
+            used = True
+
+    # validate adopted values HERE, at read time: a corrupted or hand-edited
+    # adopted_runtime.json must degrade to builtin defaults with a warning,
+    # not burn the whole TPU window failing inside the child's jit trace
+    if adopted:
+        try:
+            from jimm_tpu.configs import parse_remat
+            if "remat" in adopted:
+                parse_remat(str(adopted["remat"]))
+            ok = (str(adopted.get("attn", "auto"))
+                  in ("auto", "xla", "flash", "saveable")
+                  and str(adopted.get("ln", "xla")) in ("xla", "fused")
+                  and str(adopted.get("moment", "f32")) in ("f32", "bf16")
+                  and int(adopted.get("unroll", 1)) >= 1
+                  and int(adopted.get("batch", 1)) >= 1)
+            if not ok:
+                raise ValueError(f"invalid adopted variant {adopted}")
+        except (ValueError, TypeError) as e:
+            print(f"ignoring adopted defaults: {e}", file=sys.stderr)
+            adopted = {}
+    fill("remat", "remat")
+    fill("attn", "attn")
+    fill("ln", "ln")
+    fill("moment_dtype", "moment")
+    fill("unroll", "unroll", int)
+    fill("batch_size", "batch", int)
+    # store_true flags: an absent flag can adopt, a passed flag always wins
+    if (not args.fused_qkv
+            and str(adopted.get("fused_qkv", "")).lower() in ("1", "true")):
+        args.fused_qkv, used = True, True
+    if (not args.no_donate
+            and str(adopted.get("donate", "")).lower() in ("0", "false")):
+        args.no_donate, used = True, True
+    args.remat = args.remat or "dots"
+    args.attn = args.attn or "auto"
+    args.ln = args.ln or "xla"
+    args.moment_dtype = args.moment_dtype or "f32"
+    return used
 
 
 def emit_error(model: str, msg: str, detail: str = "") -> None:
@@ -312,6 +378,7 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
     from jimm_tpu.configs import parse_remat
 
     on_tpu = jax.default_backend() == "tpu"
+    adopted_defaults = resolve_adopted_defaults(args, on_tpu)
     # auto-unroll = the model's full depth, so the MFU crosscheck (which
     # needs a fully-unrolled scan) guards every default run of either metric
     unroll = args.unroll or (24 if args.model == "vit_l16_384" else 12)
@@ -453,6 +520,7 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
         "fused_qkv": args.fused_qkv,
         "moment_dtype": args.moment_dtype,
         "donate": not args.no_donate,
+        "adopted_defaults": adopted_defaults,
         "device": jax.devices()[0].device_kind,
     }
     # Emit the measured datapoint IMMEDIATELY — the crosscheck below can

@@ -39,11 +39,24 @@ def _configure_backend(args: argparse.Namespace) -> None:
     # `python -m jimm_tpu.launch` (or a hand-exported process group), or
     # (b) the environment looks like a multi-host TPU pod — skipping init
     # there would silently train an independent copy per host. The pod
-    # path uses jax's argless auto-detect (metadata server).
-    pod_markers = ("TPU_WORKER_ID", "CLOUD_TPU_TASK_ID",
-                   "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES")
-    if (os.environ.get("JIMM_NUM_PROCESSES")
-            or any(m in os.environ for m in pod_markers)):
+    # path uses jax's argless auto-detect (metadata server), whose failure
+    # mode on a NON-pod TPU host is a hang — so markers that single-host
+    # environments also set must not trigger it (ADVICE r4):
+    # TPU_WORKER_HOSTNAMES counts only with >1 hosts (single-host VMs set it
+    # to one name), TPU_WORKER_ID alone never counts, and an explicit
+    # non-TPU --platform skips cluster join entirely.
+    if os.environ.get("JIMM_NUM_PROCESSES"):
+        # explicit opt-in (launcher or hand-exported group): always honored,
+        # on any platform — this path never touches the TPU metadata server
+        from jimm_tpu.parallel import initialize_distributed
+        initialize_distributed()
+        return
+    if getattr(args, "platform", None) not in (None, "tpu"):
+        return  # explicit non-TPU platform: never probe the TPU runtime
+    hostnames = [h for h in
+                 os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    pod_markers = ("CLOUD_TPU_TASK_ID", "MEGASCALE_COORDINATOR_ADDRESS")
+    if any(m in os.environ for m in pod_markers) or len(hostnames) > 1:
         from jimm_tpu.parallel import initialize_distributed
         initialize_distributed()
 
@@ -324,14 +337,27 @@ def cmd_train(args: argparse.Namespace) -> int:
         # count rather than silently running the unpipelined scan with
         # stage-sharded params (correct but all-gathers every layer)
         rt.update(pipeline=True, **pp_extra)
+    # fill knobs the user left unset from the measured-best adopted runtime
+    # (`scripts/adopt_sweep.py --apply`, jimm_tpu/adopted_runtime.json);
+    # explicit flags above always win, the TPU-measured choices are not
+    # imposed on other backends, and the adoption only holds for the exact
+    # architecture it was measured on — a --tiny shrink or a checkpoint of
+    # unknown shape must not inherit e.g. a flash kernel choice or an
+    # unroll that its shapes never validated
+    import jax as _jax
+    if (_jax.default_backend() == "tpu" and not args.tiny
+            and not args.from_pretrained):
+        from jimm_tpu.configs import adopted_runtime
+        for k, v in adopted_runtime(args.preset).items():
+            rt.setdefault(k, v)
     if args.scan_unroll > 1:
         rt["scan_unroll"] = args.scan_unroll
     elif args.scan_unroll == 0 and not args.from_pretrained:
         # auto: full unroll on TPU, resolved against the preset's depth
-        # (a checkpoint's depth is unknown here — explicit unrolls only)
-        import jax as _jax
+        # (a checkpoint's depth is unknown here — explicit unrolls only);
+        # an adopted, measured unroll above outranks this heuristic
         if _jax.default_backend() == "tpu":
-            rt["scan_unroll"] = cfg.vision.depth
+            rt.setdefault("scan_unroll", cfg.vision.depth)
     if rt and not args.from_pretrained:
         cfg = _replace_towers(cfg, **rt)
     def _validate_pp(cfg_obj) -> None:
@@ -342,7 +368,14 @@ def cmd_train(args: argparse.Namespace) -> int:
             return
         from jimm_tpu.configs import validate_pipeline
         mesh_shape = dict(mesh.shape) if mesh is not None else {}
-        local_batch = args.batch_size // mesh_shape.get("data", 1)
+        data_axis = mesh_shape.get("data", 1)
+        if args.batch_size % data_axis:
+            # floor division below would validate a WRONG local batch and
+            # let a config pass (or fail confusingly) that the real
+            # shard-map trace rejects minutes later (ADVICE r4)
+            raise SystemExit(f"--batch-size {args.batch_size} is not "
+                             f"divisible by the data mesh axis ({data_axis})")
+        local_batch = args.batch_size // data_axis
         try:
             for tname in ("vision", "text"):
                 tower = getattr(cfg_obj, tname, None)

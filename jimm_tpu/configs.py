@@ -12,6 +12,8 @@ Parity-critical defaults are documented per field with the reference citation.
 from __future__ import annotations
 
 import dataclasses
+import json
+import pathlib
 from dataclasses import dataclass, field
 from typing import Any, Literal
 
@@ -422,3 +424,32 @@ def preset(name: str, **overrides: Any):
     """Fetch a named preset, optionally overriding top-level fields."""
     cfg = PRESETS[name]
     return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+# ---------------------------------------------------------------------------
+# Adopted runtime: measured-best execution config per preset
+# ---------------------------------------------------------------------------
+
+#: Written by `scripts/adopt_sweep.py --apply` from real TPU sweep records
+#: (committed with provenance); consumed by the CLI train path and bench.py
+#: so presets run the measured-best execution config by default.
+ADOPTED_RUNTIME_PATH = (pathlib.Path(__file__).resolve().parent
+                        / "adopted_runtime.json")
+
+
+def adopted_runtime(preset_name: str) -> dict[str, Any]:
+    """Measured-best `with_runtime` kwargs for ``preset_name`` ({} when no
+    sweep result has been adopted). Architecture is never touched — entries
+    are validated against RUNTIME_FIELDS at load so a hand-edited file
+    cannot smuggle in shape changes."""
+    try:
+        data = json.loads(ADOPTED_RUNTIME_PATH.read_text())
+    except (OSError, json.JSONDecodeError):
+        return {}
+    fields = dict(data.get("presets", {}).get(preset_name, {})
+                  .get("runtime", {}))
+    bad = set(fields) - RUNTIME_FIELDS
+    if bad:
+        raise ValueError(f"adopted_runtime.json for {preset_name!r} has "
+                         f"non-runtime fields {sorted(bad)}")
+    return fields

@@ -1,14 +1,21 @@
-"""Pick the measured-best sweep variant and print the bench.py defaults
-to adopt (VERDICT r3 item 2: "adopt the measured-best combo as bench.py
-defaults").
+"""Pick the measured-best sweep variant and adopt it as the framework's
+default execution config (VERDICT r3 item 2 / r4 item 7).
 
 Reads sweep records from MEASUREMENTS.jsonl (phase "sweep", as persisted
-by scripts/tpu_measure_r4.sh) or from a bench_sweep output file passed
-with --from. Only records with a real mfu field count; error records and
-CPU-smoke runs are ignored. Prints the winner, the full ranking, and the
-exact flag spelling for bench.py / docs.
+by scripts/tpu_measure_r5.sh) or from a bench_sweep output file passed
+with --from. Only records with a real mfu field count; error records,
+CPU runs, --tiny validation runs, and records with no device provenance
+are ignored. Prints the winner, the full ranking, and the exact flag
+spelling for bench.py / docs.
 
-    python -m scripts.adopt_sweep              # from MEASUREMENTS.jsonl
+With ``--apply``, writes the winner into ``jimm_tpu/adopted_runtime.json``
+(with full provenance: mfu, step time, device, source commit, timestamp).
+That file is consumed by ``jimm_tpu.configs.adopted_runtime`` so
+``jimm train --preset <name>`` and ``bench.py`` run the measured-best
+execution config by default; explicit flags still win.
+
+    python -m scripts.adopt_sweep              # rank only
+    python -m scripts.adopt_sweep --apply      # rank + write adopted file
     python -m scripts.adopt_sweep --from /tmp/sweep.log
 """
 
@@ -20,6 +27,8 @@ import pathlib
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # jimm_tpu.configs import, any invocation style
+    sys.path.insert(0, str(REPO))
 
 
 def load_records(path: pathlib.Path, phase_filter: bool) -> list[dict]:
@@ -37,8 +46,11 @@ def load_records(path: pathlib.Path, phase_filter: bool) -> list[dict]:
         if "variant" not in rec or not isinstance(rec.get("mfu"), float):
             continue
         # fidelity: a --tiny validation or CPU run must never supersede a
-        # real TPU measurement of the same variant in the ranking
-        if rec.get("tiny") or "cpu" in str(rec.get("device", "")).lower():
+        # real TPU measurement of the same variant in the ranking; a record
+        # with NO device provenance (pre-r4 sweep logs) is treated as
+        # low-fidelity too (ADVICE r4) — re-measure rather than trust it
+        device = str(rec.get("device", "")).lower()
+        if rec.get("tiny") or "cpu" in device or not device:
             continue
         recs.append(rec)
     return recs
@@ -75,12 +87,74 @@ def flags_for(variant: dict) -> str:
     return " ".join(parts)
 
 
+def runtime_for(variant: dict) -> dict:
+    """Sweep variant -> `with_runtime` kwargs (execution-strategy fields
+    only; batch/moment/donate are bench-level knobs, kept in bench_flags)."""
+    from jimm_tpu.configs import parse_remat
+    rt: dict = {}
+    if "remat" in variant:
+        rt.update(parse_remat(variant["remat"]))
+    if "attn" in variant:
+        rt["attn_impl"] = variant["attn"]
+    if "ln" in variant:
+        rt["ln_impl"] = variant["ln"]
+    if "fused_qkv" in variant:
+        rt["fused_qkv"] = str(variant["fused_qkv"]).lower() in ("1", "true")
+    if "unroll" in variant:
+        rt["scan_unroll"] = int(variant["unroll"])
+    return rt
+
+
+def apply_adoption(best: dict, preset_name: str) -> pathlib.Path:
+    """Write the winner into jimm_tpu/adopted_runtime.json (merge-preserving
+    other presets' entries), with full measurement provenance."""
+    import subprocess
+    import time
+    from jimm_tpu.configs import ADOPTED_RUNTIME_PATH
+    try:
+        commit = subprocess.run(["git", "-C", str(REPO), "rev-parse",
+                                 "--short", "HEAD"], capture_output=True,
+                                text=True, timeout=10).stdout.strip()
+    except Exception:  # noqa: BLE001 — provenance only, never fatal
+        commit = "unknown"
+    data: dict = {}
+    if ADOPTED_RUNTIME_PATH.exists():
+        try:
+            data = json.loads(ADOPTED_RUNTIME_PATH.read_text())
+        except json.JSONDecodeError:
+            data = {}
+    variant = best["variant"]
+    data.setdefault("presets", {})[preset_name] = {
+        "runtime": runtime_for(variant),
+        "variant": variant,
+        "bench_flags": flags_for(variant),
+        "provenance": {
+            "mfu": best.get("mfu"),
+            "step_time_ms": best.get("step_time_ms"),
+            "images_per_sec": best.get("images_per_sec"),
+            "device": best.get("device"),
+            "measured_at": best.get("ts"),
+            "adopted_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "adopted_from_commit": commit,
+            "source": "scripts/adopt_sweep.py --apply",
+        },
+    }
+    ADOPTED_RUNTIME_PATH.write_text(json.dumps(data, indent=2,
+                                               sort_keys=True) + "\n")
+    return ADOPTED_RUNTIME_PATH
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--from", dest="src", default=None,
                    help="bench_sweep output file (default: repo "
                         "MEASUREMENTS.jsonl, sweep phase)")
     p.add_argument("--top", type=int, default=5)
+    p.add_argument("--apply", action="store_true",
+                   help="write the winner to jimm_tpu/adopted_runtime.json "
+                        "so CLI presets and bench.py default to it")
+    p.add_argument("--preset", default="siglip-base-patch16-256",
+                   help="preset the sweep measured (adoption key)")
     args = p.parse_args()
 
     path = pathlib.Path(args.src) if args.src else REPO / "MEASUREMENTS.jsonl"
@@ -103,6 +177,10 @@ def main() -> int:
     best = ranked[0]
     print("\nadopt as bench.py defaults / run as:")
     print(f"  python bench.py {flags_for(best['variant'])}")
+    if args.apply:
+        path = apply_adoption(best, args.preset)
+        print(f"adopted -> {path} (preset {args.preset}, "
+              f"mfu={best.get('mfu')})")
     if isinstance(best.get("mfu"), float) and best["mfu"] >= 0.50:
         print(f"\nNORTH STAR MET: mfu={best['mfu']:.4f} >= 0.50")
     return 0

@@ -82,3 +82,100 @@ def test_soft_alarm_interrupts_and_restores():
         assert not fired, "disarm() left the alarm pending"
     finally:
         signal.signal(signal.SIGALRM, old)
+
+
+def test_missing_device_field_is_low_fidelity(tmp_path):
+    # pre-r4 sweep logs carry no device tag; they must not outrank (or even
+    # enter) the ranking vs provenance-tagged TPU records (ADVICE r4)
+    path = _write(tmp_path, [
+        {"variant": {"remat": "dots"}, "mfu": 0.45, "device": "TPU v5 lite"},
+        {"variant": {"remat": "full"}, "mfu": 0.93},
+    ])
+    recs = adopt.load_records(path, phase_filter=False)
+    assert [r["mfu"] for r in recs] == [0.45]
+
+
+def test_runtime_for_maps_variant_to_with_runtime_kwargs():
+    rt = adopt.runtime_for({"remat": "dots+ln", "attn": "flash",
+                            "ln": "fused", "fused_qkv": "1", "unroll": "6",
+                            "moment": "bf16", "batch": "256"})
+    assert rt == {"remat": True, "remat_policy": "dots+ln",
+                  "attn_impl": "flash", "ln_impl": "fused",
+                  "fused_qkv": True, "scan_unroll": 6}
+
+
+def test_apply_adoption_round_trips_through_configs(tmp_path, monkeypatch):
+    import jimm_tpu.configs as configs
+    monkeypatch.setattr(configs, "ADOPTED_RUNTIME_PATH",
+                        tmp_path / "adopted.json")
+    monkeypatch.setattr(adopt, "apply_adoption", adopt.apply_adoption)
+    best = {"variant": {"remat": "dots+ln", "attn": "flash", "unroll": "12"},
+            "mfu": 0.47, "step_time_ms": 240.0, "device": "TPU v5 lite",
+            "ts": "2026-07-30T00:00:00Z"}
+    path = adopt.apply_adoption(best, "siglip-base-patch16-256")
+    data = json.loads(path.read_text())
+    entry = data["presets"]["siglip-base-patch16-256"]
+    assert entry["provenance"]["mfu"] == 0.47
+    assert entry["provenance"]["device"] == "TPU v5 lite"
+    assert entry["variant"]["attn"] == "flash"
+    # the configs-side loader returns exactly the runtime fields
+    assert configs.adopted_runtime("siglip-base-patch16-256") == {
+        "remat": True, "remat_policy": "dots+ln", "attn_impl": "flash",
+        "scan_unroll": 12}
+    # unknown preset -> {}
+    assert configs.adopted_runtime("vit-large-patch16-384") == {}
+    # a second adoption for another preset preserves the first entry
+    adopt.apply_adoption({"variant": {"remat": "dots"}, "mfu": 0.5,
+                          "device": "TPU v5 lite"}, "vit-large-patch16-384")
+    data = json.loads(path.read_text())
+    assert set(data["presets"]) == {"siglip-base-patch16-256",
+                                    "vit-large-patch16-384"}
+
+
+def test_adopted_runtime_rejects_architecture_fields(tmp_path, monkeypatch):
+    import pytest
+
+    import jimm_tpu.configs as configs
+    p = tmp_path / "adopted.json"
+    p.write_text(json.dumps({"presets": {"x": {"runtime": {"width": 4096}}}}))
+    monkeypatch.setattr(configs, "ADOPTED_RUNTIME_PATH", p)
+    with pytest.raises(ValueError, match="non-runtime"):
+        configs.adopted_runtime("x")
+
+
+def test_bench_resolve_adopted_defaults(tmp_path, monkeypatch):
+    import importlib.util
+    import pathlib
+
+    import jimm_tpu.configs as configs
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_adopt_test",
+        pathlib.Path(__file__).resolve().parent.parent / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    p = tmp_path / "adopted.json"
+    p.write_text(json.dumps({"presets": {"siglip-base-patch16-256": {
+        "variant": {"remat": "dots+ln", "attn": "flash", "moment": "bf16",
+                    "unroll": "12", "fused_qkv": "1"}}}}))
+    monkeypatch.setattr(configs, "ADOPTED_RUNTIME_PATH", p)
+
+    a = bench.parse_args(["--model", "siglip_b16_256"])
+    assert bench.resolve_adopted_defaults(a, on_tpu=True)
+    assert (a.remat, a.attn, a.moment_dtype, a.unroll, a.fused_qkv) == \
+        ("dots+ln", "flash", "bf16", 12, True)
+
+    # explicit flags always beat adopted values
+    a = bench.parse_args(["--remat", "dots", "--attn", "xla", "--unroll", "6"])
+    bench.resolve_adopted_defaults(a, on_tpu=True)
+    assert (a.remat, a.attn, a.unroll) == ("dots", "xla", 6)
+
+    # off-TPU: builtin fallbacks, adopted file untouched
+    a = bench.parse_args([])
+    assert not bench.resolve_adopted_defaults(a, on_tpu=False)
+    assert (a.remat, a.attn, a.ln, a.moment_dtype) == \
+        ("dots", "auto", "xla", "f32")
+
+    # no adopted entry for the model's preset -> fallbacks only
+    a = bench.parse_args(["--model", "vit_l16_384"])
+    assert not bench.resolve_adopted_defaults(a, on_tpu=True)
+    assert a.remat == "dots"
