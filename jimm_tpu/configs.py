@@ -437,19 +437,54 @@ ADOPTED_RUNTIME_PATH = (pathlib.Path(__file__).resolve().parent
                         / "adopted_runtime.json")
 
 
+def _check_runtime_fields(fields: Any) -> None:
+    """Raise on anything `with_runtime` would reject or a jit trace would
+    choke on minutes in: unknown field names, or out-of-domain values."""
+    if not isinstance(fields, dict):
+        raise TypeError(f"runtime entry must be a dict, got {type(fields)}")
+    bad = set(fields) - RUNTIME_FIELDS
+    if bad:
+        raise ValueError(f"non-runtime fields {sorted(bad)}")
+    def _int_ge(v: Any, lo: int) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v >= lo
+    for k, v in fields.items():
+        ok = True
+        if k == "attn_impl":
+            ok = v in ("auto", "xla", "flash", "ring", "saveable")
+        elif k == "ln_impl":
+            ok = v in ("xla", "fused")
+        elif k in ("fused_qkv", "remat", "pipeline"):
+            ok = isinstance(v, bool)
+        elif k == "remat_policy":
+            remat_policy_parts(str(v))  # raises on malformed spec
+            ok = isinstance(v, str)
+        elif k in ("scan_unroll", "pp_microbatches", "pp_virtual"):
+            ok = _int_ge(v, 1)
+        elif k == "pp_stages":
+            ok = _int_ge(v, 0)
+        elif k == "dropout":
+            ok = isinstance(v, (int, float)) and 0.0 <= v <= 1.0
+        if not ok:
+            raise ValueError(f"bad value for runtime field {k!r}: {v!r}")
+
+
 def adopted_runtime(preset_name: str) -> dict[str, Any]:
     """Measured-best `with_runtime` kwargs for ``preset_name`` ({} when no
-    sweep result has been adopted). Architecture is never touched — entries
-    are validated against RUNTIME_FIELDS at load so a hand-edited file
-    cannot smuggle in shape changes."""
+    sweep result has been adopted). Field names are checked against
+    RUNTIME_FIELDS and values against their domains; a file that fails
+    validation degrades to {} with a warning, so a corrupted or hand-edited
+    adopted_runtime.json can neither crash the CLI nor burn a TPU window
+    failing deep inside the first jit trace."""
     try:
         data = json.loads(ADOPTED_RUNTIME_PATH.read_text())
     except (OSError, json.JSONDecodeError):
         return {}
-    fields = dict(data.get("presets", {}).get(preset_name, {})
-                  .get("runtime", {}))
-    bad = set(fields) - RUNTIME_FIELDS
-    if bad:
-        raise ValueError(f"adopted_runtime.json for {preset_name!r} has "
-                         f"non-runtime fields {sorted(bad)}")
-    return fields
+    fields = data.get("presets", {}).get(preset_name, {}).get("runtime", {})
+    try:
+        _check_runtime_fields(fields)
+    except (TypeError, ValueError) as e:
+        import warnings
+        warnings.warn(f"ignoring adopted runtime for {preset_name!r}: {e}",
+                      stacklevel=2)
+        return {}
+    return dict(fields)

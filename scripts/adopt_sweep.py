@@ -114,7 +114,8 @@ def apply_adoption(best: dict, preset_name: str) -> pathlib.Path:
     try:
         commit = subprocess.run(["git", "-C", str(REPO), "rev-parse",
                                  "--short", "HEAD"], capture_output=True,
-                                text=True, timeout=10).stdout.strip()
+                                text=True, timeout=10
+                                ).stdout.strip() or "unknown"
     except Exception:  # noqa: BLE001 — provenance only, never fatal
         commit = "unknown"
     data: dict = {}

@@ -16,12 +16,22 @@ import numpy as np
 
 #: BASELINE.json tracked configs; atols are the reference's own bars
 #: (ref `tests/test_vit.py:52`, `test_clip.py:48`, `test_siglip.py:69`).
+#: The five reference-anchored checkpoints: every repo the reference's own
+#: parity tests load (ref `tests/test_vit.py:20-22,49-52` both ViT sizes,
+#: `tests/test_clip.py:10` CLIP-L/14, `tests/test_siglip.py:9` SigLIP-B/16)
+#: plus CLIP-B/32, BASELINE.md tracked config #2.
 GOLDEN_SPECS: dict[str, dict] = {
     "vit-base-patch16-224": {
         "repo": "google/vit-base-patch16-224", "family": "vit",
         "image_size": 224, "atol": 0.05},
+    "vit-base-patch32-384": {
+        "repo": "google/vit-base-patch32-384", "family": "vit",
+        "image_size": 384, "atol": 0.05},
     "clip-vit-base-patch32": {
         "repo": "openai/clip-vit-base-patch32", "family": "clip",
+        "image_size": 224, "ctx": 77, "atol": 1e-1},
+    "clip-vit-large-patch14": {
+        "repo": "openai/clip-vit-large-patch14", "family": "clip",
         "image_size": 224, "ctx": 77, "atol": 1e-1},
     "siglip-base-patch16-256": {
         "repo": "google/siglip-base-patch16-256", "family": "siglip",

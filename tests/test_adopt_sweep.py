@@ -108,7 +108,6 @@ def test_apply_adoption_round_trips_through_configs(tmp_path, monkeypatch):
     import jimm_tpu.configs as configs
     monkeypatch.setattr(configs, "ADOPTED_RUNTIME_PATH",
                         tmp_path / "adopted.json")
-    monkeypatch.setattr(adopt, "apply_adoption", adopt.apply_adoption)
     best = {"variant": {"remat": "dots+ln", "attn": "flash", "unroll": "12"},
             "mfu": 0.47, "step_time_ms": 240.0, "device": "TPU v5 lite",
             "ts": "2026-07-30T00:00:00Z"}
@@ -132,15 +131,28 @@ def test_apply_adoption_round_trips_through_configs(tmp_path, monkeypatch):
                                     "vit-large-patch16-384"}
 
 
-def test_adopted_runtime_rejects_architecture_fields(tmp_path, monkeypatch):
+def test_adopted_runtime_rejects_bad_fields_with_warning(tmp_path,
+                                                         monkeypatch):
+    # a corrupted file must DEGRADE (warning + {}), never crash the CLI or
+    # fail minutes into a jit trace with an invalid baked-in value
     import pytest
 
     import jimm_tpu.configs as configs
     p = tmp_path / "adopted.json"
-    p.write_text(json.dumps({"presets": {"x": {"runtime": {"width": 4096}}}}))
     monkeypatch.setattr(configs, "ADOPTED_RUNTIME_PATH", p)
-    with pytest.raises(ValueError, match="non-runtime"):
-        configs.adopted_runtime("x")
+    for runtime in ({"width": 4096},              # architecture smuggling
+                    {"attn_impl": "flsh"},        # typo'd enum value
+                    {"scan_unroll": "12"},        # string where int needed
+                    {"remat_policy": "dotz"},     # malformed remat spec
+                    ["not", "a", "dict"]):        # wrong container type
+        p.write_text(json.dumps({"presets": {"x": {"runtime": runtime}}}))
+        with pytest.warns(UserWarning, match="ignoring adopted runtime"):
+            assert configs.adopted_runtime("x") == {}
+    # valid entries still load
+    p.write_text(json.dumps({"presets": {"x": {"runtime": {
+        "attn_impl": "flash", "scan_unroll": 12, "remat": True,
+        "remat_policy": "dots+ln"}}}}))
+    assert configs.adopted_runtime("x")["attn_impl"] == "flash"
 
 
 def test_bench_resolve_adopted_defaults(tmp_path, monkeypatch):
