@@ -1,49 +1,98 @@
-"""Capture a jax.profiler trace of the SigLIP train step on TPU and print the
-top ops by self-time (via tensorboard_plugin_profile's xplane converter).
+"""Capture a jax.profiler trace of the SigLIP train step on TPU, print the
+top ops by self-time (via tensorboard_plugin_profile's xplane converter),
+and emit a JSON summary line so the measurement watcher persists the per-op
+attribution into MEASUREMENTS.jsonl (VERDICT r4 item 2: a committed profile
+at HEAD either explains the gap to the 50%-MFU bar or shows it closed).
 
-Usage: python -m scripts.profile_step [--attn xla] [--remat dots] [--top 25]
+Usage:
+    python -m scripts.profile_step [--attn xla] [--remat dots+ln] [--top 25]
+    python -m scripts.profile_step --adopted   # use the adopted sweep winner
 """
 
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import glob
 import json
+import os
+import signal
+import sys
 import time
+
+
+def _watchdog(seconds: int, what: str):
+    """SIGALRM hard-exit guard: the axon tunnel fails by hanging, and only
+    a signal interrupts a blocked runtime call. JSON error line first so
+    the watcher's persist() records the failed attempt."""
+    def on_alarm(signum, frame):
+        print(json.dumps({"metric": "profile_step", "value": 0.0,
+                          "error": f"{what} watchdog after {seconds}s "
+                                   "(tunnel hang?)"}), flush=True)
+        os._exit(17)
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    return lambda: signal.alarm(0)
+
+
+def apply_adopted(args: argparse.Namespace) -> bool:
+    """Overwrite execution flags from the adopted sweep winner
+    (jimm_tpu/adopted_runtime.json) so the profile describes the exact
+    config the bench of record runs."""
+    try:
+        from jimm_tpu.configs import ADOPTED_RUNTIME_PATH
+        v = (json.loads(ADOPTED_RUNTIME_PATH.read_text())
+             ["presets"]["siglip-base-patch16-256"]["variant"])
+    except (OSError, KeyError, ValueError):
+        print("no adopted variant recorded; using flag defaults",
+              file=sys.stderr)
+        return False
+    args.attn = str(v.get("attn", args.attn))
+    args.remat = str(v.get("remat", args.remat))
+    args.unroll = int(v.get("unroll", args.unroll))
+    args.batch = int(v.get("batch", args.batch))
+    return True
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--attn", default="xla")
-    p.add_argument("--remat", default="dots")
+    p.add_argument("--attn", default="auto")
+    p.add_argument("--remat", default="dots",
+                   help="remat spec: none, full, or dots[+ln][+act][+attn]")
     p.add_argument("--batch", type=int, default=128)
     p.add_argument("--steps", type=int, default=8)
-    p.add_argument("--unroll", type=int, default=1)
+    p.add_argument("--unroll", type=int, default=12)
     p.add_argument("--top", type=int, default=25)
     p.add_argument("--dir", default="/tmp/jimm_profile")
+    p.add_argument("--adopted", action="store_true",
+                   help="take attn/remat/unroll/batch from the adopted "
+                        "sweep winner (scripts/adopt_sweep.py --apply)")
     args = p.parse_args()
+    adopted = apply_adopted(args) if args.adopted else False
+
+    disarm = _watchdog(120, "backend probe")
+    import pathlib
 
     import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      str(pathlib.Path(__file__).resolve().parent.parent
+                          / ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     import jax.numpy as jnp
     import numpy as np
     from flax import nnx
 
+    float((jnp.ones((1024, 1024)) @ jnp.ones((1024, 1024)))[0, 0])
+    disarm()
+
     from jimm_tpu import SigLIP, preset
+    from jimm_tpu.configs import parse_remat, with_runtime
     from jimm_tpu.train import (OptimizerConfig, make_contrastive_train_step,
-                                make_optimizer)
+                                make_optimizer, mfu)
+    from jimm_tpu.train.metrics import train_step_flops
 
     cfg = preset("siglip-base-patch16-256")
-    do_remat = args.remat != "none"
-    policy = "dots" if args.remat == "dots" else "none"
-    cfg = dataclasses.replace(
-        cfg,
-        vision=dataclasses.replace(cfg.vision, remat=do_remat,
-                                   remat_policy=policy, attn_impl=args.attn,
-                                   scan_unroll=args.unroll),
-        text=dataclasses.replace(cfg.text, remat=do_remat,
-                                 remat_policy=policy, attn_impl=args.attn,
-                                 scan_unroll=args.unroll))
+    cfg = with_runtime(cfg, **parse_remat(args.remat), attn_impl=args.attn,
+                       scan_unroll=args.unroll)
     model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
                    param_dtype=jnp.bfloat16)
     optimizer = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
@@ -52,7 +101,11 @@ def main():
     images = jnp.asarray(rng.randn(args.batch, 256, 256, 3), jnp.bfloat16)
     text = jnp.asarray(rng.randint(1, cfg.text.vocab_size,
                                    size=(args.batch, 64)), jnp.int32)
-    for _ in range(3):
+    disarm = _watchdog(300, "first-step compile")
+    m = step_fn(model, optimizer, images, text)
+    float(m["loss"])
+    disarm()
+    for _ in range(2):
         m = step_fn(model, optimizer, images, text)
     float(m["loss"])
 
@@ -65,10 +118,29 @@ def main():
     jax.profiler.stop_trace()
     print(f"step time {dt*1e3:.1f} ms ({args.batch/dt:.0f} img/s)")
 
-    analyze(args.dir, args.top)
+    summary = {
+        "metric": "profile_step",
+        "value": round(args.batch / dt, 2),
+        "unit": "images/sec/chip",
+        "step_time_ms": round(dt * 1e3, 2),
+        "mfu": round(mfu(train_step_flops(cfg, args.batch), dt,
+                         n_devices=1), 4),
+        "batch_size": args.batch,
+        "remat": args.remat, "attn": args.attn, "unroll": args.unroll,
+        "adopted": adopted,
+        "device": jax.devices()[0].device_kind,
+    }
+    # the trace-analysis import below can be slow/fragile; the timing line
+    # must survive regardless, and the enriched line supersedes it
+    print(json.dumps(summary), flush=True)
+    try:
+        summary["top_ops"] = analyze(args.dir, args.top)
+        print(json.dumps(summary), flush=True)
+    except Exception as e:  # noqa: BLE001 — attribution is best-effort
+        print(f"trace analysis failed: {e!r}", file=sys.stderr)
 
 
-def analyze(log_dir: str, top: int) -> None:
+def analyze(log_dir: str, top: int) -> list[dict]:
     from tensorboard_plugin_profile.convert import raw_to_tool_data
 
     xplanes = sorted(glob.glob(
@@ -91,11 +163,18 @@ def analyze(log_dir: str, top: int) -> None:
     total = sum(float(r[i_self]) for r in rows)
     print(f"\ntotal device self time: {total/1e3:.1f} ms; top {top} ops:")
     print(f"{'%':>6s} {'ms':>9s} {'n':>5s}  {'type':22s} name")
+    out = []
     for r in rows[:top]:
         pct = 100 * float(r[i_self]) / total
         print(f"{pct:6.2f} {float(r[i_self])/1e3:9.2f} {int(r[i_occ]):5d}  "
               f"{str(r[i_type])[:22]:22s} {str(r[i_name])[:90]}")
+        out.append({"pct": round(pct, 2),
+                    "ms": round(float(r[i_self]) / 1e3, 2),
+                    "n": int(r[i_occ]),
+                    "type": str(r[i_type])[:40],
+                    "name": str(r[i_name])[:90]})
+    return out[:10]  # JSON line stays small; full table is printed above
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

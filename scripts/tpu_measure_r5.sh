@@ -149,6 +149,9 @@ while true; do
   if [ -f scripts/flash_compiled_check.py ]; then
     run_phase flashchk  900 python -m scripts.flash_compiled_check || continue
   fi
+  # per-op attribution at HEAD, at the adopted (measured-best) config —
+  # the committed evidence for "50% reached or the gap is explained"
+  run_phase profile     900 python -m scripts.profile_step --adopted || continue
   run_phase vmem        600 python -m scripts.vmem_probe || continue
   run_phase inference   900 python -m scripts.inference_bench || continue
   run_phase crossover   900 python -m scripts.attn_crossover --causal || continue
