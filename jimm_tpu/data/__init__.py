@@ -5,6 +5,8 @@ from jimm_tpu.data.preprocess import (CLIP_MEAN, CLIP_STD, IMAGENET_MEAN,
                                       preprocess_batch, resize_bilinear,
                                       to_float_normalized)
 from jimm_tpu.data.clip_tokenizer import CLIPTokenizer
+from jimm_tpu.data.naflex import (image_to_patches, patchify_naflex,
+                                  target_size_for_max_patches)
 from jimm_tpu.data.grain_pipeline import (TFRecordDataSource,
                                           grain_batches, make_grain_loader)
 from jimm_tpu.data.records import (classification_batches, decode_image,
@@ -22,6 +24,7 @@ from jimm_tpu.data.tfrecord import (TFRecordWriter, crc32c, decode_example,
 
 __all__ = [
     "PrefetchIterator", "blob_classification", "contrastive_pairs",
+    "patchify_naflex", "image_to_patches", "target_size_for_max_patches",
     "preprocess_batch", "to_float_normalized", "resize_bilinear",
     "center_crop", "native_available", "IMAGENET_MEAN", "IMAGENET_STD",
     "CLIP_MEAN", "CLIP_STD", "SIGLIP_MEAN", "SIGLIP_STD",

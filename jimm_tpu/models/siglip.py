@@ -68,6 +68,33 @@ class SigLIP(nnx.Module):
         image feature — no separate visual projection (ref siglip.py:140-149)."""
         return self.vision(images)
 
+    def encode_image_naflex(self, patches: jax.Array,
+                            spatial_shapes: jax.Array,
+                            mask: jax.Array) -> jax.Array:
+        """NaFlex variable-resolution image encoding — BEYOND the reference,
+        whose SigLIP2 support stops at "any non-NaFlex variant"
+        (ref `README.md:13-14`). Takes HF-processor-style inputs: flattened
+        ``(B, S, p*p*C)`` patches, per-sample ``(B, 2)`` (h, w) grids, and a
+        ``(B, S)`` padding mask (see `jimm_tpu.data.naflex.patchify_naflex`
+        to produce them from raw images). Parity vs the HF ``Siglip2Model``
+        NaFlex oracle is tested in `tests/test_naflex.py`."""
+        return self.vision.forward_naflex(patches, spatial_shapes, mask)
+
+    def logits_naflex(self, patches: jax.Array, spatial_shapes: jax.Array,
+                      mask: jax.Array, text: jax.Array) -> jax.Array:
+        """``__call__`` semantics over NaFlex image inputs."""
+        return self._logits(
+            self.encode_image_naflex(patches, spatial_shapes, mask),
+            self.encode_text(text))
+
+    def _logits(self, img: jax.Array, txt: jax.Array) -> jax.Array:
+        """Shared logit head: L2-normalize, scale, bias
+        (ref `siglip.py:161-170`)."""
+        img = img / jnp.linalg.norm(img, axis=-1, keepdims=True)
+        txt = txt / jnp.linalg.norm(txt, axis=-1, keepdims=True)
+        scale = jnp.exp(self.logit_scale[...])
+        return scale * img @ txt.T + self.logit_bias[...]  # logits_per_image
+
     def encode_text(self, text: jax.Array) -> jax.Array:
         """(B, S) -> unnormalized (B, projection_dim); pools the LAST position
         (requires max-length padding) then biased projection
@@ -76,12 +103,8 @@ class SigLIP(nnx.Module):
         return self.text_projection(self.text.pool(hidden, text))
 
     def __call__(self, images: jax.Array, text: jax.Array) -> jax.Array:
-        img = self.encode_image(images)
-        txt = self.encode_text(text)
-        img = img / jnp.linalg.norm(img, axis=-1, keepdims=True)
-        txt = txt / jnp.linalg.norm(txt, axis=-1, keepdims=True)
-        scale = jnp.exp(self.logit_scale[...])
-        return scale * img @ txt.T + self.logit_bias[...]  # logits_per_image
+        return self._logits(self.encode_image(images),
+                            self.encode_text(text))
 
     # ------------------------------------------------------------------
     # Checkpoint loading
@@ -222,6 +245,7 @@ class SigLIP(nnx.Module):
         from jimm_tpu.weights.surgery import (apply_image_size,
                                               resize_checkpoint_pos_embed)
         pos_key = "vision_model.embeddings.position_embedding.weight"
+        orig_pos_n = weights[pos_key].shape[0]
         weights, cfg = apply_image_size(
             weights, cfg, image_size,
             key=pos_key, n_prefix=0)  # MAP pooling: pure grid, no class token
@@ -244,6 +268,12 @@ class SigLIP(nnx.Module):
         # origin changes what save_pretrained can round-trip
         pe = weights["vision_model.embeddings.patch_embedding.weight"]
         model._hf_source_flavor = "siglip2" if pe.ndim == 2 else "siglip"
+        # the NaFlex path resamples the position table per sample FROM the
+        # stored table; if load-time surgery already interpolated it away
+        # from the checkpoint's native grid, a second resample would diverge
+        # from the HF oracle — forward_naflex refuses in that case
+        model.vision._pos_table_resampled = (
+            weights[pos_key].shape[0] != orig_pos_n)
         return model
 
     # ------------------------------------------------------------------

@@ -1,0 +1,79 @@
+"""NaFlex (native-flexible-resolution) vision input support for SigLIP2.
+
+The reference supports "SigLIP v1 and v2, any non-NaFlex variant"
+(ref `README.md:13-14`) — NaFlex is its stated limitation. This module goes
+beyond that: variable-aspect, variable-resolution batches processed the way
+HF's ``Siglip2Model`` NaFlex path does (pre-patchified inputs + per-sample
+spatial shapes + padding mask), but designed for XLA: everything is
+shape-static, the per-sample bilinear position-embedding resize is expressed
+as one einsum over interpolation-weight matrices instead of a Python loop of
+dynamic-shape ``F.interpolate`` calls (HF
+`modeling_siglip2.py` ``Siglip2VisionEmbeddings.resize_positional_embeddings``
+loops over the batch on the host — untraceable and TPU-hostile).
+
+Semantics matched exactly (oracle-tested in `tests/test_naflex.py`):
+``torch.nn.functional.interpolate(mode="bilinear", align_corners=False,
+antialias=True)`` — the triangle filter with support scaled by the
+downsampling factor, evaluated per axis; for upscaling it degenerates to
+standard edge-clamped bilinear.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _axis_weights(idx: jax.Array, n_out: jax.Array, n_in: int) -> jax.Array:
+    """Antialiased-bilinear interpolation weights for sampling a length
+    ``n_in`` (static) source axis at output indices ``idx`` of a length
+    ``n_out`` (dynamic, per sample) target axis.
+
+    For each output index i: source center ``src = (i + 0.5) * s - 0.5``
+    with ``s = n_in / n_out``; triangle filter of half-width
+    ``max(1, s)`` (antialias widens the kernel only when downsampling),
+    normalized over the in-range taps — which also reproduces torch's
+    edge-clamping for plain bilinear upsampling.
+    """
+    scale = n_in / n_out.astype(jnp.float32)
+    src = (idx.astype(jnp.float32) + 0.5) * scale - 0.5
+    support = jnp.maximum(scale, 1.0)
+    taps = jnp.arange(n_in, dtype=jnp.float32)
+    w = jnp.maximum(0.0, 1.0 - jnp.abs(taps[None, :] - src[:, None]) / support)
+    # out-of-grid rows (padded tokens whose row/col lies past the sample's
+    # h*w) can have an all-zero tap window; the epsilon turns the 0/0 into
+    # an all-zero weight row (finite!) instead of NaN, which would otherwise
+    # poison masked attention through 0 * NaN
+    return w / jnp.maximum(w.sum(axis=-1, keepdims=True), 1e-9)
+
+
+def naflex_position_embedding(table: jax.Array, spatial_shapes: jax.Array,
+                              seq_len: int) -> jax.Array:
+    """Sample a ``(H0, W0, D)`` learned position table at every token of
+    every sample's ``(h, w)`` grid: token ``t`` of sample ``b`` lives at
+    row ``t // w_b``, col ``t % w_b`` and gets the antialiased-bilinear
+    resample of the table at that position — equivalent to resizing the
+    table to ``(h_b, w_b)`` and flattening, with no dynamic shapes.
+
+    Args:
+        table: ``(H0, W0, D)`` position-embedding grid (static shape).
+        spatial_shapes: ``(B, 2)`` int32 per-sample (height, width) in
+            patches; ``h * w <= seq_len`` for real tokens.
+        seq_len: static padded token count of the batch.
+
+    Returns:
+        ``(B, seq_len, D)``; rows past ``h * w`` are zero (they are padding
+        and must be masked out of attention anyway).
+    """
+    h0, w0, _ = table.shape
+    t = jnp.arange(seq_len)
+
+    def per_sample(shape: jax.Array) -> jax.Array:
+        h, w = shape[0], shape[1]
+        row = t // jnp.maximum(w, 1)
+        col = t % jnp.maximum(w, 1)
+        wr = _axis_weights(row, h, h0)              # (S, H0)
+        wc = _axis_weights(col, w, w0)              # (S, W0)
+        return jnp.einsum("sj,jkd,sk->sd", wr, table.astype(jnp.float32), wc)
+
+    return jax.vmap(per_sample)(spatial_shapes)

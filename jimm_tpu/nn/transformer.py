@@ -150,11 +150,13 @@ class Block(nnx.Module):
                        param_dtype=param_dtype)
         self.dropout = nnx.Dropout(cfg.dropout, rngs=rngs)
 
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array,
+                 mask: jax.Array | None = None) -> jax.Array:
         # ln outputs carry a checkpoint name so "+ln" remat policies can keep
         # them (skipping the LN recompute in the backward); plain identity
         # under every other policy
-        x = x + self.dropout(self.attn(checkpoint_name(self.ln1(x), "ln_out")))
+        x = x + self.dropout(self.attn(checkpoint_name(self.ln1(x), "ln_out"),
+                                       mask=mask))
         x = x + self.dropout(self.mlp(checkpoint_name(self.ln2(x), "ln_out")))
         return logical_constraint(x, "batch", "seq", None)
 
@@ -222,11 +224,14 @@ class Transformer(nnx.Module):
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             jax.checkpoint_policies.save_only_these_names(*names))
 
-    def _apply_stack(self, blocks: Block, x: jax.Array) -> jax.Array:
+    def _apply_stack(self, blocks: Block, x: jax.Array,
+                     mask: jax.Array | None = None) -> jax.Array:
         """Scan ``x`` through a stacked block module (all layers or one
-        pipeline stage's local slice)."""
+        pipeline stage's local slice). ``mask`` (bool, broadcastable to
+        (B, N, Sq, Sk)) rides into every layer as a closure capture — it is
+        layer-invariant, so it is not a scan carry."""
         def body(block: Block, x: jax.Array) -> jax.Array:
-            return block(x)
+            return block(x, mask=mask)
 
         if self.cfg.remat:
             body = nnx.remat(body, policy=self._remat_policy())
@@ -235,9 +240,14 @@ class Transformer(nnx.Module):
                         transform_metadata={nnx.PARTITION_NAME: "layers"})
         return scan(blocks, x)
 
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array,
+                 mask: jax.Array | None = None) -> jax.Array:
         if not self.cfg.pipeline:
-            return self._apply_stack(self.blocks, x)
+            return self._apply_stack(self.blocks, x, mask)
+        if mask is not None:
+            raise ValueError("attention masks are not supported on the "
+                             "pipelined path yet; use pipeline=False for "
+                             "NaFlex/masked batches")
 
         from jimm_tpu.parallel.pipeline import (circular_layer_order,
                                                 pipeline_forward)

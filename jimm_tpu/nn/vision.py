@@ -59,11 +59,12 @@ class MAPHead(nnx.Module):
         self.mlp = Mlp(cfg.width, cfg.mlp_dim, cfg.act, rngs, dtype=dtype,
                        param_dtype=param_dtype)
 
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array,
+                 mask: jax.Array | None = None) -> jax.Array:
         B = x.shape[0]
         probe = jnp.broadcast_to(self.probe[...], (B, 1, x.shape[-1])
                                  ).astype(x.dtype)
-        x = self.attn(probe, kv=x)        # (B, 1, width)
+        x = self.attn(probe, kv=x, mask=mask)        # (B, 1, width)
         residual = x
         x = residual + self.mlp(self.ln(x))
         return x[:, 0]
@@ -121,3 +122,56 @@ class VisionTower(nnx.Module):
         if self.cfg.pooling == "map":
             return self.head(x)
         return x
+
+    def forward_naflex(self, patches: jax.Array, spatial_shapes: jax.Array,
+                       mask: jax.Array) -> jax.Array:
+        """NaFlex path: variable-resolution batches as pre-patchified tokens
+        (beyond the reference, whose SigLIP2 support is "any non-NaFlex
+        variant", ref `README.md:13-14`).
+
+        Args:
+            patches: ``(B, S, p*p*C)`` — each row a (patch_row, patch_col,
+                channel)-flattened patch (HF ``convert_image_to_patches``
+                layout), zero-padded past the sample's ``h * w`` tokens.
+            spatial_shapes: ``(B, 2)`` int — per-sample (h, w) patch grid.
+            mask: ``(B, S)`` bool/int — True for real tokens.
+
+        Returns pooled ``(B, width)`` embeddings (MAP pooling with the
+        padding mask; matches HF ``Siglip2VisionModel`` semantics).
+        """
+        from jimm_tpu.nn.naflex import naflex_position_embedding
+        cfg = self.cfg
+        if cfg.pooling != "map" or cfg.pre_norm:
+            raise ValueError("forward_naflex targets SigLIP2-style towers "
+                             "(MAP pooling, post-norm)")
+        if getattr(self, "_pos_table_resampled", False):
+            raise ValueError(
+                "this model's position table was interpolated at load "
+                "(image_size override, or a checkpoint whose NaFlex grid "
+                "differs from the fixed-resolution grid); resampling it "
+                "again per sample would diverge from the checkpoint — load "
+                "at the native image_size for NaFlex inference")
+        # the conv patchifier IS the NaFlex Linear: HWIO (p, p, C, D)
+        # flattened row-major over (row, col, chan) matches the HF patch
+        # layout (see weights/loader._patch_linear_to_hwio)
+        kernel = self.patch_embed.conv.kernel[...]
+        p, _, c, d = kernel.shape
+        w_flat = kernel.reshape(p * p * c, d)
+        # same compute dtype as the fixed path's conv — a bf16 model must
+        # not silently run the NaFlex projection in f32
+        dtype = self.patch_embed.conv.dtype or patches.dtype
+        x = patches.astype(dtype) @ w_flat.astype(dtype)
+        if self.patch_embed.conv.bias is not None:
+            x = x + self.patch_embed.conv.bias[...].astype(dtype)
+        # source table: the stored fixed-grid pos table (== the checkpoint's
+        # native NaFlex table when image_size/patch is its native grid)
+        g = int(round(cfg.seq_len ** 0.5))
+        table = self.pos_embed[...].reshape(g, g, -1)
+        x = x + naflex_position_embedding(
+            table, spatial_shapes, x.shape[1]).astype(dtype)
+        x = self.dropout(x)
+        key_mask = (mask != 0)[:, None, None, :]     # (B, 1, 1, S) over keys
+        x = logical_constraint(x, "batch", "seq", None)
+        x = self.encoder(x, mask=key_mask)
+        x = self.ln_post(x)
+        return self.head(x, mask=key_mask)

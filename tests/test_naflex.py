@@ -1,0 +1,167 @@
+"""NaFlex (variable-resolution SigLIP2) vs the HF ``Siglip2Model`` oracle.
+
+The reference supports "SigLIP v1 and v2, any non-NaFlex variant"
+(ref `README.md:13-14`) — the NaFlex path here is beyond-reference
+capability, so parity is anchored directly to HF torch semantics:
+- position-table resize == ``F.interpolate(bilinear, align_corners=False,
+  antialias=True)`` (exact filter math, not a lookalike),
+- full vision-tower + logits parity on a mixed-resolution padded batch,
+- host-side patchify == HF ``Siglip2ImageProcessor`` grid/rounding rules.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hf_util import save_tiny_siglip2
+
+
+def _torch_resize_table(table: np.ndarray, h: int, w: int) -> np.ndarray:
+    import torch
+    import torch.nn.functional as F
+    t = torch.tensor(table).permute(2, 0, 1).unsqueeze(0)
+    out = F.interpolate(t, size=(h, w), mode="bilinear",
+                        align_corners=False, antialias=True)
+    return out[0].permute(1, 2, 0).reshape(h * w, -1).numpy()
+
+
+@pytest.mark.parametrize("hw", [(16, 16), (8, 32), (3, 5), (20, 10), (1, 64)])
+def test_position_embedding_matches_torch_interpolate(rng, hw):
+    from jimm_tpu.nn.naflex import naflex_position_embedding
+    h, w = hw
+    table = rng.randn(16, 16, 8).astype(np.float32)
+    seq = h * w
+    ours = np.asarray(naflex_position_embedding(
+        jnp.asarray(table), jnp.asarray([[h, w]], jnp.int32), seq))[0]
+    ref = _torch_resize_table(table, h, w)
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def _mixed_batch(rng, patch=16, max_patches=4):
+    """Two samples: a full 2x2 grid and a padded 1x2 grid."""
+    full = rng.randn(4, patch * patch * 3).astype(np.float32)
+    half = rng.randn(2, patch * patch * 3).astype(np.float32)
+    patches = np.zeros((2, max_patches, patch * patch * 3), np.float32)
+    patches[0] = full
+    patches[1, :2] = half
+    shapes = np.asarray([[2, 2], [1, 2]], np.int32)
+    mask = np.asarray([[1, 1, 1, 1], [1, 1, 0, 0]], bool)
+    return patches, shapes, mask
+
+
+def test_naflex_matches_hf_siglip2_oracle(rng, tmp_path):
+    import torch
+    from transformers import Siglip2Model
+
+    d = save_tiny_siglip2(tmp_path / "ckpt")
+    hf = Siglip2Model.from_pretrained(d).eval()
+
+    from jimm_tpu import SigLIP
+    model = SigLIP.from_pretrained(d)
+
+    patches, shapes, mask = _mixed_batch(rng)
+    with torch.no_grad():
+        ref_img = hf.get_image_features(
+            pixel_values=torch.tensor(patches),
+            pixel_attention_mask=torch.tensor(mask.astype(np.int64)),
+            spatial_shapes=torch.tensor(shapes.astype(np.int64))).numpy()
+    ours_img = np.asarray(model.encode_image_naflex(
+        jnp.asarray(patches), jnp.asarray(shapes), jnp.asarray(mask)))
+    np.testing.assert_allclose(ours_img, ref_img, atol=2e-4)
+
+    # full contrastive logits over the NaFlex image batch
+    txt = rng.randint(1, 90, size=(2, 8)).astype(np.int64)
+    with torch.no_grad():
+        ref_logits = hf(input_ids=torch.tensor(txt),
+                        pixel_values=torch.tensor(patches),
+                        pixel_attention_mask=torch.tensor(
+                            mask.astype(np.int64)),
+                        spatial_shapes=torch.tensor(shapes.astype(np.int64)),
+                        ).logits_per_image.numpy()
+    ours_logits = np.asarray(model.logits_naflex(
+        jnp.asarray(patches), jnp.asarray(shapes), jnp.asarray(mask),
+        jnp.asarray(txt, jnp.int32)))
+    np.testing.assert_allclose(ours_logits, ref_logits, atol=2e-3)
+
+
+def test_padding_values_cannot_leak(rng, tmp_path):
+    from jimm_tpu import SigLIP
+    d = save_tiny_siglip2(tmp_path / "ckpt")
+    model = SigLIP.from_pretrained(d)
+    patches, shapes, mask = _mixed_batch(rng)
+    base = np.asarray(model.encode_image_naflex(
+        jnp.asarray(patches), jnp.asarray(shapes), jnp.asarray(mask)))
+    poisoned = patches.copy()
+    poisoned[1, 2:] = 1e4  # garbage in the masked pad region
+    out = np.asarray(model.encode_image_naflex(
+        jnp.asarray(poisoned), jnp.asarray(shapes), jnp.asarray(mask)))
+    np.testing.assert_allclose(out, base, atol=1e-5)
+    assert np.isfinite(out).all()
+
+
+def test_uniform_grid_matches_v1_path(rng, tmp_path):
+    """At a sample's native square grid with no padding, the NaFlex path
+    must reproduce the fixed-resolution encode_image exactly (the pos-table
+    resize is the identity there)."""
+    from jimm_tpu import SigLIP
+    from jimm_tpu.data.naflex import image_to_patches
+    d = save_tiny_siglip2(tmp_path / "ckpt")
+    model = SigLIP.from_pretrained(d)
+    images = rng.randn(2, 32, 32, 3).astype(np.float32)
+    v1 = np.asarray(model.encode_image(jnp.asarray(images)))
+    patches = np.stack([image_to_patches(im, 16) for im in images])
+    shapes = np.asarray([[2, 2]] * 2, np.int32)
+    mask = np.ones((2, 4), bool)
+    ours = np.asarray(model.encode_image_naflex(
+        jnp.asarray(patches), jnp.asarray(shapes), jnp.asarray(mask)))
+    np.testing.assert_allclose(ours, v1, atol=1e-4)
+
+
+@pytest.mark.parametrize("size", [(37, 211), (1024, 64), (16, 16), (999, 3)])
+def test_target_size_matches_hf_processor(size):
+    from transformers.models.siglip2.image_processing_siglip2 import (
+        get_image_size_for_max_num_patches)
+
+    from jimm_tpu.data.naflex import target_size_for_max_patches
+    ours = target_size_for_max_patches(size[0], size[1], 16, 256)
+    ref = get_image_size_for_max_num_patches(size[0], size[1], 16, 256)
+    assert ours == tuple(ref)
+
+
+def test_patch_layout_matches_hf_processor(rng):
+    from transformers.models.siglip2.image_processing_siglip2 import (
+        convert_image_to_patches)
+
+    from jimm_tpu.data.naflex import image_to_patches
+    im = rng.randn(48, 32, 3).astype(np.float32)
+    np.testing.assert_array_equal(image_to_patches(im, 16),
+                                  convert_image_to_patches(im, 16))
+
+
+def test_patchify_naflex_end_to_end(rng):
+    from jimm_tpu.data.naflex import patchify_naflex
+    images = [rng.randn(40, 80, 3).astype(np.float32),
+              rng.randn(64, 64, 3).astype(np.float32)]
+    patches, shapes, mask = patchify_naflex(images, patch_size=16,
+                                            max_num_patches=16)
+    assert patches.shape == (2, 16, 16 * 16 * 3)
+    assert mask.shape == (2, 16)
+    for i in range(2):
+        n = int(shapes[i, 0] * shapes[i, 1])
+        assert n <= 16
+        assert mask[i, :n].all() and not mask[i, n:].any()
+        assert (patches[i, n:] == 0).all()
+
+
+def test_refuses_naflex_after_load_time_pos_resample(rng, tmp_path):
+    """An image_size override interpolates the stored table at load; a second
+    per-sample resample would diverge from the checkpoint, so the NaFlex
+    path must refuse rather than silently double-resample."""
+    from jimm_tpu import SigLIP
+    d = save_tiny_siglip2(tmp_path / "ckpt")
+    model = SigLIP.from_pretrained(d, image_size=64)  # native is 32
+    patches, shapes, mask = _mixed_batch(rng)
+    with pytest.raises(ValueError, match="native image_size"):
+        model.encode_image_naflex(jnp.asarray(patches), jnp.asarray(shapes),
+                                  jnp.asarray(mask))
