@@ -1,0 +1,53 @@
+"""NaFlex variable-resolution SigLIP2 inference.
+
+Runs a batch of images with DIFFERENT sizes and aspect ratios through one
+jitted forward — no per-resolution recompiles, no squashing to a fixed
+square. Each image keeps its aspect ratio: it is resized to the largest
+patch-divisible grid within the token budget, patchified, and padded; the
+model masks the padding and resamples its position table per sample inside
+the jit (see `jimm_tpu/nn/naflex.py`).
+
+The reference framework supports "any non-NaFlex variant" only
+(ref `README.md:13-14`) — this path is jimm_tpu-specific capability.
+
+Usage:
+    python examples/naflex_inference.py [hub-id-or-local-dir]
+"""
+
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from jimm_tpu import SigLIP
+from jimm_tpu.data import patchify_naflex, to_float_normalized
+
+
+def main() -> None:
+    repo = sys.argv[1] if len(sys.argv) > 1 else "google/siglip2-base-patch16-256"
+    model = SigLIP.from_pretrained(repo, dtype=jnp.bfloat16)
+    patch = model.config.vision.patch_size
+    budget = model.config.vision.num_patches
+
+    # three images, three different shapes — one batch, one compile
+    rng = np.random.RandomState(0)
+    images = [to_float_normalized(rng.rand(1, h, w, 3).astype(np.float32))[0]
+              for h, w in ((480, 640), (768, 256), (224, 224))]
+    patches, shapes, mask = patchify_naflex(images, patch_size=patch,
+                                            max_num_patches=budget)
+
+    @nnx.jit  # NOT bare jax.jit: the scanned layer stack is module state
+    def embed(model, p, s, m):
+        feats = model.encode_image_naflex(p, s, m)
+        return feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+
+    feats = embed(model, jnp.asarray(patches), jnp.asarray(shapes),
+                  jnp.asarray(mask))
+    print("grids:", shapes.tolist())
+    print("embeddings:", feats.shape, "cosine(img0, img1) =",
+          float(feats[0] @ feats[1]))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,3 +44,11 @@ def test_distributed_training_example():
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "[rank 0] step 2: loss=" in proc.stdout
     assert "[rank 1] rank 1 done" in proc.stdout
+
+
+def test_naflex_inference_example(tmp_path):
+    from hf_util import save_tiny_siglip2
+    ckpt = save_tiny_siglip2(tmp_path / "ckpt")
+    proc = _run("naflex_inference.py", ckpt)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "embeddings:" in proc.stdout
