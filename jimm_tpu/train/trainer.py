@@ -130,8 +130,16 @@ def contrastive_loss_fn(model, images: jax.Array, text: jax.Array, *,
     - ``"siglip"``: dense sigmoid all-pairs (oracle / single chip).
     - ``"siglip_ring"``: ppermute-ring sigmoid over ``axis_name`` —
       the north-star loss.
+
+    ``images`` is either a ``(B, H, W, C)`` array or a NaFlex triple
+    ``(patches, spatial_shapes, mask)`` (see
+    `SigLIP.encode_image_naflex`) — the latter trains SigLIP2 on
+    variable-resolution batches, which the reference cannot.
     """
-    img = model.encode_image(images)
+    if isinstance(images, (tuple, list)):
+        img = model.encode_image_naflex(*images)
+    else:
+        img = model.encode_image(images)
     txt = model.encode_text(text)
     scale = model.logit_scale[...]
     if kind == "clip":
