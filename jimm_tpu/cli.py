@@ -892,13 +892,31 @@ def cmd_classify(args: argparse.Namespace) -> int:
         img = decode_image(f.read())
     mean, std = ((CLIP_MEAN, CLIP_STD) if args.model == "clip"
                  else (SIGLIP_MEAN, SIGLIP_STD))
-    # CLIP checkpoints are trained with shortest-side resize + center crop;
-    # SigLIP's processor resizes straight to the square
-    batch = preprocess_batch(img[None], image_size=cfg.vision.image_size,
-                             mean=mean, std=std, crop=args.model == "clip")
-    images = jnp.asarray(batch, dtype)
-
-    logits = np.asarray(jit_forward(model)(images, text), np.float32)[0]
+    if args.naflex:
+        # variable-resolution path: aspect-preserving patch grid + mask
+        # instead of the square resize (SigLIP2 NaFlex; beyond the
+        # reference's non-NaFlex-only support)
+        if args.model != "siglip":
+            raise SystemExit("--naflex is a SigLIP2 feature; use "
+                             "--model siglip")
+        from jimm_tpu.data.naflex import patchify_naflex
+        from jimm_tpu.data.preprocess import to_float_normalized
+        im = to_float_normalized(img[None], mean, std)[0]
+        patches, shapes, mask = patchify_naflex(
+            [im], patch_size=cfg.vision.patch_size,
+            max_num_patches=cfg.vision.num_patches)
+        logits = np.asarray(model.logits_naflex(
+            jnp.asarray(patches, dtype), jnp.asarray(shapes),
+            jnp.asarray(mask), text), np.float32)[0]
+    else:
+        # CLIP checkpoints are trained with shortest-side resize + center
+        # crop; SigLIP's processor resizes straight to the square
+        batch = preprocess_batch(img[None],
+                                 image_size=cfg.vision.image_size,
+                                 mean=mean, std=std,
+                                 crop=args.model == "clip")
+        images = jnp.asarray(batch, dtype)
+        logits = np.asarray(jit_forward(model)(images, text), np.float32)[0]
     if args.model == "siglip":
         scores = 1.0 / (1.0 + np.exp(-logits))  # per-pair sigmoid
     else:
@@ -1157,6 +1175,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--tokens-file", default=None,
                     help="JSON {label: [token ids]} — offline alternative "
                          "to --tokenizer")
+    sp.add_argument("--naflex", action="store_true",
+                    help="SigLIP2 NaFlex path: keep the image's aspect "
+                         "ratio (variable-resolution patches + mask) "
+                         "instead of squashing to the square")
     sp.add_argument("--bf16", action="store_true")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_classify)

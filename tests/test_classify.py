@@ -88,3 +88,33 @@ def test_classify_needs_token_source(tmp_path, image_file):
     with pytest.raises(SystemExit, match="tokens-file"):
         main(["classify", image_file, "--ckpt", str(ckpt),
               "--platform", "cpu"])
+
+
+def test_classify_siglip2_naflex(tmp_path, rng, capsys):
+    """--naflex: aspect-preserving variable-resolution zero-shot on a
+    SigLIP2 checkpoint — a non-square image maps to a non-square grid."""
+    from hf_util import save_tiny_siglip2
+    p = tmp_path / "wide.png"
+    Image.fromarray(rng.randint(0, 255, size=(16, 48, 3))
+                    .astype(np.uint8)).save(p)
+    ckpt = save_tiny_siglip2(tmp_path / "ckpt")
+    tokens = tmp_path / "tokens.json"
+    tokens.write_text(json.dumps({"ant": [1, 2], "bee": [3, 4]}))
+    rc = main(["classify", str(p), "--ckpt", str(ckpt), "--model", "siglip",
+               "--naflex", "--tokens-file", str(tokens),
+               "--platform", "cpu"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2
+    for line in out:
+        assert 0.0 < float(line.split()[0]) < 1.0
+
+
+def test_classify_naflex_requires_siglip(tmp_path, image_file):
+    from hf_util import save_tiny_clip
+    ckpt = save_tiny_clip(tmp_path / "ckpt")
+    tokens = tmp_path / "tokens.json"
+    tokens.write_text(json.dumps({"cat": [1, 63]}))
+    with pytest.raises(SystemExit, match="naflex"):
+        main(["classify", image_file, "--ckpt", str(ckpt), "--model", "clip",
+              "--naflex", "--tokens-file", str(tokens), "--platform", "cpu"])
