@@ -697,10 +697,8 @@ def cmd_export_run(args: argparse.Namespace) -> int:
     `transformers` and back through `from_pretrained`. (`export` converts
     HF checkpoints; this converts this framework's own runs.)"""
     _configure_backend(args)
-    from jimm_tpu.weights.export import save_pretrained
-
     _, model = _restore_run(args)
-    save_pretrained(model, args.out)
+    _model_save(model, args)
     print(f"exported {args.ckpt_dir} -> {args.out}")
     return 0
 
@@ -927,15 +925,25 @@ def cmd_classify(args: argparse.Namespace) -> int:
     return 0
 
 
+def _model_save(model, args: argparse.Namespace) -> None:
+    """Model-method export (flavor-aware for SigLIP): --flavor picks the
+    HF format for SigLIP2-origin checkpoints; default matches the source."""
+    flavor = getattr(args, "flavor", "auto")
+    if flavor != "auto" and not hasattr(model, "_save_pretrained_siglip2"):
+        raise SystemExit("--flavor applies to SigLIP models only")
+    if flavor == "auto":
+        model.save_pretrained(args.out)
+    else:
+        model.save_pretrained(args.out, flavor=flavor)
+
+
 def cmd_export(args: argparse.Namespace) -> int:
     _configure_backend(args)
     import jax.numpy as jnp
 
-    from jimm_tpu.weights.export import save_pretrained
-
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
     model = _model_cls(args.model).from_pretrained(args.src, dtype=dtype)
-    save_pretrained(model, args.out)
+    _model_save(model, args)
     print(f"exported {args.src} -> {args.out}")
     return 0
 
@@ -1205,6 +1213,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("src", help="HF repo id, local file, or local dir")
     sp.add_argument("out", help="output directory")
     sp.add_argument("--model", required=True, choices=["vit", "clip", "siglip"])
+    sp.add_argument("--flavor", default="auto",
+                    choices=["auto", "siglip", "siglip2"],
+                    help="SigLIP export format: auto = match the source "
+                         "checkpoint (Siglip2-origin stays Siglip2Model-"
+                         "loadable); siglip forces the v1 layout")
     sp.add_argument("--bf16", action="store_true")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_export)
@@ -1217,6 +1230,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--preset", required=True,
                     help="preset the run trained (or its family, with "
                          "--from-pretrained)")
+    sp.add_argument("--flavor", default="auto",
+                    choices=["auto", "siglip", "siglip2"],
+                    help="SigLIP export format (see `export --flavor`)")
     sp.add_argument("--tiny", action="store_true")
     sp.add_argument("--from-pretrained", default=None,
                     help="HF checkpoint the run fine-tuned from")

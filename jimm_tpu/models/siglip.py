@@ -309,20 +309,54 @@ class SigLIP(nnx.Module):
             "vision_config": vision, "text_config": text,
         }
 
-    def save_pretrained(self, save_dir) -> None:
-        """Export in HF SiglipModel (v1) format: Conv2d OIHW patch embed,
-        fixed-grid position table. A model loaded FROM a ``Siglip2Model``
-        checkpoint also exports as v1 (its NaFlex position table was already
-        resampled to the fixed grid at load) — transformers'
-        ``Siglip2Model`` cannot reload the exported file; ``SiglipModel``
-        can."""
-        if getattr(self, "_hf_source_flavor", None) == "siglip2":
-            import warnings
-            warnings.warn(
-                "this model was loaded from a Siglip2Model checkpoint but "
-                "exports in SiglipModel (v1) format — the NaFlex Linear "
-                "patch embed becomes Conv2d OIHW and the position table was "
-                "resampled at load. Reload the export with SiglipModel / "
-                "SigLIP.from_pretrained, not Siglip2Model.", stacklevel=2)
+    def save_pretrained(self, save_dir, *, flavor: str | None = None) -> None:
+        """Export an HF-compatible checkpoint.
+
+        ``flavor``: ``"siglip"`` (v1: Conv2d OIHW patch embed, ``SiglipModel``
+        reloads it), ``"siglip2"`` (NaFlex Linear patch embed +
+        ``num_patches`` position table, ``Siglip2Model`` reloads it), or
+        ``None`` = match the checkpoint the model was loaded from (v1 for
+        fresh models). The reference has no save path at all (SURVEY §5)."""
+        if flavor is None:
+            flavor = getattr(self, "_hf_source_flavor", None) or "siglip"
+        if flavor not in ("siglip", "siglip2"):
+            raise ValueError(f"unknown export flavor {flavor!r}")
         from jimm_tpu.weights.export import save_pretrained
-        save_pretrained(self, save_dir)
+        if flavor == "siglip":
+            if getattr(self, "_hf_source_flavor", None) == "siglip2":
+                import warnings
+                warnings.warn(
+                    "exporting a Siglip2-origin model in SiglipModel (v1) "
+                    "format — the NaFlex Linear patch embed becomes Conv2d "
+                    "OIHW. Reload with SiglipModel / SigLIP.from_pretrained "
+                    "(or pass flavor='siglip2' for a Siglip2Model-loadable "
+                    "export).", stacklevel=2)
+            save_pretrained(self, save_dir)
+            return
+        self._save_pretrained_siglip2(save_dir)
+
+    def _save_pretrained_siglip2(self, save_dir) -> None:
+        """Siglip2-native export: the shared export pipeline with two hooks —
+        the patch embedding re-flattened to the NaFlex Linear ``(D, p*p*C)``
+        layout ((row, col, chan) input order — inverse of
+        `weights/loader._patch_linear_to_hwio`) and a ``siglip2`` config
+        carrying ``num_patches``."""
+        from jimm_tpu.weights.export import save_pretrained
+
+        def state_hook(state: dict) -> dict:
+            pe_key = "vision_model.embeddings.patch_embedding.weight"
+            pe = state[pe_key]  # v1 inverse transform wrote Conv2d OIHW
+            d_out, c, p, _ = pe.shape
+            state[pe_key] = np.ascontiguousarray(
+                pe.transpose(0, 2, 3, 1).reshape(d_out, p * p * c))
+            return state
+
+        def config_hook(cfg: dict) -> dict:
+            cfg["architectures"] = ["Siglip2Model"]
+            cfg["model_type"] = "siglip2"
+            cfg["vision_config"]["num_patches"] = \
+                self.config.vision.num_patches
+            return cfg
+
+        save_pretrained(self, save_dir, state_hook=state_hook,
+                        config_hook=config_hook)

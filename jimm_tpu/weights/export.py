@@ -75,17 +75,27 @@ def to_hf_state_dict(model: nnx.Module, entries: list[M], *, num_layers: int,
     return out
 
 
-def save_pretrained(model: nnx.Module, save_dir: str | os.PathLike) -> None:
+def save_pretrained(model: nnx.Module, save_dir: str | os.PathLike, *,
+                    state_hook=None, config_hook=None) -> None:
     """Write an HF-compatible directory: ``model.safetensors`` +
     ``config.json`` readable by ``transformers`` and by our
-    ``from_pretrained``."""
+    ``from_pretrained``.
+
+    ``state_hook(state_dict)`` / ``config_hook(config_dict)`` let a model
+    emit a format variant (e.g. SigLIP's ``flavor="siglip2"``) while sharing
+    this one pipeline — both mutate-and-return their dict."""
     d = Path(save_dir)
     d.mkdir(parents=True, exist_ok=True)
     state = to_hf_state_dict(model, model.hf_mapping(model.config),
                              **_layer_kwargs(model))
+    if state_hook is not None:
+        state = state_hook(state)
+    config = model.hf_config()
+    if config_hook is not None:
+        config = config_hook(config)
     save_file(state, d / "model.safetensors", metadata={"format": "pt"})
     with open(d / "config.json", "w") as f:
-        json.dump(model.hf_config(), f, indent=2)
+        json.dump(config, f, indent=2)
 
 
 def _layer_kwargs(model) -> dict[str, Any]:

@@ -65,3 +65,63 @@ def test_siglip_export_roundtrip(tmp_path, rng):
     again = SigLIP.from_pretrained(str(tmp_path / "out"))
     np.testing.assert_array_equal(
         ours, np.asarray(again(jnp.asarray(img), jnp.asarray(txt))))
+
+
+def test_siglip2_native_export_roundtrip(tmp_path, rng):
+    """flavor='siglip2': the export reloads in transformers' Siglip2Model
+    (NaFlex Linear patch embed + num_patches table) with feature parity,
+    and in our own from_pretrained."""
+    import torch
+    from transformers import Siglip2Model
+
+    from hf_util import save_tiny_siglip2, siglip2_pixel_inputs
+    src = save_tiny_siglip2(tmp_path / "src")
+    model = SigLIP.from_pretrained(src)
+    model.save_pretrained(tmp_path / "out")  # default flavor: match source
+    img, txt = sample_image(rng), sample_text(rng)
+    ours = np.asarray(model(jnp.asarray(img), jnp.asarray(txt)))
+    hf = Siglip2Model.from_pretrained(tmp_path / "out").eval()
+    with torch.no_grad():
+        theirs = hf(input_ids=torch.tensor(txt),
+                    **siglip2_pixel_inputs(img)).logits_per_image.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+    again = SigLIP.from_pretrained(str(tmp_path / "out"))
+    np.testing.assert_array_equal(
+        ours, np.asarray(again(jnp.asarray(img), jnp.asarray(txt))))
+
+
+def test_siglip2_origin_v1_export_warns_and_loads(tmp_path, rng):
+    import pytest as _pytest
+
+    from hf_util import save_tiny_siglip2
+    src = save_tiny_siglip2(tmp_path / "src")
+    model = SigLIP.from_pretrained(src)
+    with _pytest.warns(UserWarning, match="SiglipModel"):
+        model.save_pretrained(tmp_path / "v1", flavor="siglip")
+    again = SigLIP.from_pretrained(str(tmp_path / "v1"))
+    img, txt = sample_image(rng), sample_text(rng)
+    np.testing.assert_allclose(
+        np.asarray(model(jnp.asarray(img), jnp.asarray(txt))),
+        np.asarray(again(jnp.asarray(img), jnp.asarray(txt))), atol=1e-5)
+
+
+def test_cli_export_flavor_flag(tmp_path):
+    """`export --flavor siglip` downgrades a Siglip2-origin checkpoint to
+    the v1 layout; `--flavor` on a non-SigLIP model is refused."""
+    import warnings
+
+    from hf_util import save_tiny_siglip2, save_tiny_vit
+    from jimm_tpu.cli import main
+    src = save_tiny_siglip2(tmp_path / "src")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the intentional v1-downgrade warn
+        rc = main(["export", str(src), str(tmp_path / "v1"),
+                   "--model", "siglip", "--flavor", "siglip",
+                   "--platform", "cpu"])
+    assert rc == 0
+    assert SigLIP.from_pretrained(
+        str(tmp_path / "v1"))._hf_source_flavor == "siglip"
+    vit_src = save_tiny_vit(tmp_path / "vsrc")
+    with pytest.raises(SystemExit, match="SigLIP"):
+        main(["export", str(vit_src), str(tmp_path / "vout"),
+              "--model", "vit", "--flavor", "siglip", "--platform", "cpu"])

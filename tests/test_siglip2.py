@@ -97,14 +97,18 @@ def test_shape_inference_without_config(ckpt, tmp_path, rng):
     assert out.shape == (2, 2)
 
 
-def test_save_pretrained_warns_v1_export(ckpt, tmp_path):
-    """ADVICE r3 #1: a Siglip2-origin model exports in SiglipModel v1
-    format (patch embed back to Conv2d OIHW, position table already
-    resampled) — the user must be told Siglip2Model cannot reload it."""
+def test_save_pretrained_flavors(ckpt, tmp_path):
+    """A Siglip2-origin model round-trips natively by default (flavor
+    matches the source checkpoint — `tests/test_export.py` proves
+    Siglip2Model reloads it); the explicit v1 downgrade warns (ADVICE r3
+    #1: the patch embed becomes Conv2d OIHW, Siglip2Model cannot reload)
+    but stays a valid v1 export."""
     model = SigLIP.from_pretrained(ckpt)
     assert model._hf_source_flavor == "siglip2"
-    with pytest.warns(UserWarning, match="Siglip2Model checkpoint"):
-        model.save_pretrained(tmp_path / "export")
-    # the export itself must stay valid v1 and reload cleanly
-    again = SigLIP.from_pretrained(str(tmp_path / "export"))
+    model.save_pretrained(tmp_path / "native")  # no warning
+    again = SigLIP.from_pretrained(str(tmp_path / "native"))
+    assert again._hf_source_flavor == "siglip2"
+    with pytest.warns(UserWarning, match="SiglipModel"):
+        model.save_pretrained(tmp_path / "v1", flavor="siglip")
+    again = SigLIP.from_pretrained(str(tmp_path / "v1"))
     assert again._hf_source_flavor == "siglip"
