@@ -845,6 +845,10 @@ def cmd_classify(args: argparse.Namespace) -> int:
     cfg = model.config
 
     if args.tokens_file:
+        if args.ensemble:
+            raise SystemExit("--ensemble builds prompts from templates; it "
+                             "needs --labels (+ a tokenizer), not "
+                             "--tokens-file")
         table = json.loads(open(args.tokens_file).read())
         labels = list(table)
         rows = [table[k] for k in labels]
@@ -861,7 +865,16 @@ def cmd_classify(args: argparse.Namespace) -> int:
                              "checkpoint dir holding vocab.json/merges.txt), "
                              "or --tokens-file")
         labels = [s.strip() for s in args.labels.split(",") if s.strip()]
-        prompts = [args.template.format(label) for label in labels]
+        if args.ensemble:
+            # CLIP-paper recipe: average each class over prompt templates
+            # (normalize, mean, renormalize); "|"-separated --template
+            # supplies a custom set, else the builtin 7-template subset
+            from jimm_tpu.utils.zero_shot import TEMPLATES, expand_templates
+            templates = (tuple(t for t in args.template.split("|") if t)
+                         if "|" in args.template else TEMPLATES)
+            prompts = expand_templates(labels, templates)
+        else:
+            prompts = [args.template.format(label) for label in labels]
         rows = None
         if not args.tokenizer and args.model == "clip":
             # zero-dependency path: every HF CLIP checkpoint ships its BPE
@@ -903,9 +916,19 @@ def cmd_classify(args: argparse.Namespace) -> int:
         patches, shapes, mask = patchify_naflex(
             [im], patch_size=cfg.vision.patch_size,
             max_num_patches=cfg.vision.num_patches)
-        logits = np.asarray(model.logits_naflex(
-            jnp.asarray(patches, dtype), jnp.asarray(shapes),
-            jnp.asarray(mask), text), np.float32)[0]
+        if args.ensemble:
+            from jimm_tpu.utils.zero_shot import (
+                classifier_weights, zero_shot_logits_from_features)
+            weights = classifier_weights(model, text, len(labels))
+            feats = model.encode_image_naflex(
+                jnp.asarray(patches, dtype), jnp.asarray(shapes),
+                jnp.asarray(mask))
+            logits = np.asarray(zero_shot_logits_from_features(
+                model, feats, weights), np.float32)[0]
+        else:
+            logits = np.asarray(model.logits_naflex(
+                jnp.asarray(patches, dtype), jnp.asarray(shapes),
+                jnp.asarray(mask), text), np.float32)[0]
     else:
         # CLIP checkpoints are trained with shortest-side resize + center
         # crop; SigLIP's processor resizes straight to the square
@@ -914,7 +937,15 @@ def cmd_classify(args: argparse.Namespace) -> int:
                                  mean=mean, std=std,
                                  crop=args.model == "clip")
         images = jnp.asarray(batch, dtype)
-        logits = np.asarray(jit_forward(model)(images, text), np.float32)[0]
+        if args.ensemble:
+            from jimm_tpu.utils.zero_shot import (classifier_weights,
+                                                  zero_shot_logits)
+            weights = classifier_weights(model, text, len(labels))
+            logits = np.asarray(zero_shot_logits(model, images, weights),
+                                np.float32)[0]
+        else:
+            logits = np.asarray(jit_forward(model)(images, text),
+                                np.float32)[0]
     if args.model == "siglip":
         scores = 1.0 / (1.0 + np.exp(-logits))  # per-pair sigmoid
     else:
@@ -1183,6 +1214,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--tokens-file", default=None,
                     help="JSON {label: [token ids]} — offline alternative "
                          "to --tokenizer")
+    sp.add_argument("--ensemble", action="store_true",
+                    help="prompt-template ensemble per class (the CLIP-"
+                         "paper recipe): normalize/mean/renormalize text "
+                         "embeddings over templates; --template with "
+                         "\"|\"-separated entries overrides the builtin set")
     sp.add_argument("--naflex", action="store_true",
                     help="SigLIP2 NaFlex path: keep the image's aspect "
                          "ratio (variable-resolution patches + mask) "

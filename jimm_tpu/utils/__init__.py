@@ -1,4 +1,9 @@
 from jimm_tpu.utils.env import configure_platform
 from jimm_tpu.utils.jit import jit_forward
+from jimm_tpu.utils.zero_shot import (TEMPLATES, classifier_weights,
+                                      expand_templates, zero_shot_logits,
+                                      zero_shot_logits_from_features)
 
-__all__ = ["configure_platform", "jit_forward"]
+__all__ = ["configure_platform", "jit_forward", "TEMPLATES",
+           "classifier_weights", "expand_templates", "zero_shot_logits",
+           "zero_shot_logits_from_features"]

@@ -118,3 +118,64 @@ def test_classify_naflex_requires_siglip(tmp_path, image_file):
     with pytest.raises(SystemExit, match="naflex"):
         main(["classify", image_file, "--ckpt", str(ckpt), "--model", "clip",
               "--naflex", "--tokens-file", str(tokens), "--platform", "cpu"])
+
+
+def test_zero_shot_ensemble_weights_math(tmp_path, rng):
+    """classifier_weights == normalize(mean(normalize(per-prompt)))."""
+    import jax.numpy as jnp
+
+    from hf_util import save_tiny_siglip
+    from jimm_tpu import SigLIP
+    from jimm_tpu.utils.zero_shot import classifier_weights
+    model = SigLIP.from_pretrained(save_tiny_siglip(tmp_path / "ckpt"))
+    L = model.config.text.context_length
+    rows = jnp.asarray(rng.randint(1, 90, size=(6, L)), jnp.int32)  # 2cls x3
+    w = np.asarray(classifier_weights(model, rows, 2))
+    emb = np.asarray(model.encode_text(rows))
+    emb = emb / np.linalg.norm(emb, axis=-1, keepdims=True)
+    ref = emb.reshape(2, 3, -1).mean(axis=1)
+    ref = ref / np.linalg.norm(ref, axis=-1, keepdims=True)
+    np.testing.assert_allclose(w, ref, atol=1e-6)
+    np.testing.assert_allclose(np.linalg.norm(w, axis=-1), 1.0, atol=1e-6)
+
+
+def _clip_ckpt_with_vocab(tmp_path):
+    import json as _json
+
+    from jimm_tpu.data.clip_tokenizer import bytes_to_unicode
+
+    alphabet = list(bytes_to_unicode().values())
+    merges = [("c", "a"), ("ca", "t</w>"), ("d", "o"), ("do", "g</w>")]
+    vocab_tokens = (alphabet + [c + "</w>" for c in alphabet]
+                    + ["".join(m) for m in merges]
+                    + ["<|startoftext|>", "<|endoftext|>"])
+    ckpt = save_tiny_clip(tmp_path / "ckpt", vocab_size=len(vocab_tokens))
+    (tmp_path / "ckpt" / "vocab.json").write_text(_json.dumps(
+        {tok: i for i, tok in enumerate(vocab_tokens)}))
+    (tmp_path / "ckpt" / "merges.txt").write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges) + "\n")
+    return ckpt
+
+
+def test_classify_ensemble_clip(tmp_path, image_file, capsys):
+    """--ensemble with a custom "|" template set through the built-in CLIP
+    BPE tokenizer; scores still softmax-normalize over the labels."""
+    ckpt = _clip_ckpt_with_vocab(tmp_path)
+    rc = main(["classify", image_file, "--ckpt", str(ckpt), "--model",
+               "clip", "--labels", "cat,dog", "--ensemble",
+               "--template", "a photo of a {}|a drawing of a {}",
+               "--platform", "cpu"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2
+    assert abs(sum(float(l.split()[0]) for l in out) - 1.0) < 1e-3
+
+
+def test_classify_ensemble_rejects_tokens_file(tmp_path, image_file):
+    ckpt = save_tiny_clip(tmp_path / "ckpt")
+    tokens = tmp_path / "tokens.json"
+    tokens.write_text(json.dumps({"cat": [1, 63]}))
+    with pytest.raises(SystemExit, match="ensemble"):
+        main(["classify", image_file, "--ckpt", str(ckpt), "--model",
+              "clip", "--ensemble", "--tokens-file", str(tokens),
+              "--platform", "cpu"])
