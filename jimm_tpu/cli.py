@@ -1130,9 +1130,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--loss", default=None,
                     choices=["clip", "clip_ring", "siglip", "siglip_ring"])
     sp.add_argument("--attn-impl", default=None,
-                    choices=["auto", "xla", "flash", "ring", "saveable"],
+                    choices=["auto", "xla", "flash", "ring", "ulysses",
+                             "saveable"],
                     help="attention kernel for both towers "
-                         "(ring = sequence-parallel, needs a seq mesh axis; "
+                         "(ring/ulysses = sequence-parallel over a seq mesh "
+                         "axis: ppermute kv ring vs all-to-all head "
+                         "redistribution; "
                          "saveable = checkpoint-named probs for --remat "
                          "dots+attn)")
     sp.add_argument("--remat", default=None,

@@ -19,7 +19,7 @@ from typing import Any, Literal
 
 Pooling = Literal["cls", "map", "last", "eot", "none"]
 Activation = Literal["gelu", "gelu_tanh", "quick_gelu"]
-AttnImpl = Literal["auto", "xla", "flash", "ring", "saveable"]
+AttnImpl = Literal["auto", "xla", "flash", "ring", "ulysses", "saveable"]
 #: "dots" + optional "+ln"/"+act"/"+attn" save-list extensions
 RematPolicy = str
 
@@ -450,7 +450,8 @@ def _check_runtime_fields(fields: Any) -> None:
     for k, v in fields.items():
         ok = True
         if k == "attn_impl":
-            ok = v in ("auto", "xla", "flash", "ring", "saveable")
+            from typing import get_args
+            ok = v in get_args(AttnImpl)
         elif k == "ln_impl":
             ok = v in ("xla", "fused")
         elif k in ("fused_qkv", "remat", "pipeline"):

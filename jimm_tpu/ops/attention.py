@@ -11,6 +11,11 @@ The reference locks attention to ``flax.nnx.MultiHeadAttention``'s einsum path
 - ``"ring"`` — sequence-parallel ring attention over the ambient mesh's
   ``seq`` axis (long context across chips; flash within each chip on TPU).
   See `jimm_tpu/parallel/ring_attention.py`.
+- ``"ulysses"`` — all-to-all sequence parallelism over the same ``seq``
+  axis: one head-redistributing all_to_all in, full-sequence local
+  attention (flash on TPU), one all_to_all out. Exact causal for free;
+  needs ``num_heads`` divisible by the axis. See
+  `jimm_tpu/parallel/ulysses.py`.
 - ``"saveable"`` — explicit einsum attention whose bf16 probabilities carry a
   ``checkpoint_name`` so the ``"dots+attn"`` remat policy can keep them: the
   remat'd backward then skips the qk^T + softmax recompute at the cost of one
@@ -63,16 +68,20 @@ def dot_product_attention(
                              "masks; use is_causal or impl='xla'")
         from jimm_tpu.ops.flash_attention import flash_attention
         return flash_attention(q, k, v, is_causal=is_causal)
-    if impl == "ring":
+    if impl in ("ring", "ulysses"):
         if mask is not None:
-            raise ValueError("ring attention does not support explicit "
+            raise ValueError(f"{impl} attention does not support explicit "
                              "masks; use is_causal or impl='xla'")
-        from jimm_tpu.parallel.ring_attention import ring_attention
         from jimm_tpu.parallel.sharding import current_rules
         rules = current_rules()
         axis = (rules.seq if rules is not None and rules.seq else "seq")
-        return ring_attention(q, k, v, axis_name=axis, is_causal=is_causal,
-                              impl="auto")
+        if impl == "ring":
+            from jimm_tpu.parallel.ring_attention import ring_attention
+            return ring_attention(q, k, v, axis_name=axis,
+                                  is_causal=is_causal, impl="auto")
+        from jimm_tpu.parallel.ulysses import ulysses_attention
+        return ulysses_attention(q, k, v, axis_name=axis,
+                                 is_causal=is_causal, impl="auto")
     if impl == "xla":
         return jax.nn.dot_product_attention(q, k, v, mask=mask,
                                             is_causal=is_causal)

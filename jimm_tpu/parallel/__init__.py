@@ -2,6 +2,7 @@ from jimm_tpu.parallel.mesh import (TOPOLOGIES, initialize_distributed,
                                     make_hybrid_mesh, make_mesh,
                                     make_topology)
 from jimm_tpu.parallel.pipeline import pipeline_forward
+from jimm_tpu.parallel.ulysses import ulysses_attention
 from jimm_tpu.parallel.ring_attention import (ring_attention, zigzag_order,
                                               zigzag_shard, zigzag_unshard)
 from jimm_tpu.parallel.sharding import (DATA_PARALLEL, FSDP, FSDP_TP,
@@ -16,7 +17,7 @@ __all__ = [
     "make_mesh", "make_hybrid_mesh", "make_topology", "TOPOLOGIES",
     "initialize_distributed", "ShardingRules", "use_sharding",
     "create_sharded", "shard_model", "shard_batch", "logical",
-    "logical_constraint", "pipeline_forward", "ring_attention",
+    "logical_constraint", "pipeline_forward", "ring_attention", "ulysses_attention",
     "zigzag_order", "zigzag_shard", "zigzag_unshard",
     "REPLICATED", "DATA_PARALLEL", "TENSOR_PARALLEL",
     "FSDP", "FSDP_TP", "HYBRID_FSDP_TP", "SEQUENCE_PARALLEL", "PIPELINE",

@@ -148,3 +148,23 @@ def initialize_distributed(coordinator_address: str | None = None,
         import warnings
         warnings.warn(f"jax.distributed.initialize auto-detect failed "
                       f"({e}); continuing single-process", RuntimeWarning)
+
+
+def resolve_mesh_axis(mesh, axis_name: str) -> dict:
+    """Validate that ``axis_name`` exists on ``mesh`` (or, when ``mesh`` is
+    None, on the ambient mesh installed by ``use_sharding``/``jax.set_mesh``)
+    and return the mesh shape dict. Shared by the sequence-parallel
+    attention schemes (`ring_attention`, `ulysses_attention`)."""
+    import jax as _jax
+    if mesh is None:
+        ambient = _jax.sharding.get_abstract_mesh()
+        if ambient is None or ambient.empty:
+            raise ValueError("no mesh given and no ambient mesh installed "
+                             "(use use_sharding(mesh, ...))")
+        if axis_name not in ambient.shape:
+            raise ValueError(f"ambient mesh {dict(ambient.shape)} has no "
+                             f"{axis_name!r} axis")
+        return dict(ambient.shape)
+    if axis_name not in mesh.shape:
+        raise ValueError(f"mesh {dict(mesh.shape)} has no {axis_name!r} axis")
+    return dict(mesh.shape)

@@ -286,24 +286,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     the model nothing changes because attention is permutation-covariant in
     sequence once positions are accounted for.
     """
-    if mesh is None:
-        # Works both outside and inside jit: the abstract mesh mirrors the
-        # ambient concrete mesh installed by use_sharding/jax.set_mesh, and
-        # shard_map binds the concrete one itself when no mesh is passed.
-        ambient = jax.sharding.get_abstract_mesh()
-        if ambient is None or ambient.empty:
-            raise ValueError("ring_attention: no mesh given and no ambient "
-                             "mesh installed (use use_sharding(mesh, ...))")
-        if axis_name not in ambient.shape:
-            raise ValueError(f"ambient mesh {dict(ambient.shape)} has no "
-                             f"{axis_name!r} axis")
-    elif axis_name not in mesh.shape:
-        raise ValueError(f"mesh {dict(mesh.shape)} has no {axis_name!r} axis")
+    from jimm_tpu.parallel.mesh import resolve_mesh_axis
+    # Works both outside and inside jit: the abstract mesh mirrors the
+    # ambient concrete mesh installed by use_sharding/jax.set_mesh, and
+    # shard_map binds the concrete one itself when no mesh is passed.
+    shape = resolve_mesh_axis(mesh, axis_name)
     if impl == "auto":
         # Same shape gate as dot_product_attention's auto path: the Pallas
         # kernel is validated for head_dim 64/128/256 and per-chip chunks
         # worth blocking; everything else takes the einsum path.
-        shape = dict((mesh or jax.sharding.get_abstract_mesh()).shape)
         local_seq = q.shape[1] // shape[axis_name]
         flash_ok = (jax.default_backend() == "tpu"
                     and q.shape[-1] in (64, 128, 256) and local_seq >= 128)
