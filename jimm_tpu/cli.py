@@ -116,10 +116,11 @@ def _is_tar_data(data: str) -> bool:
     return ".tar" in p.name
 
 
-def _num_classes_from_data(data: str) -> int | None:
-    """classes.json written by prepare-data, found next to the shards —
-    resolved by the container's own path rules (tfrecord or tar), so every
-    --data form (dir, glob, file) works for both formats."""
+def _dataset_classes(data: str) -> list[str] | None:
+    """Ordered class names from the classes.json prepare-data writes next
+    to the shards (index == label id) — resolved by the container's own
+    path rules (tfrecord or tar), so every --data form (dir, glob, file)
+    works for both formats."""
     import json
     from pathlib import Path
 
@@ -132,9 +133,15 @@ def _num_classes_from_data(data: str) -> int | None:
     except FileNotFoundError:
         return None  # the loader itself will raise with the right message
     if cj.is_file():
-        n = len(json.loads(cj.read_text()))
-        print(f"num_classes={n} from {cj}")
-        return n
+        return list(json.loads(cj.read_text()))
+    return None
+
+
+def _num_classes_from_data(data: str) -> int | None:
+    classes = _dataset_classes(data)
+    if classes is not None:
+        print(f"num_classes={len(classes)} from classes.json")
+        return len(classes)
     return None
 
 
@@ -649,7 +656,13 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
 
     fwd = jit_forward(model)
     n = 0
-    if fam == "vit":
+    if args.zero_shot:
+        if fam == "vit":
+            raise SystemExit("--zero-shot needs a contrastive model "
+                             "(clip/siglip); vit evaluates accuracy "
+                             "directly")
+        metrics, n = _zero_shot_eval(args, model, cfg, norm)
+    elif fam == "vit":
         if _is_tar_data(args.data):
             from jimm_tpu.data.webdataset import (
                 wds_classification_batches as classification_batches)
@@ -689,6 +702,78 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
     print(json.dumps({"examples": n, "batch_size": args.batch_size,
                       **metrics}))
     return 0
+
+
+def _zero_shot_eval(args: argparse.Namespace, model, cfg, norm
+                    ) -> tuple[dict, int]:
+    """Zero-shot classification accuracy (the CLIP-paper benchmark flow)
+    over *classification* records: ensemble classifier weights from a
+    tokens file, then one image-encoder pass + a (B, D) @ (D, C) matmul
+    per batch — no text tower in the loop.
+
+    ``--zero-shot tokens.json``: ``{label: [ids]}`` or
+    ``{label: [[ids], [ids], ...]}`` (multiple prompt templates per class,
+    ensemble-averaged). Class order follows the dataset's own
+    ``classes.json`` when present (index == label id), else the file's
+    insertion order.
+    """
+    import json
+
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu.data.records import pad_tokens
+    from jimm_tpu.utils.zero_shot import zero_shot_logits_from_features
+
+    table = json.loads(open(args.zero_shot).read())
+    labels = _dataset_classes(args.data) or list(table)
+    missing = [label for label in labels if label not in table]
+    if missing:
+        raise SystemExit(f"--zero-shot file lacks tokens for classes "
+                         f"{missing[:5]} (dataset classes.json order)")
+    rows, owner = [], []
+    for ci, label in enumerate(labels):
+        entry = table[label]
+        per_class = entry if entry and isinstance(entry[0], list) else [entry]
+        for r in per_class:
+            if len(r) > cfg.text.context_length:
+                raise SystemExit(
+                    f"tokens for {label!r} are {len(r)} ids but the "
+                    f"checkpoint's context_length is "
+                    f"{cfg.text.context_length}; re-tokenize to fit")
+            rows.append(pad_tokens(r, cfg.text.context_length))
+            owner.append(ci)
+    emb = np.array(model.encode_text(jnp.asarray(np.stack(rows))),
+                   np.float32)  # copy: jax buffers are read-only
+    emb /= np.linalg.norm(emb, axis=-1, keepdims=True)
+    owner_arr = np.asarray(owner)
+    weights = np.stack([emb[owner_arr == ci].mean(axis=0)
+                        for ci in range(len(labels))])
+    weights /= np.linalg.norm(weights, axis=-1, keepdims=True)
+    weights = jnp.asarray(weights)
+
+    if _is_tar_data(args.data):
+        from jimm_tpu.data.webdataset import (
+            wds_classification_batches as classification_batches)
+    else:
+        from jimm_tpu.data.records import classification_batches
+    encode = nnx.jit(lambda m, im: m.encode_image(im))
+    correct = n = 0
+    for images, y in classification_batches(
+            args.data, args.batch_size, image_size=cfg.vision.image_size,
+            repeat=False, shuffle_buffer=0, drop_remainder=False, **norm):
+        feats = encode(model, jnp.asarray(images))
+        logits = np.asarray(
+            zero_shot_logits_from_features(model, feats, weights),
+            np.float32)
+        correct += int((logits.argmax(axis=1) == y).sum())
+        n += len(y)
+    if not n:
+        raise SystemExit(f"no examples in {args.data}")
+    return {"zero_shot_top1": round(correct / n, 4),
+            "classes": len(labels),
+            "prompts": len(rows)}, n
 
 
 def cmd_export_run(args: argparse.Namespace) -> int:
@@ -1192,6 +1277,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--from-pretrained", default=None,
                     help="with --ckpt-dir: the HF checkpoint the training "
                          "run fine-tuned from (rebuilds that architecture)")
+    sp.add_argument("--zero-shot", default=None, metavar="TOKENS_JSON",
+                    help="zero-shot classification accuracy over labeled "
+                         "records (clip/siglip): {label: [ids]} or "
+                         "{label: [[ids], ...]} for prompt ensembles; "
+                         "class order from the dataset's classes.json")
     sp.add_argument("--image-size", type=int, default=None,
                     help="with --from-pretrained: the --image-size the "
                          "training run used")

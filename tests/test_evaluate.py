@@ -80,3 +80,44 @@ def test_evaluate_counts_trailing_remainder(tmp_path, rng, capsys):
 def test_evaluate_requires_weights_source(tmp_path):
     with pytest.raises(SystemExit, match="ckpt"):
         main(["evaluate", "--data", str(tmp_path), "--platform", "cpu"])
+
+
+def test_evaluate_zero_shot(tmp_path, rng, capsys):
+    """--zero-shot: ensemble weights from a tokens file, accuracy over
+    labeled records, class order from the dataset's classes.json."""
+    ckpt = save_tiny_siglip(tmp_path / "ckpt")
+    pairs = [(rng.randint(0, 255, size=(16, 16, 3)).astype(np.uint8), i % 3)
+             for i in range(6)]
+    write_classification_records(tmp_path / "d.tfrecord", pairs,
+                                 encoding="raw")
+    # classes.json defines label-id order; tokens file is deliberately in a
+    # DIFFERENT order to prove the dataset order wins
+    (tmp_path / "classes.json").write_text(json.dumps(["ant", "bee", "fly"]))
+    tokens = tmp_path / "tokens.json"
+    tokens.write_text(json.dumps({
+        "fly": [[5, 6], [7, 8]],       # 2-template ensemble
+        "ant": [1, 2],                 # single row
+        "bee": [[3, 4]],
+    }))
+    rc = main(["evaluate", "--data", str(tmp_path / "d.tfrecord"),
+               "--batch-size", "4", "--ckpt", str(ckpt), "--model", "siglip",
+               "--zero-shot", str(tokens), "--platform", "cpu"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["examples"] == 6
+    assert out["classes"] == 3
+    assert out["prompts"] == 4
+    assert 0.0 <= out["zero_shot_top1"] <= 1.0
+
+
+def test_evaluate_zero_shot_rejects_vit(tmp_path, rng):
+    ckpt = save_tiny_vit(tmp_path / "ckpt")
+    pairs = [(rng.randint(0, 255, size=(16, 16, 3)).astype(np.uint8), 0)]
+    write_classification_records(tmp_path / "d.tfrecord", pairs,
+                                 encoding="raw")
+    tokens = tmp_path / "tokens.json"
+    tokens.write_text(json.dumps({"x": [1]}))
+    with pytest.raises(SystemExit, match="contrastive"):
+        main(["evaluate", "--data", str(tmp_path / "d.tfrecord"),
+              "--ckpt", str(ckpt), "--model", "vit",
+              "--zero-shot", str(tokens), "--platform", "cpu"])
