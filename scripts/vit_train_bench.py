@@ -18,8 +18,12 @@ BENCH = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
 
 
 def main() -> None:
+    # compile watchdog 300s (not the 240 default): the 24-layer full-unroll
+    # ViT-L step compiles noticeably slower than SigLIP-B's 12+12 towers on
+    # this single-core host; user argv still overrides
     os.execv(sys.executable, [sys.executable, str(BENCH),
-                              "--model", "vit_l16_384"] + sys.argv[1:])
+                              "--model", "vit_l16_384",
+                              "--compile-timeout", "300"] + sys.argv[1:])
 
 
 if __name__ == "__main__":
