@@ -11,6 +11,7 @@ from jimm_tpu.data.grain_pipeline import (TFRecordDataSource,
                                           grain_batches, make_grain_loader)
 from jimm_tpu.data.records import (classification_batches, decode_image,
                                    image_text_batches, iter_examples,
+                                   naflex_image_text_batches,
                                    pad_tokens, prep_image, resolve_paths,
                                    write_classification_records,
                                    write_image_text_records)
@@ -30,7 +31,8 @@ __all__ = [
     "CLIP_MEAN", "CLIP_STD", "SIGLIP_MEAN", "SIGLIP_STD",
     "TFRecordWriter", "write_tfrecord", "read_tfrecord", "crc32c",
     "masked_crc32c", "encode_example", "decode_example",
-    "image_text_batches", "classification_batches", "iter_examples",
+    "image_text_batches", "naflex_image_text_batches",
+    "classification_batches", "iter_examples",
     "decode_image", "resolve_paths", "prep_image", "pad_tokens",
     "write_image_text_records", "write_classification_records",
     "TFRecordDataSource", "make_grain_loader", "grain_batches",

@@ -198,6 +198,53 @@ def image_text_batches_from(examples: Iterator[dict], batch_size: int, *,
         yield images, tokens
 
 
+def naflex_image_text_batches(data: str | Sequence[str], batch_size: int, *,
+                              patch_size: int, max_num_patches: int,
+                              seq_len: int, pad_id: int = 0,
+                              mean=SIGLIP_MEAN, std=SIGLIP_STD,
+                              shuffle_buffer: int = 0, seed: int = 0,
+                              repeat: bool = True, shard_index: int = 0,
+                              shard_count: int = 1, skip_examples: int = 0,
+                              drop_remainder: bool = True):
+    """NaFlex contrastive batches: images keep their native aspect ratio
+    (resized to the largest patch-divisible grid within
+    ``max_num_patches``) instead of being squashed to a square. Yields
+    ``((patches, spatial_shapes, mask), tokens)`` — the image triple feeds
+    `SigLIP.encode_image_naflex` and the contrastive train steps
+    directly (`jimm_tpu.train.contrastive_loss_fn` accepts it as the
+    image argument). Beyond the reference, which has no NaFlex support."""
+    examples = iter_examples(resolve_paths(data), repeat=repeat,
+                             shuffle_buffer=shuffle_buffer, seed=seed,
+                             shard_index=shard_index, shard_count=shard_count)
+    return naflex_image_text_batches_from(
+        examples, batch_size, patch_size=patch_size,
+        max_num_patches=max_num_patches, seq_len=seq_len, pad_id=pad_id,
+        mean=mean, std=std, skip_examples=skip_examples,
+        drop_remainder=drop_remainder)
+
+
+def naflex_image_text_batches_from(examples: Iterator[dict],
+                                   batch_size: int, *, patch_size: int,
+                                   max_num_patches: int, seq_len: int,
+                                   pad_id: int = 0, mean=SIGLIP_MEAN,
+                                   std=SIGLIP_STD, skip_examples: int = 0,
+                                   drop_remainder: bool = True):
+    """NaFlex batch builder over any decoded-example stream — see
+    `naflex_image_text_batches`."""
+    from jimm_tpu.data.naflex import patchify_naflex
+    _skip(examples, skip_examples)
+    for chunk in _chunks(examples, batch_size, drop_remainder):
+        imgs = [to_float_normalized(
+            (decode_image(ex["image"][0], ex.get("shape"))
+             .astype(np.float32) / 255.0)[None], mean, std)[0]
+                for ex in chunk]
+        triple = patchify_naflex(imgs, patch_size=patch_size,
+                                 max_num_patches=max_num_patches)
+        tokens = np.stack([pad_tokens(ex["tokens"], seq_len, pad_id)
+                           for ex in chunk])
+        yield triple, tokens
+
+
 def classification_batches(data: str | Sequence[str], batch_size: int, *,
                            image_size: int, mean=SIGLIP_MEAN, std=SIGLIP_STD,
                            shuffle_buffer: int = 0, seed: int = 0,

@@ -199,3 +199,41 @@ def test_naflex_contrastive_training_step(rng, tmp_path):
     losses = [float(step(model, opt, nf, txt)["loss"]) for _ in range(5)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_naflex_file_dataset_to_train_step(rng, tmp_path):
+    """tfrecords of mixed-size images -> naflex_image_text_batches ->
+    contrastive train step: the full file-to-gradient NaFlex loop."""
+    from jimm_tpu import SigLIP
+    from jimm_tpu.data.records import (naflex_image_text_batches,
+                                       write_image_text_records)
+    from jimm_tpu.train import (OptimizerConfig,
+                                make_contrastive_train_step, make_optimizer)
+    pairs = []
+    for i, (h, w) in enumerate([(16, 48), (32, 32), (48, 16), (16, 16)]):
+        img = rng.randint(0, 255, size=(h, w, 3)).astype(np.uint8)
+        pairs.append((img, [i + 1, i + 2]))
+    write_image_text_records(tmp_path / "d.tfrecord", pairs, encoding="raw")
+
+    batches = naflex_image_text_batches(
+        str(tmp_path / "d.tfrecord"), 2, patch_size=16, max_num_patches=4,
+        seq_len=8, repeat=False, shuffle_buffer=0)
+    d = save_tiny_siglip2(tmp_path / "ckpt")
+    model = SigLIP.from_pretrained(d)
+    opt = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
+    step = make_contrastive_train_step("siglip")
+    seen = 0
+    shapes_seen = set()
+    for (patches, shapes, mask), tokens in batches:
+        assert patches.shape[1:] == (4, 16 * 16 * 3)
+        assert mask.shape[1] == 4
+        shapes_seen.update(map(tuple, shapes.tolist()))
+        out = step(model, opt,
+                   (jnp.asarray(patches), jnp.asarray(shapes),
+                    jnp.asarray(mask)), jnp.asarray(tokens))
+        assert np.isfinite(float(out["loss"]))
+        seen += len(tokens)
+    assert seen == 4
+    # aspect ratios survived: wide (16x48 -> 1x3), square (scaled up to the
+    # budget, 2x2), and tall (3x1) grids all appear
+    assert shapes_seen == {(1, 3), (2, 2), (3, 1)}
