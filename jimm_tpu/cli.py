@@ -297,6 +297,9 @@ def cmd_train(args: argparse.Namespace) -> int:
                                 make_contrastive_train_step, make_optimizer)
 
     fam = _family(args.preset)
+    if args.naflex and fam != "siglip":
+        raise SystemExit("--naflex trains SigLIP2-style models; "
+                         "use a siglip preset")
     cfg = preset(args.preset)
     if args.tiny:
         if args.from_pretrained:
@@ -523,7 +526,31 @@ def cmd_train(args: argparse.Namespace) -> int:
             loss_kind = args.loss or ("siglip_ring" if ring_ok
                                       else "siglip")
         step_fn = make_contrastive_train_step(loss_kind, mesh=mesh)
-        if args.data and args.loader == "grain":
+        if args.naflex:
+            # variable-resolution SigLIP2 training (beyond the reference)
+            if fam != "siglip":
+                raise SystemExit("--naflex trains SigLIP2-style models; "
+                                 "use a siglip preset")
+            if args.rules == "pp":
+                raise SystemExit("--naflex needs attention masks, which the "
+                                 "pipelined path does not support yet")
+            if args.data and (args.loader == "grain"
+                              or _is_tar_data(args.data)):
+                raise SystemExit("--naflex reads tfrecord shards (records "
+                                 "loader) or synthetic data")
+            naflex_kw = dict(patch_size=cfg.vision.patch_size,
+                             max_num_patches=cfg.vision.num_patches,
+                             seq_len=cfg.text.context_length)
+            if args.data:
+                from jimm_tpu.data.records import naflex_image_text_batches
+                data = naflex_image_text_batches(
+                    args.data, args.batch_size, **naflex_kw, **data_kw)
+            else:
+                from jimm_tpu.data.synthetic import naflex_contrastive_pairs
+                data = naflex_contrastive_pairs(
+                    args.batch_size, **naflex_kw,
+                    vocab_size=cfg.text.vocab_size, seed=args.seed)
+        elif args.data and args.loader == "grain":
             data = _grain_data("contrastive")
         elif args.data:
             if _is_tar_data(args.data):
@@ -552,7 +579,9 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     def place(batch):
         if mesh is None:
-            return tuple(jnp.asarray(b) for b in batch)
+            # tree-map: a NaFlex batch nests the image triple inside
+            import jax as _jax
+            return _jax.tree.map(jnp.asarray, batch)
         return shard_batch(batch, mesh, rules)
 
     data = PrefetchIterator(data, mesh=mesh, rules=rules) \
@@ -1214,6 +1243,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="sharding rules preset (requires --mesh)")
     sp.add_argument("--loss", default=None,
                     choices=["clip", "clip_ring", "siglip", "siglip_ring"])
+    sp.add_argument("--naflex", action="store_true",
+                    help="variable-resolution SigLIP2 training: NaFlex "
+                         "(patches, shapes, mask) batches from tfrecords "
+                         "(or synthetic mixed-aspect data) instead of "
+                         "square images")
     sp.add_argument("--attn-impl", default=None,
                     choices=["auto", "xla", "flash", "ring", "ulysses",
                              "saveable"],

@@ -65,3 +65,35 @@ def contrastive_pairs(batch_size: int, *, image_size: int = 32,
         text = rng.randint(4, vocab_size, size=(batch_size, seq_len))
         text[:, 0] = labels  # class token leads the caption
         yield images[lo:hi], text[lo:hi].astype(np.int32)
+
+
+def naflex_contrastive_pairs(batch_size: int, *, patch_size: int = 16,
+                             max_num_patches: int = 4, vocab_size: int = 64,
+                             seq_len: int = 8, seed: int = 0,
+                             shard_index: int = 0, shard_count: int = 1):
+    """`contrastive_pairs` in NaFlex form: the square blob images are
+    resized to a cycling set of aspect ratios (wide / square / tall) before
+    patchification, so every batch exercises variable grids, per-sample
+    position resampling, and the padding mask. Yields
+    ``((patches, spatial_shapes, mask), tokens)``."""
+    from jimm_tpu.data.naflex import patchify_naflex
+    from jimm_tpu.data.preprocess import resize_bilinear
+
+    base = patch_size * 2  # native square size before aspect warping
+    aspects = [(1.0, 3.0), (1.0, 1.0), (3.0, 1.0), (1.0, 2.0)]
+    pairs = contrastive_pairs(batch_size, image_size=base,
+                              vocab_size=vocab_size, seq_len=seq_len,
+                              seed=seed, shard_index=shard_index,
+                              shard_count=shard_count)
+    i = 0
+    while True:
+        images, tokens = next(pairs)
+        warped = []
+        for img in images:
+            ah, aw = aspects[i % len(aspects)]
+            i += 1
+            h = max(patch_size, int(base * ah))
+            w = max(patch_size, int(base * aw))
+            warped.append(resize_bilinear(img[None], (h, w))[0])
+        yield (patchify_naflex(warped, patch_size=patch_size,
+                               max_num_patches=max_num_patches), tokens)

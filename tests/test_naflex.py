@@ -237,3 +237,28 @@ def test_naflex_file_dataset_to_train_step(rng, tmp_path):
     # aspect ratios survived: wide (16x48 -> 1x3), square (scaled up to the
     # budget, 2x2), and tall (3x1) grids all appear
     assert shapes_seen == {(1, 3), (2, 2), (3, 1)}
+
+
+def test_cli_train_naflex_synthetic(tmp_path):
+    """`train --naflex`: variable-resolution contrastive training from the
+    CLI, synthetic mixed-aspect data, ring loss over an FSDP+TP mesh."""
+    from jimm_tpu.cli import main
+    rc = main(["train", "--preset", "siglip2-base-patch16-256", "--tiny",
+               "--naflex", "--steps", "3", "--batch-size", "8",
+               "--platform", "cpu", "--host-devices", "8",
+               "--mesh", "data=4,model=2", "--rules", "fsdp_tp",
+               "--loss", "siglip_ring",
+               "--metrics-file", str(tmp_path / "m.jsonl")])
+    assert rc == 0
+    import json as _json
+    lines = [_json.loads(line)
+             for line in open(tmp_path / "m.jsonl").read().splitlines()]
+    assert len(lines) == 3
+    assert all(np.isfinite(rec["loss"]) for rec in lines)
+
+
+def test_cli_train_naflex_rejects_vit():
+    from jimm_tpu.cli import main
+    with pytest.raises(SystemExit, match="siglip"):
+        main(["train", "--preset", "vit-base-patch16-224", "--tiny",
+              "--naflex", "--steps", "1", "--platform", "cpu"])
