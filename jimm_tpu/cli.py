@@ -683,6 +683,9 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
     # file-pipeline convention (classify's center-crop is for wild images)
     norm = _norm_for(fam)
 
+    if args.naflex and (fam == "vit" or args.zero_shot):
+        raise SystemExit("--naflex applies to clip/siglip retrieval "
+                         "evaluation (not vit accuracy or --zero-shot)")
     fwd = jit_forward(model)
     n = 0
     if args.zero_shot:
@@ -708,18 +711,49 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
             raise SystemExit(f"no examples in {args.data}")
         metrics = {"top1_accuracy": round(correct / n, 4)}
     else:
-        if _is_tar_data(args.data):
-            from jimm_tpu.data.webdataset import (
-                wds_image_text_batches as image_text_batches)
+        if args.naflex:
+            # variable-resolution retrieval: aspect-preserving NaFlex
+            # batches + masked logits instead of the square resize
+            if fam != "siglip":
+                raise SystemExit("--naflex evaluates SigLIP2-style models; "
+                                 "use --model siglip")
+            if _is_tar_data(args.data):
+                raise SystemExit("--naflex reads tfrecord shards")
+            from jimm_tpu.data.records import naflex_image_text_batches
+
+            def batches():
+                return naflex_image_text_batches(
+                    args.data, args.batch_size,
+                    patch_size=cfg.vision.patch_size,
+                    max_num_patches=cfg.vision.num_patches,
+                    seq_len=cfg.text.context_length, repeat=False,
+                    shuffle_buffer=0, drop_remainder=False, **norm)
+
+            logits_fn = nnx.jit(
+                lambda m, im, tok: m.logits_naflex(*im, tok))
         else:
-            from jimm_tpu.data.records import image_text_batches
+            if _is_tar_data(args.data):
+                from jimm_tpu.data.webdataset import (
+                    wds_image_text_batches as image_text_batches)
+            else:
+                from jimm_tpu.data.records import image_text_batches
+
+            def batches():
+                return image_text_batches(
+                    args.data, args.batch_size,
+                    image_size=cfg.vision.image_size,
+                    seq_len=cfg.text.context_length, repeat=False,
+                    shuffle_buffer=0, drop_remainder=False, **norm)
+
+            logits_fn = nnx.jit(lambda m, im, tok: m(im, tok))
         i2t = t2i = 0
-        for images, tokens in image_text_batches(
-                args.data, args.batch_size, image_size=cfg.vision.image_size,
-                seq_len=cfg.text.context_length, repeat=False,
-                shuffle_buffer=0, drop_remainder=False, **norm):
+        for images, tokens in batches():
+            if args.naflex:
+                images = tuple(jnp.asarray(a) for a in images)
+            else:
+                images = jnp.asarray(images)
             logits = np.asarray(
-                fwd(jnp.asarray(images), jnp.asarray(tokens)), np.float32)
+                logits_fn(model, images, jnp.asarray(tokens)), np.float32)
             diag = np.arange(len(logits))
             i2t += int((logits.argmax(axis=1) == diag).sum())
             t2i += int((logits.argmax(axis=0) == diag).sum())
@@ -1316,6 +1350,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "records (clip/siglip): {label: [ids]} or "
                          "{label: [[ids], ...]} for prompt ensembles; "
                          "class order from the dataset's classes.json")
+    sp.add_argument("--naflex", action="store_true",
+                    help="SigLIP2 retrieval over NaFlex variable-resolution "
+                         "batches (aspect-preserving) instead of the square "
+                         "resize")
     sp.add_argument("--image-size", type=int, default=None,
                     help="with --from-pretrained: the --image-size the "
                          "training run used")

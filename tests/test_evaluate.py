@@ -121,3 +121,22 @@ def test_evaluate_zero_shot_rejects_vit(tmp_path, rng):
         main(["evaluate", "--data", str(tmp_path / "d.tfrecord"),
               "--ckpt", str(ckpt), "--model", "vit",
               "--zero-shot", str(tokens), "--platform", "cpu"])
+
+
+def test_evaluate_naflex_retrieval(tmp_path, rng, capsys):
+    """--naflex: retrieval over mixed-size images, aspect preserved."""
+    from hf_util import save_tiny_siglip2
+    ckpt = save_tiny_siglip2(tmp_path / "ckpt")
+    pairs = []
+    for i, (h, w) in enumerate([(16, 48), (32, 32), (48, 16), (16, 32)]):
+        pairs.append((rng.randint(0, 255, size=(h, w, 3)).astype(np.uint8),
+                      [i + 1, i + 2]))
+    write_image_text_records(tmp_path / "d.tfrecord", pairs, encoding="raw")
+    rc = main(["evaluate", "--data", str(tmp_path / "d.tfrecord"),
+               "--batch-size", "2", "--ckpt", str(ckpt), "--model", "siglip",
+               "--naflex", "--platform", "cpu"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["examples"] == 4
+    for k in ("retrieval_r1_image_to_text", "retrieval_r1_text_to_image"):
+        assert 0.0 <= out[k] <= 1.0
