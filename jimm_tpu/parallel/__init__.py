@@ -5,7 +5,8 @@ from jimm_tpu.parallel.pipeline import pipeline_forward
 from jimm_tpu.parallel.ulysses import ulysses_attention
 from jimm_tpu.parallel.ring_attention import (ring_attention, zigzag_order,
                                               zigzag_shard, zigzag_unshard)
-from jimm_tpu.parallel.sharding import (DATA_PARALLEL, FSDP, FSDP_TP,
+from jimm_tpu.parallel.sharding import (DATA_PARALLEL, FSDP, FSDP_SP,
+                                        FSDP_TP,
                                         HYBRID_FSDP_TP, PIPELINE,
                                         PRESET_RULES, REPLICATED,
                                         SEQUENCE_PARALLEL, TENSOR_PARALLEL,
@@ -20,6 +21,6 @@ __all__ = [
     "logical_constraint", "pipeline_forward", "ring_attention", "ulysses_attention",
     "zigzag_order", "zigzag_shard", "zigzag_unshard",
     "REPLICATED", "DATA_PARALLEL", "TENSOR_PARALLEL",
-    "FSDP", "FSDP_TP", "HYBRID_FSDP_TP", "SEQUENCE_PARALLEL", "PIPELINE",
+    "FSDP", "FSDP_SP", "FSDP_TP", "HYBRID_FSDP_TP", "SEQUENCE_PARALLEL", "PIPELINE",
     "PRESET_RULES",
 ]

@@ -109,6 +109,13 @@ HYBRID_FSDP_TP = ShardingRules(
 #: activations sharded over the sequence axis.
 SEQUENCE_PARALLEL = ShardingRules(batch="data", seq="seq", pos="seq")
 
+#: Long-context training at scale: FSDP/ZeRO-3 parameters over "data"
+#: composed with sequence-sharded activations over "seq" (ring or ulysses
+#: attention across it). The memory-critical pair — params AND the long
+#: sequence both sharded.
+FSDP_SP = ShardingRules(embed="data", batch="data", seq="seq", pos="seq",
+                        mlp=None, heads=None)
+
 #: Pipeline parallelism: the stacked ``layers`` axis sharded over "stage";
 #: forward runs the microbatched ppermute loop
 #: (`jimm_tpu/parallel/pipeline.py`, enabled by ``pipeline=True`` in the
@@ -123,6 +130,7 @@ PRESET_RULES: dict[str, ShardingRules] = {
     "fsdp_tp": FSDP_TP,
     "hybrid_fsdp_tp": HYBRID_FSDP_TP,
     "sp": SEQUENCE_PARALLEL,
+    "fsdp_sp": FSDP_SP,
     "pp": PIPELINE,
 }
 
