@@ -31,7 +31,8 @@ if str(REPO) not in sys.path:  # jimm_tpu.configs import, any invocation style
     sys.path.insert(0, str(REPO))
 
 
-def load_records(path: pathlib.Path, phase_filter: bool) -> list[dict]:
+def load_records(path: pathlib.Path, phase_filter: bool,
+                 phase: str = "sweep") -> list[dict]:
     recs = []
     for line in path.read_text(errors="replace").splitlines():
         line = line.strip()
@@ -41,7 +42,7 @@ def load_records(path: pathlib.Path, phase_filter: bool) -> list[dict]:
             rec = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if phase_filter and rec.get("phase") != "sweep":
+        if phase_filter and rec.get("phase") != phase:
             continue
         if "variant" not in rec or not isinstance(rec.get("mfu"), float):
             continue
@@ -156,13 +157,17 @@ def main() -> int:
                         "so CLI presets and bench.py default to it")
     p.add_argument("--preset", default="siglip-base-patch16-256",
                    help="preset the sweep measured (adoption key)")
+    p.add_argument("--phase", default="sweep",
+                   help="MEASUREMENTS.jsonl phase tag to rank (the watcher "
+                        "persists the ViT sweep as 'vit_sweep')")
     args = p.parse_args()
 
     path = pathlib.Path(args.src) if args.src else REPO / "MEASUREMENTS.jsonl"
     if not path.exists():
         print(f"no records: {path} does not exist", file=sys.stderr)
         return 1
-    recs = load_records(path, phase_filter=args.src is None)
+    recs = load_records(path, phase_filter=args.src is None,
+                        phase=args.phase)
     if not recs:
         print(f"no usable sweep records (variant + float mfu) in {path}",
               file=sys.stderr)

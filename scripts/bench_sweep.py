@@ -46,6 +46,20 @@ def parse_variant(s: str) -> dict:
     return out
 
 
+#: ViT-L/16-384 grid (metric of record #2): smaller batch lever — the
+#: 1.1 TFLOP/image model fits ~48/chip with aggressive remat, not 256
+VIT_GRID = [
+    "remat=dots",
+    "remat=dots,ln=fused",
+    "remat=dots,fused_qkv=1",
+    "remat=dots+ln",
+    "remat=dots+ln+act",
+    "remat=dots,moment=bf16",
+    "remat=dots+attn,attn=saveable",
+    "remat=dots,batch=48",
+    "remat=dots+ln+act,batch=48",
+]
+
 STANDARD_GRID = [
     "remat=dots",
     "remat=dots,ln=fused",
@@ -68,11 +82,17 @@ STANDARD_GRID = [
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--model", default="siglip_b16_256",
+                   choices=["siglip_b16_256", "vit_l16_384"],
+                   help="which bench config to sweep (matches bench.py "
+                        "--model)")
+    p.add_argument("--batch", type=int, default=0,
+                   help="0 = auto (128 siglip / 32 vit-L)")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--unroll", type=int, default=12,
-                   help="default scan unroll for variants that don't set it")
+    p.add_argument("--unroll", type=int, default=0,
+                   help="default scan unroll for variants that don't set "
+                        "it; 0 = full depth (12 siglip / 24 vit-L)")
     p.add_argument("--variant", action="append", default=None,
                    help="comma-separated k=v list; repeatable. Keys: remat, "
                         "attn, ln, fused_qkv, unroll, moment, donate, batch")
@@ -93,29 +113,44 @@ def main():
     import numpy as np
     from flax import nnx
 
-    from jimm_tpu import SigLIP, preset
+    from jimm_tpu import SigLIP, VisionTransformer, preset
     from jimm_tpu.configs import parse_remat, with_runtime
-    from jimm_tpu.train import (OptimizerConfig, make_contrastive_train_step,
-                                make_optimizer, mfu)
+    from jimm_tpu.train import (OptimizerConfig, make_classifier_train_step,
+                                make_contrastive_train_step, make_optimizer,
+                                mfu)
     from jimm_tpu.train.metrics import train_step_flops
 
-    variants = [parse_variant(v) for v in (args.variant or STANDARD_GRID)]
+    is_vit = args.model == "vit_l16_384"
+    default_grid = VIT_GRID if is_vit else STANDARD_GRID
+    variants = [parse_variant(v) for v in (args.variant or default_grid)]
+    args.batch = args.batch or (32 if is_vit else 128)
+    args.unroll = args.unroll or (24 if is_vit else 12)
     rng = np.random.RandomState(0)
     if args.tiny:
-        from jimm_tpu.configs import SigLIPConfig, TextConfig, VisionConfig
-        base = SigLIPConfig(
-            vision=VisionConfig(image_size=32, patch_size=16, width=64,
+        from jimm_tpu.configs import (SigLIPConfig, TextConfig, ViTConfig,
+                                      VisionConfig)
+        tiny_vision = VisionConfig(image_size=32, patch_size=16, width=64,
+                                   depth=2, num_heads=2, mlp_dim=128,
+                                   act="gelu_tanh", pooling="map")
+        if is_vit:
+            base = ViTConfig(
+                vision=VisionConfig(image_size=32, patch_size=16, width=64,
+                                    depth=2, num_heads=2, mlp_dim=128,
+                                    ln_eps=1e-12),
+                num_classes=16)
+        else:
+            base = SigLIPConfig(
+                vision=tiny_vision,
+                text=TextConfig(vocab_size=64, context_length=8, width=64,
                                 depth=2, num_heads=2, mlp_dim=128,
-                                act="gelu_tanh", pooling="map"),
-            text=TextConfig(vocab_size=64, context_length=8, width=64,
-                            depth=2, num_heads=2, mlp_dim=128,
-                            act="gelu_tanh", causal=False, pooling="last",
-                            proj_bias=True),
-            projection_dim=64)
+                                act="gelu_tanh", causal=False,
+                                pooling="last", proj_bias=True),
+                projection_dim=64)
         args.batch = min(args.batch, 8)
         args.unroll = min(args.unroll, 2)
     else:
-        base = preset("siglip-base-patch16-256")
+        base = preset("vit-large-patch16-384" if is_vit
+                      else "siglip-base-patch16-256")
     max_batch = max([args.batch] + [int(v["batch"]) for v in variants
                                     if "batch" in v])
     if args.tiny:
@@ -126,8 +161,11 @@ def main():
     images_np = gen.standard_normal(
         (max_batch, base.vision.image_size, base.vision.image_size, 3),
         dtype=np.float32)
-    text_np = rng.randint(1, base.text.vocab_size,
-                          size=(max_batch, base.text.context_length))
+    if is_vit:
+        labels_np = rng.randint(0, base.num_classes, size=(max_batch,))
+    else:
+        text_np = rng.randint(1, base.text.vocab_size,
+                              size=(max_batch, base.text.context_length))
 
     for v in variants:
         vb = min(int(v.get("batch", args.batch)), max_batch)
@@ -143,28 +181,40 @@ def main():
             # host materialization through the last optimizer update —
             # block_until_ready can lie on remote-tunnel platforms
             float(metrics["loss"])
-            float(nnx.state(model, nnx.Param)["logit_scale"].get_value())
+            if is_vit:
+                float(nnx.state(model, nnx.Param)
+                      ["classifier"]["kernel"].get_value()[0, 0])
+            else:
+                float(nnx.state(model, nnx.Param)["logit_scale"].get_value())
 
         model = optimizer = step_fn = metrics = None
         try:
-            model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
-                           param_dtype=jnp.bfloat16)
+            donate = v.get("donate", "1") in ("1", "true")
             moment = {"bf16": "bfloat16"}.get(v.get("moment"))
+            if is_vit:
+                model = VisionTransformer(cfg, rngs=nnx.Rngs(0),
+                                          dtype=jnp.bfloat16,
+                                          param_dtype=jnp.bfloat16)
+                step_fn = make_classifier_train_step(donate=donate)
+                data = (jnp.asarray(images_np[:vb], jnp.bfloat16),
+                        jnp.asarray(labels_np[:vb], jnp.int32))
+            else:
+                model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
+                               param_dtype=jnp.bfloat16)
+                step_fn = make_contrastive_train_step("siglip", donate=donate)
+                data = (jnp.asarray(images_np[:vb], jnp.bfloat16),
+                        jnp.asarray(text_np[:vb], jnp.int32))
             optimizer = make_optimizer(model, OptimizerConfig(
                 learning_rate=1e-3, moment_dtype=moment))
-            step_fn = make_contrastive_train_step(
-                "siglip", donate=v.get("donate", "1") in ("1", "true"))
-            images = jnp.asarray(images_np[:vb], jnp.bfloat16)
-            text = jnp.asarray(text_np[:vb], jnp.int32)
 
             t_c0 = time.perf_counter()
             for _ in range(args.warmup):
-                metrics = step_fn(model, optimizer, images, text)
+                metrics = step_fn(model, optimizer, *data)
             sync(model, metrics)
             compile_s = time.perf_counter() - t_c0
             t0 = time.perf_counter()
             for _ in range(args.steps):
-                metrics = step_fn(model, optimizer, images, text)
+                metrics = step_fn(model, optimizer, *data)
             sync(model, metrics)
             dt = (time.perf_counter() - t0) / args.steps
         except Exception as e:  # OOM on an aggressive save policy: keep going
@@ -178,6 +228,7 @@ def main():
         flops = train_step_flops(cfg, vb)
         print(json.dumps({
             "variant": v,
+            "model": args.model,
             "batch": vb,
             "step_time_ms": round(dt * 1e3, 2),
             "images_per_sec": round(vb / dt, 1),

@@ -157,6 +157,12 @@ while true; do
   run_phase crossover   900 python -m scripts.attn_crossover --causal || continue
   run_phase longctx     900 python -m scripts.longcontext_bench --bwd || continue
   run_phase longctx_c   900 python -m scripts.longcontext_bench --bwd --causal || continue
+  # metric-of-record #2 tuning: the ViT-L lever grid, adopted under its own
+  # preset key (rides the same fidelity filters)
+  run_phase vit_sweep  3600 python -m scripts.bench_sweep --model vit_l16_384 --steps 30 || continue
+  if [ -e "$STATE/vit_sweep.done" ] && [ ! -e "$STATE/vit_adopt.done" ]; then
+    run_phase vit_adopt 300 env JIMM_PLATFORM=cpu python -m scripts.adopt_sweep --phase vit_sweep --preset vit-large-patch16-384 --apply || continue
+  fi
   if [ -f scripts/dump_goldens.py ]; then
     # needs network egress, not the chip; a blocked attempt still leaves
     # tests/goldens/ATTEMPTS.log evidence (VERDICT r4 item 4)
