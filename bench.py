@@ -135,7 +135,8 @@ def resolve_adopted_defaults(args: argparse.Namespace, on_tpu: bool) -> bool:
             entry = (json.loads(ADOPTED_RUNTIME_PATH.read_text())
                      ["presets"][BENCH_PRESET[args.model]])
             adopted = dict(entry.get("variant", {}))
-        except (OSError, KeyError, ValueError):
+        except (OSError, KeyError, ValueError, TypeError, AttributeError):
+            # missing file OR valid-JSON-wrong-container corruption: builtins
             adopted = {}
     used = False
 
@@ -330,15 +331,16 @@ def parent_main(args: argparse.Namespace) -> int:
 
 def _watchdog(seconds: int, exit_code: int, what: str):
     """SIGALRM guard: interrupts a tunnel-blocked syscall where a python-
-    level timeout can't. Call the returned disarm() on success."""
-    def on_alarm(signum, frame):
+    level timeout can't. Call the returned disarm() on success. (Shared
+    implementation: `scripts/_watchdog.py` — stdlib-only, safe to arm
+    before any jax/jimm import.)"""
+    from scripts._watchdog import hard_watchdog
+
+    def emit():
         print(f"{what} watchdog: no progress after {seconds}s",
               file=sys.stderr)
-        os._exit(exit_code)
 
-    signal.signal(signal.SIGALRM, on_alarm)
-    signal.alarm(seconds)
-    return lambda: signal.alarm(0)
+    return hard_watchdog(seconds, exit_code, emit)
 
 
 def _soft_alarm(seconds: int):

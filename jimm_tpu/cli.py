@@ -360,7 +360,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         from jimm_tpu.configs import adopted_runtime
         for k, v in adopted_runtime(args.preset).items():
             rt.setdefault(k, v)
-    if args.scan_unroll > 1:
+    if args.scan_unroll >= 1:  # any explicit value wins, including 1
         rt["scan_unroll"] = args.scan_unroll
     elif args.scan_unroll == 0 and not args.from_pretrained:
         # auto: full unroll on TPU, resolved against the preset's depth
@@ -1013,16 +1013,18 @@ def cmd_classify(args: argparse.Namespace) -> int:
                              "checkpoint dir holding vocab.json/merges.txt), "
                              "or --tokens-file")
         labels = [s.strip() for s in args.labels.split(",") if s.strip()]
+        template = args.template or "a photo of a {}"
         if args.ensemble:
             # CLIP-paper recipe: average each class over prompt templates
-            # (normalize, mean, renormalize); "|"-separated --template
-            # supplies a custom set, else the builtin 7-template subset
+            # (normalize, mean, renormalize); an explicit --template
+            # supplies the set ("|"-separated; a single entry works), else
+            # the builtin 7-template subset
             from jimm_tpu.utils.zero_shot import TEMPLATES, expand_templates
             templates = (tuple(t for t in args.template.split("|") if t)
-                         if "|" in args.template else TEMPLATES)
+                         if args.template else TEMPLATES)
             prompts = expand_templates(labels, templates)
         else:
-            prompts = [args.template.format(label) for label in labels]
+            prompts = [template.format(label) for label in labels]
         rows = None
         if not args.tokenizer and args.model == "clip":
             # zero-dependency path: every HF CLIP checkpoint ships its BPE
@@ -1372,8 +1374,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--model", default="clip", choices=["clip", "siglip"])
     sp.add_argument("--labels", default=None,
                     help='comma-separated label names, e.g. "cat,dog"')
-    sp.add_argument("--template", default="a photo of a {}",
-                    help="prompt template applied to each label")
+    sp.add_argument("--template", default=None,
+                    help="prompt template applied to each label (default "
+                         "'a photo of a {}'); with --ensemble, a "
+                         "\"|\"-separated template set")
     sp.add_argument("--tokenizer", default=None,
                     help="HF tokenizer for --labels (optional tooling)")
     sp.add_argument("--tokens-file", default=None,

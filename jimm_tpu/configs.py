@@ -478,9 +478,15 @@ def adopted_runtime(preset_name: str) -> dict[str, Any]:
     failing deep inside the first jit trace."""
     try:
         data = json.loads(ADOPTED_RUNTIME_PATH.read_text())
+        fields = (data.get("presets", {}).get(preset_name, {})
+                  .get("runtime", {}))
     except (OSError, json.JSONDecodeError):
         return {}
-    fields = data.get("presets", {}).get(preset_name, {}).get("runtime", {})
+    except (AttributeError, TypeError) as e:  # valid JSON, wrong containers
+        import warnings
+        warnings.warn(f"ignoring malformed adopted_runtime.json: {e}",
+                      stacklevel=2)
+        return {}
     try:
         _check_runtime_fields(fields)
     except (TypeError, ValueError) as e:

@@ -85,15 +85,20 @@ def naflex_contrastive_pairs(batch_size: int, *, patch_size: int = 16,
                               vocab_size=vocab_size, seq_len=seq_len,
                               seed=seed, shard_index=shard_index,
                               shard_count=shard_count)
-    i = 0
+    lo = shard_index * (batch_size // shard_count)
+    step = 0
     while True:
         images, tokens = next(pairs)
         warped = []
-        for img in images:
-            ah, aw = aspects[i % len(aspects)]
-            i += 1
+        for j, img in enumerate(images):
+            # aspect keyed by GLOBAL row, preserving contrastive_pairs'
+            # invariant: per-process shards reassemble into exactly the
+            # single-process stream (shapes included)
+            gidx = step * batch_size + lo + j
+            ah, aw = aspects[gidx % len(aspects)]
             h = max(patch_size, int(base * ah))
             w = max(patch_size, int(base * aw))
             warped.append(resize_bilinear(img[None], (h, w))[0])
+        step += 1
         yield (patchify_naflex(warped, patch_size=patch_size,
                                max_num_patches=max_num_patches), tokens)

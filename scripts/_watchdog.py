@@ -1,0 +1,31 @@
+"""Hard SIGALRM watchdog shared by the TPU measurement entry points.
+
+Deliberately imports NOTHING beyond the stdlib: every caller arms the
+watchdog BEFORE the first jax/jimm import, because backend plugin discovery
+can touch the axon tunnel whose failure mode is an indefinite hang that only
+a signal interrupts. (bench.py, scripts/flash_compiled_check.py, and
+scripts/profile_step.py all key their retry logic on the exit codes armed
+here — keep the semantics in this one place.)
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Callable
+
+
+def hard_watchdog(seconds: int, exit_code: int,
+                  emit: Callable[[], None]) -> Callable[[], None]:
+    """Arm SIGALRM: after ``seconds`` with no disarm, call ``emit()`` (print
+    the failure evidence — it must not raise) and ``os._exit(exit_code)``.
+    Returns a ``disarm()`` that cancels the alarm."""
+    def on_alarm(signum, frame):
+        try:
+            emit()
+        finally:
+            os._exit(exit_code)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    return lambda: signal.alarm(0)

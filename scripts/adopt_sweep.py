@@ -168,6 +168,19 @@ def main() -> int:
         return 1
     recs = load_records(path, phase_filter=args.src is None,
                         phase=args.phase)
+    # records tag the bench model they measured; a ViT sweep log must never
+    # adopt under the SigLIP preset key (or vice versa). Pre-r5 records
+    # without the tag pass through.
+    expected_model = {"siglip-base-patch16-256": "siglip_b16_256",
+                      "vit-large-patch16-384": "vit_l16_384"}.get(args.preset)
+    dropped = [r for r in recs
+               if expected_model and r.get("model")
+               and r["model"] != expected_model]
+    if dropped:
+        print(f"ignoring {len(dropped)} records measured on "
+              f"{dropped[0]['model']!r} (adopting for {args.preset!r})",
+              file=sys.stderr)
+        recs = [r for r in recs if r not in dropped]
     if not recs:
         print(f"no usable sweep records (variant + float mfu) in {path}",
               file=sys.stderr)

@@ -20,20 +20,19 @@ from __future__ import annotations
 
 import json
 import os
-import signal
 import sys
 import time
 
 
 def _watchdog(seconds: int, what: str):
-    def on_alarm(signum, frame):
+    from scripts._watchdog import hard_watchdog
+
+    def emit():
         print(json.dumps({"metric": "flash_compiled_parity", "value": 0.0,
                           "error": f"{what} watchdog after {seconds}s "
                                    "(tunnel hang?)"}), flush=True)
-        os._exit(17)
-    signal.signal(signal.SIGALRM, on_alarm)
-    signal.alarm(seconds)
-    return lambda: signal.alarm(0)
+
+    return hard_watchdog(seconds, 17, emit)
 
 
 def main() -> int:

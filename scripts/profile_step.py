@@ -15,7 +15,6 @@ import argparse
 import glob
 import json
 import os
-import signal
 import sys
 import time
 
@@ -24,14 +23,14 @@ def _watchdog(seconds: int, what: str):
     """SIGALRM hard-exit guard: the axon tunnel fails by hanging, and only
     a signal interrupts a blocked runtime call. JSON error line first so
     the watcher's persist() records the failed attempt."""
-    def on_alarm(signum, frame):
+    from scripts._watchdog import hard_watchdog
+
+    def emit():
         print(json.dumps({"metric": "profile_step", "value": 0.0,
                           "error": f"{what} watchdog after {seconds}s "
                                    "(tunnel hang?)"}), flush=True)
-        os._exit(17)
-    signal.signal(signal.SIGALRM, on_alarm)
-    signal.alarm(seconds)
-    return lambda: signal.alarm(0)
+
+    return hard_watchdog(seconds, 17, emit)
 
 
 def apply_adopted(args: argparse.Namespace) -> bool:

@@ -58,7 +58,10 @@ EOF
 bench_clean() {  # did the bench phase log produce a real TPU datapoint?
   python - "$1" <<'EOF'
 import json, sys
-ok = False
+# bench.py's contract: the LAST parseable result line is authoritative — a
+# datapoint-first emission ("mfu_crosscheck": "pending") is superseded by
+# the final line, which may have withheld the metric (value 0 + mfu_error)
+last = None
 for line in open(sys.argv[1], errors="replace"):
     line = line.strip()
     if not line.startswith("{"):
@@ -67,9 +70,10 @@ for line in open(sys.argv[1], errors="replace"):
         rec = json.loads(line)
     except Exception:
         continue
-    if ("metric" in rec and "error" not in rec
-            and rec.get("value", 0) > 0 and "cpu" not in rec["metric"]):
-        ok = True
+    if "metric" in rec:
+        last = rec
+ok = (last is not None and "error" not in last and "mfu_error" not in last
+      and last.get("value", 0) > 0 and "cpu" not in last["metric"])
 sys.exit(0 if ok else 1)
 EOF
 }
