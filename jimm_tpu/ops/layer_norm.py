@@ -53,9 +53,18 @@ def _bwd_kernel(x_ref, g_ref, mu_ref, rstd_ref, do_ref, dx_ref, dg_ref,
     m1 = jnp.mean(dy, axis=1, keepdims=True)
     m2 = jnp.mean(dy * xhat, axis=1, keepdims=True)
     dx_ref[...] = (rstd * (dy - m1 - xhat * m2)).astype(dx_ref.dtype)
-    # per-row-block partials; summed across blocks by the wrapper
-    dg_ref[0] = jnp.sum(do * xhat, axis=0)
-    db_ref[0] = jnp.sum(do, axis=0)
+    # dscale/dbias accumulate into ONE (8, F) block revisited by every grid
+    # step (TPU grids run sequentially, so read-modify-write is ordered).
+    # Mosaic requires the sublane dim divisible by 8, so the partial lives
+    # in row 0 of an 8-row block; the wrapper sums the zero rows away.
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_ref[...] = jnp.zeros_like(dg_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    row0 = jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0) == 0
+    dg_ref[...] += jnp.where(row0, jnp.sum(do * xhat, axis=0)[None, :], 0.0)
+    db_ref[...] += jnp.where(row0, jnp.sum(do, axis=0)[None, :], 0.0)
 
 
 def _pad_rows(x: jax.Array, target: int) -> jax.Array:
@@ -130,13 +139,13 @@ def _ln_bwd(eps, res, do):
         ],
         out_specs=[
             pl.BlockSpec((br, f), lambda i: (i, 0)),
-            pl.BlockSpec((1, f), lambda i: (i, 0)),
-            pl.BlockSpec((1, f), lambda i: (i, 0)),
+            pl.BlockSpec((8, f), lambda i: (0, 0)),
+            pl.BlockSpec((8, f), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rp, f), x.dtype),
-            jax.ShapeDtypeStruct((n_b, f), jnp.float32),
-            jax.ShapeDtypeStruct((n_b, f), jnp.float32),
+            jax.ShapeDtypeStruct((8, f), jnp.float32),
+            jax.ShapeDtypeStruct((8, f), jnp.float32),
         ],
         interpret=_interpret(),
     )(_pad_rows(x, rp), scale, _pad_rows(mu, rp), _pad_rows(rstd, rp),

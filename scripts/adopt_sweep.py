@@ -173,14 +173,16 @@ def main() -> int:
     # without the tag pass through.
     expected_model = {"siglip-base-patch16-256": "siglip_b16_256",
                       "vit-large-patch16-384": "vit_l16_384"}.get(args.preset)
-    dropped = [r for r in recs
-               if expected_model and r.get("model")
-               and r["model"] != expected_model]
+    def _model_mismatch(r):
+        return (expected_model and r.get("model")
+                and r["model"] != expected_model)
+
+    dropped = [r for r in recs if _model_mismatch(r)]
     if dropped:
         print(f"ignoring {len(dropped)} records measured on "
               f"{dropped[0]['model']!r} (adopting for {args.preset!r})",
               file=sys.stderr)
-        recs = [r for r in recs if r not in dropped]
+        recs = [r for r in recs if not _model_mismatch(r)]
     if not recs:
         print(f"no usable sweep records (variant + float mfu) in {path}",
               file=sys.stderr)

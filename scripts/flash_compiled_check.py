@@ -24,11 +24,12 @@ import sys
 import time
 
 
-def _watchdog(seconds: int, what: str):
+def _watchdog(seconds: int, what: str,
+              metric: str = "flash_compiled_parity"):
     from scripts._watchdog import hard_watchdog
 
     def emit():
-        print(json.dumps({"metric": "flash_compiled_parity", "value": 0.0,
+        print(json.dumps({"metric": metric, "value": 0.0,
                           "error": f"{what} watchdog after {seconds}s "
                                    "(tunnel hang?)"}), flush=True)
 
@@ -126,6 +127,63 @@ def main() -> int:
             "elapsed_s": round(time.monotonic() - t0, 1),
             "device": jax.devices()[0].device_kind,
         }), flush=True)
+
+    # Fused LayerNorm kernel: same interpret-only risk as flash. Row counts
+    # cover one partial block (300 -> pad to 512, 2 grid steps) and many
+    # grid steps (2048 -> 8), i.e. the multi-block dscale/dbias
+    # accumulation Mosaic rejected before the r5 block-spec fix; features
+    # cover SigLIP-B (768) and ViT-L (1024) widths.
+    from jimm_tpu.ops.layer_norm import layer_norm
+
+    def ln_ref(x, g, b):
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + 1e-6) * g + b
+        return y.astype(x.dtype)
+
+    for rows, feat, dtype in ((300, 768, "f32"), (2048, 768, "bf16"),
+                              (2048, 1024, "bf16")):
+        dt = np.float32 if dtype == "f32" else jnp.bfloat16
+        x = jnp.asarray(rng.randn(rows, feat).astype(np.float32), dt)
+        g = jnp.asarray(1.0 + 0.1 * rng.randn(feat).astype(np.float32))
+        b = jnp.asarray(0.1 * rng.randn(feat).astype(np.float32))
+        atol_f = 2e-5 if dtype == "f32" else 2e-2
+        atol_b = 5e-4 if dtype == "f32" else 5e-2
+        guard = _watchdog(300, f"ln rows={rows} feat={feat} {dtype}",
+                          metric="ln_compiled_parity")
+        t0 = time.monotonic()
+
+        def loss_ln(x, g, b):
+            return jnp.sum(layer_norm(x, g, b).astype(jnp.float32) ** 2)
+
+        def loss_lref(x, g, b):
+            return jnp.sum(ln_ref(x, g, b).astype(jnp.float32) ** 2)
+
+        fwd_err = float(np.abs(
+            np.asarray(layer_norm(x, g, b), np.float32)
+            - np.asarray(ln_ref(x, g, b), np.float32)).max())
+        gf = jax.grad(loss_ln, argnums=(0, 1, 2))(x, g, b)
+        gr = jax.grad(loss_lref, argnums=(0, 1, 2))(x, g, b)
+        # dscale/dbias are O(rows)-magnitude sums — compare relative
+        bwd_err = max(
+            float((np.abs(np.asarray(a, np.float32)
+                          - np.asarray(b_, np.float32))
+                   / (1.0 + np.abs(np.asarray(b_, np.float32)))).max())
+            for a, b_ in zip(gf, gr))
+        guard()
+        ok = fwd_err <= atol_f and bwd_err <= atol_b
+        failures += not ok
+        print(json.dumps({
+            "metric": "ln_compiled_parity",
+            "case": f"r{rows}_f{feat}_{dtype}",
+            "value": 1.0 if ok else 0.0,
+            "fwd_max_abs_err": fwd_err, "bwd_max_rel_err": bwd_err,
+            "atol_fwd": atol_f, "atol_bwd": atol_b,
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "device": jax.devices()[0].device_kind,
+        }), flush=True)
+        cases.append(("ln", rows, feat))
 
     print(json.dumps({
         "metric": "flash_compiled_parity_summary",
