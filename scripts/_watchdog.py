@@ -1,31 +1,61 @@
-"""Hard SIGALRM watchdog shared by the TPU measurement entry points.
+"""Hard watchdog shared by the TPU measurement entry points.
 
 Deliberately imports NOTHING beyond the stdlib: every caller arms the
 watchdog BEFORE the first jax/jimm import, because backend plugin discovery
 can touch the axon tunnel whose failure mode is an indefinite hang that only
-a signal interrupts. (bench.py, scripts/flash_compiled_check.py, and
-scripts/profile_step.py all key their retry logic on the exit codes armed
-here — keep the semantics in this one place.)
+an external nudge interrupts. (bench.py, scripts/flash_compiled_check.py,
+and scripts/profile_step.py all key their retry logic on the exit codes
+armed here — keep the semantics in this one place.)
+
+Two mechanisms, belt and braces:
+
+- SIGALRM: fires in the main thread's eval loop. Sufficient when the hang
+  is at a point that returns to the interpreter (or an EINTR-able syscall).
+- A daemon thread: Python signal handlers only run when the MAIN thread
+  re-enters the bytecode loop; a PJRT wait parked on a condition variable
+  is signal-restarted and never returns, so SIGALRM alone can sit armed
+  forever while the tunnel is down. The thread needs only the GIL (which a
+  blocked-but-released C call isn't holding) to emit and _exit.
 """
 
 from __future__ import annotations
 
 import os
 import signal
+import threading
 from typing import Callable
 
 
 def hard_watchdog(seconds: int, exit_code: int,
                   emit: Callable[[], None]) -> Callable[[], None]:
-    """Arm SIGALRM: after ``seconds`` with no disarm, call ``emit()`` (print
-    the failure evidence — it must not raise) and ``os._exit(exit_code)``.
-    Returns a ``disarm()`` that cancels the alarm."""
-    def on_alarm(signum, frame):
+    """After ``seconds`` with no disarm, call ``emit()`` (print the failure
+    evidence — it must not raise) and ``os._exit(exit_code)``. Returns a
+    ``disarm()`` that cancels both mechanisms."""
+    fired = threading.Lock()  # emit exactly once even if both fire
+
+    def die():
+        if not fired.acquire(blocking=False):
+            return
         try:
             emit()
         finally:
             os._exit(exit_code)
 
+    def on_alarm(signum, frame):
+        die()
+
     signal.signal(signal.SIGALRM, on_alarm)
     signal.alarm(seconds)
-    return lambda: signal.alarm(0)
+    cancel = threading.Event()
+    # +5 s grace so SIGALRM (whose emit runs on the main thread, with
+    # context) wins when the interpreter is actually responsive
+    t = threading.Timer(seconds + 5, lambda: cancel.is_set() or die())
+    t.daemon = True
+    t.start()
+
+    def disarm():
+        signal.alarm(0)
+        cancel.set()
+        t.cancel()
+
+    return disarm

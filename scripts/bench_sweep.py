@@ -14,8 +14,33 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import pathlib
 import time
+
+MEASUREMENTS = pathlib.Path(__file__).resolve().parent.parent \
+    / "MEASUREMENTS.jsonl"
+
+
+def measured_variants(model: str) -> list[dict]:
+    """Variant dicts that already have a real-TPU measurement (any attempt:
+    a record printed before a hang is still a completed measurement)."""
+    done = []
+    try:
+        lines = MEASUREMENTS.read_text(errors="replace").splitlines()
+    except OSError:
+        return done
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if (rec.get("model") == model and isinstance(rec.get("variant"), dict)
+                and isinstance(rec.get("mfu"), (int, float))
+                and rec.get("mfu") > 0 and not rec.get("tiny")
+                and "tpu" in str(rec.get("device", "")).lower()):
+            done.append(rec["variant"])
+    return done
 
 
 VARIANT_KEYS = frozenset(
@@ -99,6 +124,16 @@ def main():
     p.add_argument("--tiny", action="store_true",
                    help="smoke-test the whole grid on a tiny model (CPU "
                         "validation of the sweep itself)")
+    p.add_argument("--no-skip", action="store_true",
+                   help="re-measure variants that already have a good TPU "
+                        "record in MEASUREMENTS.jsonl (default: skip them, "
+                        "so a retried attempt resumes where the last one "
+                        "hung instead of restarting the grid)")
+    p.add_argument("--variant-timeout", type=int, default=int(
+        os.environ.get("SWEEP_VARIANT_TIMEOUT_S", "600")),
+                   help="hard per-variant watchdog (compile + steps); a "
+                        "mid-variant tunnel hang costs this much, not the "
+                        "whole phase window")
     args = p.parse_args()
 
     import jimm_tpu.utils.env
@@ -167,7 +202,25 @@ def main():
         text_np = rng.randint(1, base.text.vocab_size,
                               size=(max_batch, base.text.context_length))
 
+    already = [] if (args.no_skip or args.tiny) \
+        else measured_variants(args.model)
+    from scripts._watchdog import hard_watchdog
+
     for v in variants:
+        if v in already:
+            print(json.dumps({"variant": v, "model": args.model,
+                              "skipped": "already measured "
+                                         "(MEASUREMENTS.jsonl)"}),
+                  flush=True)
+            continue
+
+        def _hang_record(v=v):
+            print(json.dumps({"variant": v, "model": args.model,
+                              "error": f"variant watchdog after "
+                                       f"{args.variant_timeout}s "
+                                       "(tunnel hang?)"}), flush=True)
+
+        disarm = hard_watchdog(args.variant_timeout, 21, _hang_record)
         vb = min(int(v.get("batch", args.batch)), max_batch)
         cfg = with_runtime(
             base,
@@ -222,6 +275,7 @@ def main():
                   flush=True)
             continue
         finally:
+            disarm()  # remaining work is host arithmetic — can't hang
             # drop this variant's buffers even on failure, so an OOM'd
             # variant doesn't double-book HBM under the next one
             del model, optimizer, step_fn, metrics

@@ -191,3 +191,32 @@ def test_bench_resolve_adopted_defaults(tmp_path, monkeypatch):
     a = bench.parse_args(["--model", "vit_l16_384"])
     assert not bench.resolve_adopted_defaults(a, on_tpu=True)
     assert a.remat == "dots"
+
+
+def test_sweep_skips_already_measured_tpu_variants(tmp_path, monkeypatch):
+    """bench_sweep's retry-resume: only same-model, real-TPU, non-tiny,
+    successful records mark a grid variant as already measured."""
+    import scripts.bench_sweep as bs
+    recs = [
+        {"model": "siglip_b16_256", "variant": {"remat": "dots"},
+         "mfu": 0.446, "device": "TPU v5 lite"},
+        # errored attempt: must be retried
+        {"model": "siglip_b16_256", "variant": {"remat": "dots",
+                                                "ln": "fused"}, "error": "x"},
+        # CPU validation record: never marks a TPU variant done
+        {"model": "siglip_b16_256", "variant": {"remat": "dots",
+                                                "batch": "192"},
+         "mfu": 0.4, "device": "cpu"},
+        # other bench model: independent
+        {"model": "vit_l16_384", "variant": {"remat": "dots"},
+         "mfu": 0.3, "device": "TPU v5 lite"},
+        # tiny smoke: low fidelity
+        {"model": "siglip_b16_256", "variant": {"remat": "dots+ln"},
+         "mfu": 0.4, "device": "TPU v5 lite", "tiny": True},
+    ]
+    p = _write(tmp_path, recs)
+    monkeypatch.setattr(bs, "MEASUREMENTS", p)
+    assert bs.measured_variants("siglip_b16_256") == [{"remat": "dots"}]
+    assert bs.measured_variants("vit_l16_384") == [{"remat": "dots"}]
+    monkeypatch.setattr(bs, "MEASUREMENTS", tmp_path / "absent.jsonl")
+    assert bs.measured_variants("siglip_b16_256") == []
