@@ -220,3 +220,45 @@ def test_sweep_skips_already_measured_tpu_variants(tmp_path, monkeypatch):
     assert bs.measured_variants("vit_l16_384") == [{"remat": "dots"}]
     monkeypatch.setattr(bs, "MEASUREMENTS", tmp_path / "absent.jsonl")
     assert bs.measured_variants("siglip_b16_256") == []
+
+
+def test_hard_watchdog_thread_backstop_fires_without_sigalrm(tmp_path):
+    """A PJRT wait parked on a condition variable never lets the SIGALRM
+    Python handler run; the daemon-thread backstop must fire anyway."""
+    import subprocess
+    import sys
+    code = """
+import signal, sys, time
+# neuter SIGALRM delivery so only the thread backstop can fire
+real_signal = signal.signal
+signal.signal = lambda *a: None
+signal.alarm = lambda *a: 0
+sys.path.insert(0, %r)
+from scripts._watchdog import hard_watchdog
+hard_watchdog(1, 7, lambda: print("backstop fired", flush=True))
+time.sleep(30)
+""" % ("/root/repo",)
+    t0 = time.time()
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=25)
+    assert proc.returncode == 7, (proc.returncode, proc.stderr)
+    assert "backstop fired" in proc.stdout
+    assert time.time() - t0 < 20  # fired at ~6 s, not the sleep's 30
+
+
+def test_hard_watchdog_disarm_cancels_backstop():
+    import subprocess
+    import sys
+    code = """
+import sys, time
+sys.path.insert(0, %r)
+from scripts._watchdog import hard_watchdog
+disarm = hard_watchdog(1, 7, lambda: print("fired", flush=True))
+disarm()
+time.sleep(8)
+print("survived", flush=True)
+""" % ("/root/repo",)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=25)
+    assert proc.returncode == 0, (proc.returncode, proc.stderr)
+    assert "survived" in proc.stdout
