@@ -83,6 +83,7 @@ VIT_GRID = [
     "remat=dots+attn,attn=saveable",
     "remat=dots,batch=48",
     "remat=dots+ln+act,batch=48",
+    "remat=dots+ln+act,ln=fused,batch=48",
 ]
 
 STANDARD_GRID = [
@@ -102,6 +103,12 @@ STANDARD_GRID = [
     "remat=dots,batch=192",
     "remat=dots,batch=256",
     "remat=dots+ln+act,batch=256",
+    # composites: fused one-pass LN stacked on saved-LN/act remat (fused
+    # bwd helps even when the fwd outputs are checkpointed), with and
+    # without the batch lever
+    "remat=dots,ln=fused,batch=256",
+    "remat=dots+ln+act,ln=fused",
+    "remat=dots+ln+act,ln=fused,batch=256",
 ]
 
 
