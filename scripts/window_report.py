@@ -1,0 +1,94 @@
+"""Summarize MEASUREMENTS.jsonl: what each TPU-tunnel window measured.
+
+Every line the resident watcher persists carries (ts, phase, attempt, rc)
+provenance. This tool folds them into a per-phase table so "which windows
+existed and what each one bought" is answerable at a glance:
+
+    python -m scripts.window_report               # human table
+    python -m scripts.window_report --markdown    # rows for docs/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+
+MEASUREMENTS = pathlib.Path(__file__).resolve().parent.parent \
+    / "MEASUREMENTS.jsonl"
+
+
+def load(path: pathlib.Path) -> list[dict]:
+    recs = []
+    try:
+        lines = path.read_text(errors="replace").splitlines()
+    except OSError:
+        return recs
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            recs.append(rec)
+    return recs
+
+
+def describe(rec: dict) -> str:
+    """One cell summarizing what the record measured (or why it failed)."""
+    if "error" in rec:
+        return "ERROR: " + str(rec["error"])[:60]
+    if "skipped" in rec:
+        return "skipped: " + str(rec["skipped"])[:40]
+    parts = []
+    if isinstance(rec.get("variant"), dict):
+        parts.append(",".join(f"{k}={v}" for k, v in rec["variant"].items()))
+    if "case" in rec:
+        parts.append(str(rec["case"]))
+    if "metric" in rec and "variant" not in rec:
+        parts.append(str(rec["metric"]))
+    for k in ("mfu", "images_per_sec", "step_time_ms"):
+        if isinstance(rec.get(k), (int, float)):
+            parts.append(f"{k}={rec[k]}")
+    if "value" in rec and "mfu" not in rec:
+        parts.append(f"value={rec['value']}")
+    return "  ".join(parts) or "(no payload)"
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--markdown", action="store_true")
+    p.add_argument("--file", default=str(MEASUREMENTS))
+    args = p.parse_args()
+    recs = load(pathlib.Path(args.file))
+    if not recs:
+        print("no records")
+        return
+    if args.markdown:
+        try:
+            print("| ts (UTC) | phase | try | rc | result |")
+            print("|---|---|---|---|---|")
+            for r in recs:
+                print(f"| {r.get('ts', '?')} | {r.get('phase', '?')} "
+                      f"| {r.get('attempt', '?')} | {r.get('rc', '?')} "
+                      f"| {describe(r)} |")
+        except BrokenPipeError:  # `| head` is a normal way to use this
+            pass
+        return
+    width = max(len(str(r.get("phase", "?"))) for r in recs)
+    for r in recs:
+        print(f"{r.get('ts', '?'):20} {str(r.get('phase', '?')):{width}} "
+              f"a{r.get('attempt', '?')} rc={r.get('rc', '?'):>3} "
+              f"{describe(r)}")
+    phases = {}
+    for r in recs:
+        ph = str(r.get("phase", "?"))
+        ok = "error" not in r and "skipped" not in r
+        good, total = phases.get(ph, (0, 0))
+        phases[ph] = (good + ok, total + 1)
+    print("\nper phase (clean/total):",
+          "  ".join(f"{ph}={g}/{t}" for ph, (g, t) in sorted(phases.items())))
+
+
+if __name__ == "__main__":
+    main()
