@@ -262,3 +262,27 @@ print("survived", flush=True)
                           capture_output=True, text=True, timeout=25)
     assert proc.returncode == 0, (proc.returncode, proc.stderr)
     assert "survived" in proc.stdout
+
+
+def test_window_report_summarizes_phases(tmp_path, capsys):
+    import scripts.window_report as wr
+    p = tmp_path / "m.jsonl"
+    p.write_text("\n".join([
+        json.dumps({"ts": "t1", "phase": "sweep", "attempt": 1, "rc": 124,
+                    "variant": {"remat": "dots"}, "mfu": 0.45,
+                    "step_time_ms": 251.0}),
+        json.dumps({"ts": "t2", "phase": "sweep", "attempt": 1, "rc": 124,
+                    "variant": {"ln": "fused"}, "error": "boom"}),
+        "not json",
+    ]))
+    import sys
+    old = sys.argv
+    try:
+        sys.argv = ["window_report", "--file", str(p)]
+        wr.main()
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "remat=dots" in out and "mfu=0.45" in out
+    assert "ERROR: boom" in out
+    assert "sweep=1/2" in out
