@@ -123,7 +123,8 @@ run_phase() {  # run_phase <name> <timeout_s> <cmd...>; bench needs a clean rec
   local ok=$rc
   # bench.py exits 0 on every failure path by design — require a clean
   # TPU record before declaring the metric-of-record phases done
-  if { [ "$name" = bench ] || [ "$name" = vit_train ]; } && [ $rc -eq 0 ] \
+  if { [ "$name" = bench ] || [ "$name" = vit_train ] \
+      || [ "$name" = bench_adopted ]; } && [ $rc -eq 0 ] \
       && ! bench_clean "$plog"; then
     ok=99
   fi
@@ -148,17 +149,26 @@ while true; do
     continue
   fi
   echo "probe $i: TPU ALIVE $(date -u +%H:%M:%S)"
-  # 14 variants x (compile + 30 steps); partial JSON lines are persisted
-  # even on timeout, and .jax_cache makes a retry's compiles cheap
-  run_phase sweep      4500 python -m scripts.bench_sweep --steps 30 || continue
-  # adoption runs on CPU off the sweep records; cheap, no chip time needed,
-  # but must precede bench so bench.py measures the adopted defaults
-  if [ -e "$STATE/sweep.done" ] && [ ! -e "$STATE/adopt.done" ]; then
-    run_phase adopt     300 env JIMM_PLATFORM=cpu python -m scripts.adopt_sweep --apply || continue
-  fi
+  # Windows are scarce (r5: one 19-min window in the first 3 h) — spend
+  # them on the metrics of record FIRST. bench's builtin defaults equal
+  # the measured-best known config (remat=dots, unroll 12, 44.6%), so
+  # running it before the sweep completes loses nothing; vit_train is
+  # metric of record #2 and has never had a datapoint.
   run_phase bench       950 env BENCH_TIMEOUT_S=900 python bench.py || continue
   if [ -f scripts/vit_train_bench.py ]; then
     run_phase vit_train 950 env BENCH_TIMEOUT_S=900 python -m scripts.vit_train_bench || continue
+  fi
+  # lever grid: per-variant watchdog + skip-resume; partial JSON lines are
+  # persisted even on timeout, and .jax_cache makes a retry's compiles cheap
+  run_phase sweep      4500 python -m scripts.bench_sweep --steps 30 || continue
+  # adoption runs on CPU off the sweep records; cheap, no chip time needed
+  if [ -e "$STATE/sweep.done" ] && [ ! -e "$STATE/adopt.done" ]; then
+    run_phase adopt     300 env JIMM_PLATFORM=cpu python -m scripts.adopt_sweep --apply || continue
+  fi
+  # re-measure the benchmark of record at the adopted (measured-best)
+  # defaults once adoption has happened
+  if [ -e "$STATE/adopt.done" ]; then
+    run_phase bench_adopted 950 env BENCH_TIMEOUT_S=900 python bench.py || continue
   fi
   if [ -f scripts/flash_compiled_check.py ]; then
     run_phase flashchk  900 python -m scripts.flash_compiled_check || continue
