@@ -33,15 +33,9 @@ if str(REPO) not in sys.path:  # jimm_tpu.configs import, any invocation style
 
 def load_records(path: pathlib.Path, phase_filter: bool,
                  phase: str = "sweep") -> list[dict]:
+    from scripts._measurements import read_records
     recs = []
-    for line in path.read_text(errors="replace").splitlines():
-        line = line.strip()
-        if not line.startswith("{"):
-            continue
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            continue
+    for rec in read_records(path):
         if phase_filter and rec.get("phase") != phase:
             continue
         if "variant" not in rec or not isinstance(rec.get("mfu"), float):

@@ -18,29 +18,35 @@ import os
 import pathlib
 import time
 
-MEASUREMENTS = pathlib.Path(__file__).resolve().parent.parent \
-    / "MEASUREMENTS.jsonl"
+from scripts._measurements import MEASUREMENTS, read_records
 
 
 def measured_variants(model: str) -> list[dict]:
     """Variant dicts that already have a real-TPU measurement (any attempt:
     a record printed before a hang is still a completed measurement)."""
-    done = []
-    try:
-        lines = MEASUREMENTS.read_text(errors="replace").splitlines()
-    except OSError:
-        return done
-    for line in lines:
-        try:
-            rec = json.loads(line)
-        except ValueError:
-            continue
+    return [rec["variant"] for rec in read_records(MEASUREMENTS)
+            if rec.get("model") == model
+            and isinstance(rec.get("variant"), dict)
+            and isinstance(rec.get("mfu"), (int, float))
+            and rec.get("mfu") > 0 and not rec.get("tiny")
+            and "tpu" in str(rec.get("device", "")).lower()]
+
+
+def hung_variants(model: str, min_hangs: int = 2) -> list[dict]:
+    """Variant dicts whose measurement hit the per-variant watchdog at
+    least ``min_hangs`` times. A variant that deterministically hangs
+    (variant-specific compile pathology, not a dropped tunnel) would
+    otherwise be retried first on every resume, burn its full watchdog
+    budget each window, and starve every grid row after it."""
+    counts: dict[str, int] = {}
+    variants: dict[str, dict] = {}
+    for rec in read_records(MEASUREMENTS):
         if (rec.get("model") == model and isinstance(rec.get("variant"), dict)
-                and isinstance(rec.get("mfu"), (int, float))
-                and rec.get("mfu") > 0 and not rec.get("tiny")
-                and "tpu" in str(rec.get("device", "")).lower()):
-            done.append(rec["variant"])
-    return done
+                and "variant watchdog" in str(rec.get("error", ""))):
+            key = json.dumps(rec["variant"], sort_keys=True)
+            counts[key] = counts.get(key, 0) + 1
+            variants[key] = rec["variant"]
+    return [variants[k] for k, n in counts.items() if n >= min_hangs]
 
 
 VARIANT_KEYS = frozenset(
@@ -211,6 +217,7 @@ def main():
 
     already = [] if (args.no_skip or args.tiny) \
         else measured_variants(args.model)
+    hung = [] if (args.no_skip or args.tiny) else hung_variants(args.model)
     from scripts._watchdog import hard_watchdog
 
     for v in variants:
@@ -218,6 +225,12 @@ def main():
             print(json.dumps({"variant": v, "model": args.model,
                               "skipped": "already measured "
                                          "(MEASUREMENTS.jsonl)"}),
+                  flush=True)
+            continue
+        if v in hung:
+            print(json.dumps({"variant": v, "model": args.model,
+                              "skipped": "hit the variant watchdog twice — "
+                                         "deferred (--no-skip to force)"}),
                   flush=True)
             continue
 

@@ -115,8 +115,12 @@ run_phase() {  # run_phase <name> <timeout_s> <cmd...>; bench needs a clean rec
   persist "$name" "$plog" "$((tries + 1))" "$rc"
   # a failed attempt that still landed a measurement (sweep variants before
   # a mid-grid hang) is progress, not a strike — refund the try so the
-  # skip-resume logic gets as many windows as the grid needs
-  if [ $rc -ne 0 ] && grep -q '"mfu"' "$plog" 2>/dev/null; then
+  # skip-resume logic gets as many windows as the grid needs. ONLY the
+  # resumable sweep phases: for bench/vit_train a printed record + nonzero
+  # exit would repeat identically every window (no skip-resume there), so
+  # refunding would starve the queue behind a permanently-failing phase.
+  if { [ "$name" = sweep ] || [ "$name" = vit_sweep ]; } \
+      && [ $rc -ne 0 ] && grep -q '"mfu"' "$plog" 2>/dev/null; then
     echo "$tries" > "$STATE/$name.tries"
     echo "=== phase $name failed but made progress (try refunded) ==="
   fi

@@ -11,27 +11,14 @@ existed and what each one bought" is answerable at a glance:
 from __future__ import annotations
 
 import argparse
-import json
 import pathlib
+import sys
 
-MEASUREMENTS = pathlib.Path(__file__).resolve().parent.parent \
-    / "MEASUREMENTS.jsonl"
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
-
-def load(path: pathlib.Path) -> list[dict]:
-    recs = []
-    try:
-        lines = path.read_text(errors="replace").splitlines()
-    except OSError:
-        return recs
-    for line in lines:
-        try:
-            rec = json.loads(line)
-        except ValueError:
-            continue
-        if isinstance(rec, dict):
-            recs.append(rec)
-    return recs
+from scripts._measurements import MEASUREMENTS, read_records as load
 
 
 def describe(rec: dict) -> str:
@@ -76,18 +63,22 @@ def main() -> None:
             pass
         return
     width = max(len(str(r.get("phase", "?"))) for r in recs)
-    for r in recs:
-        print(f"{r.get('ts', '?'):20} {str(r.get('phase', '?')):{width}} "
-              f"a{r.get('attempt', '?')} rc={r.get('rc', '?'):>3} "
-              f"{describe(r)}")
-    phases = {}
-    for r in recs:
-        ph = str(r.get("phase", "?"))
-        ok = "error" not in r and "skipped" not in r
-        good, total = phases.get(ph, (0, 0))
-        phases[ph] = (good + ok, total + 1)
-    print("\nper phase (clean/total):",
-          "  ".join(f"{ph}={g}/{t}" for ph, (g, t) in sorted(phases.items())))
+    try:
+        for r in recs:
+            print(f"{r.get('ts', '?'):20} {str(r.get('phase', '?')):{width}} "
+                  f"a{r.get('attempt', '?')} rc={r.get('rc', '?'):>3} "
+                  f"{describe(r)}")
+        phases = {}
+        for r in recs:
+            ph = str(r.get("phase", "?"))
+            ok = "error" not in r and "skipped" not in r
+            good, total = phases.get(ph, (0, 0))
+            phases[ph] = (good + ok, total + 1)
+        print("\nper phase (clean/total):",
+              "  ".join(f"{ph}={g}/{t}"
+                        for ph, (g, t) in sorted(phases.items())))
+    except BrokenPipeError:  # `| head` is a normal way to use this
+        pass
 
 
 if __name__ == "__main__":

@@ -2,6 +2,7 @@
 and the shared soft-alarm guard."""
 
 import json
+import pathlib
 import time
 
 import scripts.adopt_sweep as adopt
@@ -237,7 +238,7 @@ sys.path.insert(0, %r)
 from scripts._watchdog import hard_watchdog
 hard_watchdog(1, 7, lambda: print("backstop fired", flush=True))
 time.sleep(30)
-""" % ("/root/repo",)
+""" % (str(pathlib.Path(__file__).resolve().parents[1]),)
     t0 = time.time()
     proc = subprocess.run([sys.executable, "-c", code],
                           capture_output=True, text=True, timeout=25)
@@ -257,7 +258,7 @@ disarm = hard_watchdog(1, 7, lambda: print("fired", flush=True))
 disarm()
 time.sleep(8)
 print("survived", flush=True)
-""" % ("/root/repo",)
+""" % (str(pathlib.Path(__file__).resolve().parents[1]),)
     proc = subprocess.run([sys.executable, "-c", code],
                           capture_output=True, text=True, timeout=25)
     assert proc.returncode == 0, (proc.returncode, proc.stderr)
