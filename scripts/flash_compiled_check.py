@@ -20,8 +20,29 @@ from __future__ import annotations
 
 import json
 import os
+import pathlib
 import sys
 import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def proven_cases() -> set[tuple[str, str]]:
+    """(metric, case) pairs already recorded clean on a real TPU — a
+    retried phase attempt (the 15 cold-cache compiles can outlive one
+    window) resumes at the first unproven case instead of recompiling
+    everything. JIMM_FLASHCHK_NO_SKIP=1 forces a full re-run."""
+    if os.environ.get("JIMM_FLASHCHK_NO_SKIP"):
+        return set()
+    from scripts._measurements import read_records
+    return {(r["metric"], str(r.get("case")))
+            for r in read_records()
+            if r.get("metric") in ("flash_compiled_parity",
+                                   "ln_compiled_parity")
+            and r.get("case") and r.get("value") == 1.0
+            and "tpu" in str(r.get("device", "")).lower()}
 
 
 def _watchdog(seconds: int, what: str,
@@ -83,7 +104,14 @@ def main() -> int:
                      for _ in range(3))
 
     failures = 0
+    done = proven_cases()
     for seq, causal, dtype in cases:
+        case = f"seq{seq}_causal{int(causal)}_{dtype}"
+        if ("flash_compiled_parity", case) in done:
+            print(json.dumps({"metric": "flash_compiled_parity",
+                              "case": case, "skipped": "already proven"}),
+                  flush=True)
+            continue
         q, k, v = qkv(seq, dtype)
         # fwd/bwd tolerance: fp32 kernel ~1e-5-scale; bf16 inputs dominate
         # error (~8-bit mantissa) so compare in f32 with a wider band
@@ -118,7 +146,7 @@ def main() -> int:
         failures += not ok
         print(json.dumps({
             "metric": "flash_compiled_parity",
-            "case": f"seq{seq}_causal{int(causal)}_{dtype}",
+            "case": case,
             "value": 1.0 if ok else 0.0,
             "fwd_max_abs_err": fwd_err,
             "bwd_max_abs_err": bwd_err,
@@ -144,6 +172,13 @@ def main() -> int:
 
     for rows, feat, dtype in ((300, 768, "f32"), (2048, 768, "bf16"),
                               (2048, 1024, "bf16")):
+        case = f"r{rows}_f{feat}_{dtype}"
+        if ("ln_compiled_parity", case) in done:
+            print(json.dumps({"metric": "ln_compiled_parity",
+                              "case": case, "skipped": "already proven"}),
+                  flush=True)
+            cases.append(("ln", rows, feat))
+            continue
         dt = np.float32 if dtype == "f32" else jnp.bfloat16
         x = jnp.asarray(rng.randn(rows, feat).astype(np.float32), dt)
         g = jnp.asarray(1.0 + 0.1 * rng.randn(feat).astype(np.float32))
@@ -176,7 +211,7 @@ def main() -> int:
         failures += not ok
         print(json.dumps({
             "metric": "ln_compiled_parity",
-            "case": f"r{rows}_f{feat}_{dtype}",
+            "case": case,
             "value": 1.0 if ok else 0.0,
             "fwd_max_abs_err": fwd_err, "bwd_max_rel_err": bwd_err,
             "atol_fwd": atol_f, "atol_bwd": atol_b,

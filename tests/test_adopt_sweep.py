@@ -287,3 +287,27 @@ def test_window_report_summarizes_phases(tmp_path, capsys):
     assert "remat=dots" in out and "mfu=0.45" in out
     assert "ERROR: boom" in out
     assert "sweep=1/2" in out
+
+
+def test_flashchk_resumes_at_unproven_cases(tmp_path, monkeypatch):
+    """A retried compiled-parity phase skips cases already recorded clean
+    on a real TPU (value 1.0); failures, CPU records and unseen cases run."""
+    import scripts._measurements as m
+    import scripts.flash_compiled_check as fc
+    p = tmp_path / "m.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in [
+        {"metric": "flash_compiled_parity", "case": "seq512_causal0_f32",
+         "value": 1.0, "device": "TPU v5 lite"},
+        {"metric": "flash_compiled_parity", "case": "seq512_causal1_f32",
+         "value": 0.0, "device": "TPU v5 lite"},
+        {"metric": "ln_compiled_parity", "case": "r300_f768_f32",
+         "value": 1.0, "device": "cpu"},
+        {"metric": "ln_compiled_parity", "case": "r2048_f768_bf16",
+         "value": 1.0, "device": "TPU v5 lite"},
+    ]))
+    monkeypatch.setattr(m, "MEASUREMENTS", p)
+    assert fc.proven_cases() == {
+        ("flash_compiled_parity", "seq512_causal0_f32"),
+        ("ln_compiled_parity", "r2048_f768_bf16")}
+    monkeypatch.setenv("JIMM_FLASHCHK_NO_SKIP", "1")
+    assert fc.proven_cases() == set()
