@@ -143,6 +143,42 @@ run_phase() {  # run_phase <name> <timeout_s> <cmd...>; bench needs a clean rec
   fi
 }
 
+adopt_refresh() {  # adopt_refresh <phase> <preset-args...>
+  # Adoption is cheap CPU work off MEASUREMENTS.jsonl — run it whenever the
+  # phase has NEW records, not only after the full grid completes, so a
+  # window that measured a better config benefits the very next bench run
+  # even if the sweep never finishes (windows are scarce).
+  local phase=$1; shift
+  local n last
+  n=$(grep -c "\"phase\": \"$phase\"" /root/repo/MEASUREMENTS.jsonl \
+      2>/dev/null || echo 0)
+  last=$(cat "$STATE/adopt_$phase.count" 2>/dev/null || echo 0)
+  [ "$n" -gt "$last" ] || return 0
+  if env JIMM_PLATFORM=cpu timeout 300 \
+      python -m scripts.adopt_sweep --phase "$phase" "$@" --apply; then
+    echo "$n" > "$STATE/adopt_$phase.count"
+    echo "=== adopt($phase) refreshed at $n records $(date -u +%H:%M:%S) ==="
+  else
+    echo "=== adopt($phase) refresh failed (rc=$?) $(date -u +%H:%M:%S) ==="
+  fi
+}
+
+bench_adopted_phase() {
+  # Re-measure the benchmark of record whenever the ADOPTED CONFIG CHANGES
+  # (hash-keyed, not once-ever): a later window's better sweep result gets
+  # its own bench datapoint. Tries reset when the config changes.
+  [ -f jimm_tpu/adopted_runtime.json ] || return 0
+  local cur prev
+  cur=$(sha256sum jimm_tpu/adopted_runtime.json | cut -d' ' -f1)
+  prev=$(cat "$STATE/bench_adopted.cfg" 2>/dev/null || echo none)
+  if [ "$cur" != "$prev" ]; then
+    rm -f "$STATE/bench_adopted.done" "$STATE/bench_adopted.gave_up" \
+          "$STATE/bench_adopted.tries"
+    echo "$cur" > "$STATE/bench_adopted.cfg"
+  fi
+  run_phase bench_adopted 950 env BENCH_TIMEOUT_S=900 python bench.py
+}
+
 echo "watcher r5 started $(date -u +%F' '%H:%M:%S) head=$(git rev-parse --short HEAD)"
 i=0
 while true; do
@@ -164,16 +200,12 @@ while true; do
   fi
   # lever grid: per-variant watchdog + skip-resume; partial JSON lines are
   # persisted even on timeout, and .jax_cache makes a retry's compiles cheap
-  run_phase sweep      4500 python -m scripts.bench_sweep --steps 30 || continue
-  # adoption runs on CPU off the sweep records; cheap, no chip time needed
-  if [ -e "$STATE/sweep.done" ] && [ ! -e "$STATE/adopt.done" ]; then
-    run_phase adopt     300 env JIMM_PLATFORM=cpu python -m scripts.adopt_sweep --apply || continue
+  if ! run_phase sweep 4500 python -m scripts.bench_sweep --steps 30; then
+    adopt_refresh sweep --preset siglip-base-patch16-256
+    continue
   fi
-  # re-measure the benchmark of record at the adopted (measured-best)
-  # defaults once adoption has happened
-  if [ -e "$STATE/adopt.done" ]; then
-    run_phase bench_adopted 950 env BENCH_TIMEOUT_S=900 python bench.py || continue
-  fi
+  adopt_refresh sweep --preset siglip-base-patch16-256
+  bench_adopted_phase || continue
   if [ -f scripts/flash_compiled_check.py ]; then
     # 15 compiled cases (12 flash + 3 fused-LN) x fwd+bwd+oracle compiles:
     # a cold cache needs well over the old 900 s
@@ -189,10 +221,11 @@ while true; do
   run_phase longctx_c   900 python -m scripts.longcontext_bench --bwd --causal || continue
   # metric-of-record #2 tuning: the ViT-L lever grid, adopted under its own
   # preset key (rides the same fidelity filters)
-  run_phase vit_sweep  3600 python -m scripts.bench_sweep --model vit_l16_384 --steps 30 || continue
-  if [ -e "$STATE/vit_sweep.done" ] && [ ! -e "$STATE/vit_adopt.done" ]; then
-    run_phase vit_adopt 300 env JIMM_PLATFORM=cpu python -m scripts.adopt_sweep --phase vit_sweep --preset vit-large-patch16-384 --apply || continue
+  if ! run_phase vit_sweep 3600 python -m scripts.bench_sweep --model vit_l16_384 --steps 30; then
+    adopt_refresh vit_sweep --preset vit-large-patch16-384
+    continue
   fi
+  adopt_refresh vit_sweep --preset vit-large-patch16-384
   if [ -f scripts/dump_goldens.py ]; then
     # needs network egress, not the chip; a blocked attempt still leaves
     # tests/goldens/ATTEMPTS.log evidence (VERDICT r4 item 4)
