@@ -311,3 +311,17 @@ def test_flashchk_resumes_at_unproven_cases(tmp_path, monkeypatch):
         ("ln_compiled_parity", "r2048_f768_bf16")}
     monkeypatch.setenv("JIMM_FLASHCHK_NO_SKIP", "1")
     assert fc.proven_cases() == set()
+
+
+def test_sweep_defers_variants_that_hang_repeatedly(tmp_path, monkeypatch):
+    import scripts.bench_sweep as bs
+    hang = {"model": "siglip_b16_256", "variant": {"remat": "dots+ln"},
+            "error": "variant watchdog after 600s (tunnel hang?)"}
+    other_err = {"model": "siglip_b16_256", "variant": {"ln": "fused"},
+                 "error": "ValueError('block spec')"}
+    p = _write(tmp_path, [hang, other_err, hang])
+    monkeypatch.setattr(bs, "MEASUREMENTS", p)
+    # two hang records -> deferred; one non-watchdog error -> still retried
+    assert bs.hung_variants("siglip_b16_256") == [{"remat": "dots+ln"}]
+    assert bs.hung_variants("siglip_b16_256", min_hangs=3) == []
+    assert bs.hung_variants("vit_l16_384") == []
