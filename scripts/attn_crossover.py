@@ -43,12 +43,21 @@ def main():
                         "approach half the non-causal time at long seq")
     args = p.parse_args()
 
+    from scripts._watchdog import hard_watchdog
+
     print("backend:", jax.default_backend(), jax.devices()[0].device_kind)
     rng = np.random.RandomState(0)
     N, D = 12, 64
     total_tokens = 128 * 256  # constant B*S
     for S in (64, 128, 256, 512, 1024, 2048, 4096, 8192):
         B = max(1, total_tokens // S)
+
+        def _hang(S=S):
+            # bound a tunnel hang to one sequence length, with evidence
+            print(f"  S={S}: case watchdog after 300s (tunnel hang?)",
+                  flush=True)
+
+        disarm = hard_watchdog(300, 21, _hang)
         q = jnp.asarray(rng.randn(B, S, N, D), jnp.bfloat16)
         k = jnp.asarray(rng.randn(B, S, N, D), jnp.bfloat16)
         v = jnp.asarray(rng.randn(B, S, N, D), jnp.bfloat16)
@@ -72,6 +81,7 @@ def main():
         print(f"  S={S:5d} B={B:4d}: flash {tf*1e3:8.2f} ms "
               f"({flops/tf/1e12:6.2f} TF/s)  xla {tx*1e3:8.2f} ms "
               f"({flops/tx/1e12:6.2f} TF/s)  -> {win}{causal_col}")
+        disarm()
 
 
 if __name__ == "__main__":

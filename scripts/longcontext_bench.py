@@ -61,6 +61,8 @@ def main():
             return jnp.sum(fwd(q, k, v).astype(jnp.float32))
         return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
 
+    from scripts._watchdog import hard_watchdog
+
     key = jax.random.PRNGKey(0)
     for seq in [int(s) for s in args.seqs.split(",")]:
         shape = (args.batch, seq, args.heads, args.head_dim)
@@ -71,6 +73,15 @@ def main():
         impls = ["flash"] + (["xla"] if seq <= args.xla_max_seq else [])
         for impl in impls:
             fn = make_fn(impl)
+
+            def _hang(impl=impl, seq=seq):
+                # a tunnel hang mid-case must cost one case's budget, not
+                # the whole phase window, and leave its own evidence line
+                print(json.dumps({"impl": impl, "seq": seq,
+                                  "error": "case watchdog after 240s "
+                                           "(tunnel hang?)"}), flush=True)
+
+            disarm = hard_watchdog(240, 21, _hang)
             try:
                 out = fn(q, k, v)
                 jax.block_until_ready(out)
@@ -83,6 +94,8 @@ def main():
                 print(json.dumps({"impl": impl, "seq": seq,
                                   "error": repr(e)[:200]}), flush=True)
                 continue
+            finally:
+                disarm()
             fl = attention_flops(args.batch, seq, args.heads, args.head_dim,
                                  bwd=args.bwd)
             if args.causal:
