@@ -417,6 +417,13 @@ PRESETS: dict[str, Any] = {
     "siglip-so400m-patch14-384": _siglip("So400m", 14, 384),
     "siglip2-base-patch16-256": _siglip("B", 16, 256, vocab=256000),
     "siglip2-large-patch16-512": _siglip("L", 16, 512, vocab=256000),
+    # So400m towers are dimensionally identical to the v1 So400m release
+    # (verified against google/siglip-so400m-patch14-384); v2 swaps the
+    # tokenizer/vocab (Gemma 256k) and training recipe, not the shapes.
+    # (giant-opt is deliberately absent: its asymmetric text tower can't be
+    # verified offline — from_pretrained still loads it from the HF config.)
+    "siglip2-so400m-patch14-384": _siglip("So400m", 14, 384, vocab=256000),
+    "siglip2-so400m-patch16-256": _siglip("So400m", 16, 256, vocab=256000),
 }
 
 

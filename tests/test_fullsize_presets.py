@@ -137,3 +137,16 @@ def test_siglip2_large_patch16_512(tmp_path, rng):
     del oracle
     _check_roundtrip(SigLIP, tmp_path / "out", model,
                      (jnp.asarray(img), jnp.asarray(txt)))
+
+
+def test_siglip2_so400m_presets_shapes():
+    """SigLIP2 So400m presets: v1 So400m tower dims + Gemma-sized vocab."""
+    from jimm_tpu.configs import preset
+    for name, patches in (("siglip2-so400m-patch14-384", 729),
+                          ("siglip2-so400m-patch16-256", 256)):
+        cfg = preset(name)
+        assert (cfg.vision.width, cfg.vision.depth,
+                cfg.vision.mlp_dim) == (1152, 27, 4304)
+        assert cfg.vision.num_patches == patches
+        assert cfg.text.vocab_size == 256000
+        assert cfg.projection_dim == 1152
