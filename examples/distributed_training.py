@@ -67,7 +67,8 @@ def main() -> None:
     # global mesh, never materialized on one host
     model = create_sharded(lambda: SigLIP(cfg, rngs=nnx.Rngs(0)), mesh, FSDP)
     opt = make_optimizer(model, OptimizerConfig(learning_rate=3e-3))
-    step = make_contrastive_train_step("siglip_ring", mesh=mesh)
+    step = make_contrastive_train_step("siglip_ring", mesh=mesh,
+                                       donate=True)
 
     stream = contrastive_pairs(args.batch_size, image_size=16, seq_len=8,
                                shard_index=rank, shard_count=world)

@@ -73,7 +73,7 @@ def main() -> None:
 
     optimizer = make_optimizer(model, OptimizerConfig(
         learning_rate=1e-4, warmup_steps=2, total_steps=args.steps))
-    step = make_contrastive_train_step("siglip")
+    step = make_contrastive_train_step("siglip", donate=True)
     log = MetricsLogger()
 
     rng = np.random.RandomState(0)

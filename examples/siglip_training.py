@@ -79,7 +79,8 @@ def main() -> None:
                    dtype=dtype, param_dtype=param_dtype)
     optimizer = make_optimizer(model, OptimizerConfig(
         learning_rate=args.lr, warmup_steps=10, total_steps=args.steps))
-    train_step = make_contrastive_train_step(args.loss, mesh=mesh)
+    train_step = make_contrastive_train_step(args.loss, mesh=mesh,
+                                             donate=True)
     logger = MetricsLogger(path=args.log, print_every=5)
     ckpt = CheckpointManager(args.ckpt_dir) if args.ckpt_dir else None
 

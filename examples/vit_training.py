@@ -56,7 +56,7 @@ def main() -> None:
     model = VisionTransformer(cfg, rngs=nnx.Rngs(0), mesh=mesh, rules=rules)
     optimizer = make_optimizer(model, OptimizerConfig(
         learning_rate=args.lr, warmup_steps=20, total_steps=args.steps))
-    train_step = make_classifier_train_step()
+    train_step = make_classifier_train_step(donate=True)
     logger = MetricsLogger(path=args.log, print_every=10)
     ckpt = CheckpointManager(args.ckpt_dir) if args.ckpt_dir else None
 

@@ -7,6 +7,39 @@ flash attention, and distributed contrastive training with a ring sigmoid
 loss.
 """
 
+def _check_versions() -> None:
+    """Fail fast with a clear message on JAX/flax older than the tested
+    floor (pyproject.toml mirrors these; pip cannot enforce them for
+    source checkouts or pre-installed environments)."""
+    import jax
+    from flax import __version__ as flax_version
+
+    def parse(v: str) -> tuple[int, ...]:
+        parts = []
+        for p in v.split(".")[:3]:
+            digits = "".join(ch for ch in p if ch.isdigit())
+            if not digits:
+                break
+            parts.append(int(digits))
+        return tuple(parts)
+
+    floors = (("jax", jax.__version__, (0, 4, 35)),
+              ("flax", flax_version, (0, 10)))
+    for name, have, floor in floors:
+        if parse(have) and parse(have) < floor:
+            raise ImportError(
+                f"jimm_tpu requires {name} >= {'.'.join(map(str, floor))}, "
+                f"found {have}. Upgrade with `pip install -U {name}` "
+                f"(TPU: `pip install -U 'jax[tpu]'`).")
+
+
+_check_versions()
+
+# imported for its side effects too: backfills nnx module/class attributes
+# (to_flat_state, Variable.set_value, ...) that flax 0.10 lacks, before any
+# model/weights code touches them
+import jimm_tpu.utils.compat  # noqa: E402,F401  isort: skip
+
 from jimm_tpu.configs import (CLIPConfig, SigLIPConfig, TextConfig,
                               TransformerConfig, ViTConfig, VisionConfig,
                               PRESETS, RUNTIME_FIELDS, preset, with_runtime)

@@ -499,7 +499,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         return grain_stream.batches()
 
     if fam == "vit":
-        step_fn = make_classifier_train_step()
+        step_fn = make_classifier_train_step(donate=True)
         if args.data and args.loader == "grain":
             data = _grain_data("classification")
         elif args.data:
@@ -525,7 +525,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         else:
             loss_kind = args.loss or ("siglip_ring" if ring_ok
                                       else "siglip")
-        step_fn = make_contrastive_train_step(loss_kind, mesh=mesh)
+        step_fn = make_contrastive_train_step(loss_kind, mesh=mesh,
+                                              donate=True)
         if args.naflex:
             # variable-resolution SigLIP2 training (beyond the reference)
             if fam != "siglip":

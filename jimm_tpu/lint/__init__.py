@@ -1,0 +1,11 @@
+"""``jimm_tpu.lint`` — TPU-correctness static analyzer.
+
+Layer 1 (always on) is pure-``ast`` rules JL001–JL005 over the source tree;
+layer 2 (``--trace``) lowers registered model entry points and asserts
+program-text properties JLT101–JLT103. See ``docs/static_analysis.md`` for
+the rule catalog and suppression syntax (``# jaxlint: disable=<rule>``).
+"""
+
+from jimm_tpu.lint.core import ERROR, WARNING, Finding, lint_file, lint_paths
+
+__all__ = ["ERROR", "WARNING", "Finding", "lint_file", "lint_paths"]

@@ -1,0 +1,63 @@
+"""Command-line interface: ``python -m jimm_tpu.lint [paths] [--trace]
+[--json] [--vmem-budget BYTES]``.
+
+Exit status is 1 when any **error**-severity finding survives suppression;
+warnings are reported but never block. ``--json`` emits a machine-readable
+report (one object per finding: rule, severity, path, line, message) for CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from jimm_tpu.lint.core import ERROR, Finding, lint_paths
+from jimm_tpu.lint.rules_ast import DEFAULT_VMEM_BUDGET
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m jimm_tpu.lint",
+        description="TPU-correctness static analyzer for jimm_tpu "
+                    "(AST rules JL0xx; --trace adds lowered-HLO checks "
+                    "JLT1xx)")
+    parser.add_argument("paths", nargs="*", default=["jimm_tpu", "tests"],
+                        help="files or directories to lint "
+                             "(default: jimm_tpu tests)")
+    parser.add_argument("--trace", action="store_true",
+                        help="also lower registered model entry points on "
+                             "tiny shapes and check donation aliasing, FSDP "
+                             "gather behavior, and batch-bucket stability "
+                             "(imports JAX, takes ~a minute)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as a JSON array on stdout")
+    parser.add_argument("--vmem-budget", type=int,
+                        default=DEFAULT_VMEM_BUDGET, metavar="BYTES",
+                        help="VMEM budget for the JL005 block-size estimate "
+                             f"(default {DEFAULT_VMEM_BUDGET})")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    findings: list[Finding] = lint_paths(args.paths,
+                                         vmem_budget=args.vmem_budget)
+    if args.trace:
+        from jimm_tpu.lint.trace import run_trace_checks
+        findings.extend(run_trace_checks())
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if args.as_json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        errors = sum(f.severity == ERROR for f in findings)
+        warnings = len(findings) - errors
+        print(f"{errors} error(s), {warnings} warning(s)")
+    return 1 if any(f.severity == ERROR for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,454 @@
+"""Layer-1 lint rules — pure ``ast``, no JAX import.
+
+Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
+
+- **JL001** version-gated ``jax.config.update`` key used without a guard
+  (the exact bug that bricked the seed suite's collection on JAX 0.4.x).
+- **JL002** host-device sync inside jitted code: ``.item()``,
+  ``float()``/``int()``/``bool()``/``np.asarray()`` on traced values, and
+  Python ``if`` on a traced value (shape/dtype/``is None`` tests are static
+  and exempt).
+- **JL003** train-step-shaped jit (carries optimizer state) without
+  ``donate_argnums``, and train-step builder calls without ``donate=`` in
+  library code (tests are exempt — they exercise the default).
+- **JL004** ``PartitionSpec`` axis names outside the canonical mesh-axis
+  vocabulary (a typo'd axis silently shards nothing).
+- **JL005** Pallas block/VMEM shapes that violate the TPU (8, 128)
+  sublane/lane tiling or exceed the VMEM budget estimate.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from jimm_tpu.lint.core import ERROR, WARNING, Finding
+
+#: jax.config keys that only exist on some JAX lines — using one unguarded
+#: makes the import/startup path crash on the other lines. Extend this table
+#: as new gated keys enter the codebase.
+VERSION_GATED_CONFIG_KEYS: dict[str, str] = {
+    "jax_num_cpu_devices": "JAX >= 0.5 (0.4.x: XLA_FLAGS "
+                           "--xla_force_host_platform_device_count)",
+}
+
+#: canonical physical mesh-axis vocabulary. Mirrors
+#: ``jimm_tpu.parallel.mesh.MESH_AXES`` — duplicated here so layer 1 never
+#: imports JAX; ``tests/test_lint.py`` asserts the two stay in sync.
+CANONICAL_MESH_AXES = frozenset({"data", "model", "replica", "seq", "stage"})
+
+#: parameter names that mark a jitted function as a train step carrying
+#: optimizer state (JL003)
+OPTIMIZER_PARAM_NAMES = frozenset({"optimizer", "opt", "opt_state",
+                                   "optimizer_state"})
+
+TRAIN_STEP_BUILDERS = frozenset({"make_classifier_train_step",
+                                 "make_contrastive_train_step"})
+
+#: attribute reads on a traced value that are static at trace time (inspect
+#: metadata, not data) — branching on them is fine
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding"})
+
+DEFAULT_VMEM_BUDGET = 16 * 1024 * 1024  # bytes; ~v5e per-core VMEM
+
+_DTYPE_BYTES = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
+                "uint32": 4, "bfloat16": 2, "float16": 2, "int16": 2,
+                "int8": 1, "uint8": 1, "bool_": 1}
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _annotate_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._jaxlint_parent = node  # type: ignore[attr-defined]
+
+
+def _parent(node: ast.AST) -> ast.AST | None:
+    return getattr(node, "_jaxlint_parent", None)
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``jax.config.update``-style dotted name for Name/Attribute chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    name = _dotted(node)
+    if name is None:
+        return False
+    return name == "jit" or name.endswith(".jit")
+
+
+def _jit_decorator(dec: ast.expr) -> ast.expr | None:
+    """The decorator expression if it jit-wraps the function: ``@jit``,
+    ``@jax.jit`` / ``@nnx.jit``, ``@jit(...)``, ``@partial(jit, ...)``."""
+    if _is_jit_expr(dec):
+        return dec
+    if isinstance(dec, ast.Call):
+        if _is_jit_expr(dec.func):
+            return dec
+        fname = _dotted(dec.func)
+        if fname in ("partial", "functools.partial") and dec.args \
+                and _is_jit_expr(dec.args[0]):
+            return dec
+    return None
+
+
+def _decorator_keywords(dec: ast.expr) -> set[str]:
+    if isinstance(dec, ast.Call):
+        return {kw.arg for kw in dec.keywords if kw.arg}
+    return set()
+
+
+def _jitted_functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                jd = _jit_decorator(dec)
+                if jd is not None:
+                    yield node, jd
+                    break
+
+
+# ---------------------------------------------------------------------------
+# JL001 — version-gated config key without a guard
+# ---------------------------------------------------------------------------
+
+def _is_guarded(node: ast.AST) -> bool:
+    """True when an ancestor try/except catches AttributeError (or broader),
+    or an ancestor ``if`` gates on ``hasattr``/``__version__``."""
+    cur: ast.AST | None = node
+    while cur is not None:
+        parent = _parent(cur)
+        if isinstance(parent, ast.Try) and cur in parent.body:
+            for handler in parent.handlers:
+                if handler.type is None:
+                    return True
+                names = [_dotted(t) for t in (
+                    handler.type.elts if isinstance(handler.type, ast.Tuple)
+                    else [handler.type])]
+                if any(n in ("AttributeError", "Exception") for n in names):
+                    return True
+        if isinstance(parent, ast.If):
+            test_src = ast.dump(parent.test)
+            if "hasattr" in test_src or "__version__" in test_src:
+                return True
+        cur = parent
+    return False
+
+
+def check_version_gated_config(tree: ast.AST, path: str) -> list[Finding]:
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        if fname is None or not fname.endswith("config.update"):
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant):
+            continue
+        key = node.args[0].value
+        if key not in VERSION_GATED_CONFIG_KEYS:
+            continue
+        if _is_guarded(node):
+            continue
+        findings.append(Finding(
+            "JL001", ERROR, path, node.lineno,
+            f"jax.config.update({key!r}, ...) is version-gated "
+            f"({VERSION_GATED_CONFIG_KEYS[key]}) but has no "
+            f"try/except AttributeError or hasattr guard"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JL002 — host-device sync inside jitted code
+# ---------------------------------------------------------------------------
+
+def _tainted_names(fn: ast.FunctionDef) -> set[str]:
+    """Function parameters plus locals assigned from expressions that use
+    them — a one-pass, forward-only approximation of 'traced value'."""
+    args = fn.args
+    tainted = {a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)
+               if a.arg not in ("self", "cls")}
+    for a in (args.vararg, args.kwarg):
+        if a is not None:
+            tainted.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(n, ast.Name) and n.id in tainted
+                for n in ast.walk(node.value)):
+            for target in node.targets:
+                for t in ast.walk(target):
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+    return tainted
+
+
+def _mentions_tainted(node: ast.AST, tainted: set[str]) -> bool:
+    return any(isinstance(n, ast.Name) and n.id in tainted
+               for n in ast.walk(node))
+
+
+def _branch_is_static(test: ast.expr, tainted: set[str]) -> bool:
+    """True for trace-time-static branch tests: ``is (not) None``,
+    ``isinstance``, and tests that touch traced values only through static
+    metadata attributes (``.shape``/``.ndim``/``.dtype``/``len()``)."""
+    if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return True
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in tainted):
+            continue
+        parent = _parent(node)
+        if isinstance(parent, ast.Attribute) and parent.attr in STATIC_ATTRS:
+            continue
+        if isinstance(parent, ast.Call) and _dotted(parent.func) in (
+                "len", "isinstance"):
+            continue
+        # raw traced value in the test
+        return False
+    return True
+
+
+def check_host_sync_in_jit(tree: ast.AST, path: str) -> list[Finding]:
+    findings = []
+    for fn, _dec in _jitted_functions(tree):
+        tainted = _tainted_names(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                fname = _dotted(node.func)
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" and not node.args:
+                    findings.append(Finding(
+                        "JL002", ERROR, path, node.lineno,
+                        f".item() inside jitted `{fn.name}` forces a "
+                        f"host-device sync"))
+                elif fname in ("float", "int", "bool") and node.args \
+                        and _mentions_tainted(node.args[0], tainted):
+                    findings.append(Finding(
+                        "JL002", ERROR, path, node.lineno,
+                        f"{fname}() on a traced value inside jitted "
+                        f"`{fn.name}` forces a host-device sync"))
+                elif fname in ("np.asarray", "np.array", "numpy.asarray",
+                               "numpy.array", "onp.asarray") and node.args \
+                        and _mentions_tainted(node.args[0], tainted):
+                    findings.append(Finding(
+                        "JL002", ERROR, path, node.lineno,
+                        f"{fname}() on a traced value inside jitted "
+                        f"`{fn.name}` copies device data to host"))
+            elif isinstance(node, ast.If) \
+                    and _mentions_tainted(node.test, tainted) \
+                    and not _branch_is_static(node.test, tainted):
+                findings.append(Finding(
+                    "JL002", ERROR, path, node.lineno,
+                    f"Python `if` on a traced value inside jitted "
+                    f"`{fn.name}` — use jnp.where/lax.cond"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JL003 — train-step jit without donation
+# ---------------------------------------------------------------------------
+
+def _path_is_test(path: str) -> bool:
+    base = path.replace("\\", "/").rsplit("/", 1)[-1]
+    return base.startswith("test_") or base == "conftest.py"
+
+
+def check_train_step_donation(tree: ast.AST, path: str) -> list[Finding]:
+    findings = []
+    for fn, dec in _jitted_functions(tree):
+        params = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs}
+        if not params & OPTIMIZER_PARAM_NAMES:
+            continue
+        if not _decorator_keywords(dec) & {"donate_argnums", "donate",
+                                           "donate_argnames"}:
+            findings.append(Finding(
+                "JL003", ERROR, path, fn.lineno,
+                f"jitted train step `{fn.name}` carries optimizer state "
+                f"({sorted(params & OPTIMIZER_PARAM_NAMES)}) without "
+                f"donate_argnums — params/m/v double-buffer in HBM"))
+    if not _path_is_test(path):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func)
+            if fname is None:
+                continue
+            if fname.rsplit(".", 1)[-1] not in TRAIN_STEP_BUILDERS:
+                continue
+            if any(kw.arg == "donate" for kw in node.keywords):
+                continue
+            findings.append(Finding(
+                "JL003", ERROR, path, node.lineno,
+                f"{fname}(...) without donate= leaves donation off on a "
+                f"training hot path; pass donate=True (or donate=False "
+                f"with a reason)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JL004 — PartitionSpec axis vocabulary
+# ---------------------------------------------------------------------------
+
+def _spec_strings(args: list[ast.expr]):
+    for arg in args:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                yield node
+
+
+def check_partition_spec_axes(tree: ast.AST, path: str) -> list[Finding]:
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        if fname is None:
+            continue
+        if fname != "P" and fname.rsplit(".", 1)[-1] != "PartitionSpec":
+            continue
+        for s in _spec_strings(list(node.args)):
+            if s.value not in CANONICAL_MESH_AXES:
+                findings.append(Finding(
+                    "JL004", ERROR, path, s.lineno,
+                    f"PartitionSpec axis {s.value!r} is not a canonical "
+                    f"mesh axis {sorted(CANONICAL_MESH_AXES)} — typo'd "
+                    f"axes silently shard nothing"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JL005 — Pallas tiling / VMEM budget
+# ---------------------------------------------------------------------------
+
+def _module_int_constants(tree: ast.AST) -> dict[str, int]:
+    consts: dict[str, int] = {}
+    body = getattr(tree, "body", [])
+    for node in body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            val = _resolve_int(node.value, consts)
+            if val is not None:
+                consts[node.targets[0].id] = val
+    return consts
+
+
+def _resolve_int(node: ast.expr, consts: dict[str, int]) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _resolve_int(node.operand, consts)
+        return None if v is None else -v
+    if isinstance(node, ast.BinOp):
+        left = _resolve_int(node.left, consts)
+        right = _resolve_int(node.right, consts)
+        if left is None or right is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.FloorDiv):
+                return left // right
+            if isinstance(node.op, ast.Mod):
+                return left % right
+            if isinstance(node.op, ast.Pow):
+                return left ** right
+        except (ZeroDivisionError, OverflowError, ValueError):
+            return None
+    return None
+
+
+def _dtype_bytes(node: ast.expr | None) -> int:
+    name = (_dotted(node) or "") if node is not None else ""
+    leaf = name.rsplit(".", 1)[-1]
+    return _DTYPE_BYTES.get(leaf, 4)
+
+
+def _check_shape(dims: list[int | None], bytes_per_elem: int, budget: int,
+                 path: str, lineno: int, what: str) -> list[Finding]:
+    findings = []
+    if dims and dims[-1] is not None and dims[-1] != 1 \
+            and dims[-1] % 128 != 0:
+        findings.append(Finding(
+            "JL005", ERROR, path, lineno,
+            f"{what} last dim {dims[-1]} is not a multiple of the 128-lane "
+            f"TPU tile — the Mosaic pad wastes VMEM and VPU lanes"))
+    if len(dims) >= 2 and dims[-2] is not None and dims[-2] != 1 \
+            and dims[-2] % 8 != 0:
+        findings.append(Finding(
+            "JL005", ERROR, path, lineno,
+            f"{what} second-minor dim {dims[-2]} is not a multiple of the "
+            f"8-sublane TPU tile"))
+    if all(d is not None for d in dims) and dims:
+        total = bytes_per_elem
+        for d in dims:
+            total *= d  # type: ignore[operator]
+        if total > budget:
+            findings.append(Finding(
+                "JL005", ERROR, path, lineno,
+                f"{what} is {total / 2**20:.1f} MiB, over the "
+                f"{budget / 2**20:.1f} MiB VMEM budget (tune with "
+                f"--vmem-budget)"))
+    return findings
+
+
+def check_pallas_tiling(tree: ast.AST, path: str,
+                        vmem_budget: int | None = None) -> list[Finding]:
+    budget = vmem_budget if vmem_budget is not None else DEFAULT_VMEM_BUDGET
+    consts = _module_int_constants(tree)
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        leaf = fname.rsplit(".", 1)[-1] if fname else None
+        if leaf == "BlockSpec":
+            for arg in node.args:
+                if isinstance(arg, ast.Tuple):
+                    dims = [_resolve_int(e, consts) for e in arg.elts]
+                    findings.extend(_check_shape(
+                        dims, 4, budget, path, node.lineno,
+                        "BlockSpec block shape"))
+                    break  # one shape tuple per BlockSpec
+        elif leaf in ("VMEM", "SMEM") and fname and "." in fname:
+            if node.args and isinstance(node.args[0], ast.Tuple):
+                dims = [_resolve_int(e, consts)
+                        for e in node.args[0].elts]
+                dtype = node.args[1] if len(node.args) > 1 else None
+                if leaf == "VMEM":
+                    findings.extend(_check_shape(
+                        dims, _dtype_bytes(dtype), budget, path,
+                        node.lineno, "VMEM scratch shape"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+
+def run_all(tree: ast.AST, path: str,
+            vmem_budget: int | None = None) -> list[Finding]:
+    _annotate_parents(tree)
+    findings: list[Finding] = []
+    findings += check_version_gated_config(tree, path)
+    findings += check_host_sync_in_jit(tree, path)
+    findings += check_train_step_donation(tree, path)
+    findings += check_partition_spec_axes(tree, path)
+    findings += check_pallas_tiling(tree, path, vmem_budget)
+    return findings

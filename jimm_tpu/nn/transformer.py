@@ -174,7 +174,12 @@ class Transformer(nnx.Module):
         def create_block(rngs: nnx.Rngs) -> Block:
             return Block(cfg, rngs, dtype=dtype, param_dtype=param_dtype)
 
-        self.blocks = create_block(rngs)
+        # the clone keeps the blocks' captured RngState from aliasing the
+        # caller's rngs (flax 0.10 vmap broadcasts it by reference), so the
+        # stacking fixup below cannot corrupt sibling modules' streams
+        self.blocks = create_block(nnx.clone(rngs))
+        from jimm_tpu.utils.compat import ensure_stacked_rng_state
+        ensure_stacked_rng_state(self.blocks, cfg.depth)
         if cfg.pipeline and cfg.pp_virtual > 1 and cfg.pp_stages:
             # circular placement is baked into STORAGE order once at
             # construction (stored row j = canonical layer order[j]), so the
@@ -255,7 +260,8 @@ class Transformer(nnx.Module):
 
         from jimm_tpu.configs import validate_pipeline
 
-        mesh = jax.sharding.get_abstract_mesh()
+        from jimm_tpu.utils.compat import get_abstract_mesh
+        mesh = get_abstract_mesh()
         n_stage = (dict(mesh.shape).get("stage", 0)
                    if mesh is not None else 0)
         # shared checks (stage axis present, depth divisibility, pp_stages
@@ -286,8 +292,10 @@ class Transformer(nnx.Module):
             # so masks differ across training steps too.
             from jimm_tpu.parallel.pipeline import num_ticks
             t_total = num_ticks(self.cfg.pp_microbatches, n_stage, n_virtual)
-            tick_offset = self.pp_tick[...]
-            self.pp_tick[...] = tick_offset + jnp.uint32(t_total)
+            # .value, not [...]: flax 0.10 __setitem__ writes through to the
+            # (immutable) jax array instead of replacing the variable's value
+            tick_offset = self.pp_tick.value
+            self.pp_tick.value = tick_offset + jnp.uint32(t_total)
 
         def stage_apply(state_chunk, xm, tick):
             # plain lax.scan + per-layer merge (nnx.scan can't consume
@@ -315,10 +323,19 @@ class Transformer(nnx.Module):
                                 tick_offset=tick_offset)
 
 
+def _is_rng_count(leaf) -> bool:
+    # flat-state leaves are Variables on flax >= 0.12 but VariableStates
+    # (carrying the Variable class in .type) on 0.10
+    if isinstance(leaf, nnx.RngCount):
+        return True
+    t = getattr(leaf, "type", None)
+    return isinstance(t, type) and issubclass(t, nnx.RngCount)
+
+
 def _set_rng_counts(state, value) -> nnx.State:
     """Functionally pin every RngCount in ``state`` to ``value`` — each
     (layer key, tick) pair then draws a unique, deterministic dropout mask."""
     flat = nnx.to_flat_state(state)
     new = [(p, l.replace(jnp.asarray(value, jnp.uint32))
-            if isinstance(l, nnx.RngCount) else l) for p, l in flat]
+            if _is_rng_count(l) else l) for p, l in flat]
     return nnx.from_flat_state(new)

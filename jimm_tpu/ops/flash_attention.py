@@ -274,7 +274,9 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-_SEMANTICS = pltpu.CompilerParams(
+from jimm_tpu.utils.compat import pallas_tpu_compiler_params
+
+_SEMANTICS = pallas_tpu_compiler_params(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 

@@ -19,6 +19,14 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 
+#: Canonical physical mesh-axis names. Every ``Mesh`` built in this package
+#: and every ``PartitionSpec`` in library/test code draws from this
+#: vocabulary — ``jimm_tpu.lint`` rule JL004 flags any other axis string as a
+#: probable typo (a misspelled axis silently shards nothing).
+#: ``tests/test_lint.py`` asserts the linter's copy stays in sync.
+MESH_AXES: tuple[str, ...] = ("data", "model", "replica", "seq", "stage")
+
+
 def make_mesh(axes: Mapping[str, int] | None = None,
               devices: list | None = None) -> Mesh:
     """Build a mesh from ``{"axis": size}``; ``-1`` means "all remaining
@@ -155,9 +163,9 @@ def resolve_mesh_axis(mesh, axis_name: str) -> dict:
     None, on the ambient mesh installed by ``use_sharding``/``jax.set_mesh``)
     and return the mesh shape dict. Shared by the sequence-parallel
     attention schemes (`ring_attention`, `ulysses_attention`)."""
-    import jax as _jax
+    from jimm_tpu.utils.compat import get_abstract_mesh
     if mesh is None:
-        ambient = _jax.sharding.get_abstract_mesh()
+        ambient = get_abstract_mesh()
         if ambient is None or ambient.empty:
             raise ValueError("no mesh given and no ambient mesh installed "
                              "(use use_sharding(mesh, ...))")

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from jax import shard_map
+from jimm_tpu.utils.compat import axis_size, shard_map
 
 
 def circular_layer_order(n_layers: int, n_stages: int, n_virtual: int
@@ -98,7 +98,7 @@ def pipeline_forward(stage_apply: Callable, stage_params, x: jax.Array, *,
 
     def local(params_local, x_local):
         stage = jax.lax.axis_index(axis_name)
-        S = jax.lax.axis_size(axis_name)
+        S = axis_size(axis_name)
         b = x_local.shape[0]
         check_pp_schedule(M, V, n_stages=S, local_batch=b)
         micro = x_local.reshape(M, b // M, *x_local.shape[1:])

@@ -35,12 +35,17 @@ from typing import Any, Callable, Sequence
 import jax
 import numpy as np
 from flax import nnx
-from flax.core import spmd as _core_spmd
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from jimm_tpu.utils.compat import (core_spmd as _core_spmd,
+                                   get_abstract_mesh, manual_axis_names,
+                                   set_mesh)
+
 # Parameters are annotated with logical names; we never want flax to eagerly
-# reshard at creation time (we control placement explicitly).
-nnx.use_eager_sharding(False)
+# reshard at creation time (we control placement explicitly). flax < 0.11
+# has no eager sharding, which matches the disabled behavior.
+if hasattr(nnx, "use_eager_sharding"):
+    nnx.use_eager_sharding(False)
 
 MeshAxis = str | tuple[str, ...] | None
 
@@ -154,7 +159,7 @@ def use_sharding(mesh: Mesh | None, rules: ShardingRules | str | None = None):
         _core_spmd.set_logical_axis_rules(rules.to_flax_rules())
     try:
         if mesh is not None:
-            with jax.set_mesh(mesh):
+            with set_mesh(mesh):
                 yield
         else:
             yield
@@ -182,12 +187,11 @@ def logical_constraint(x: jax.Array, *names: str | None) -> jax.Array:
     partially-manual mesh (``shard_map(..., axis_names=...)`` subsets) are
     preserved rather than dropped wholesale."""
     rules = current_rules()
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = get_abstract_mesh()
     if rules is None or mesh is None or mesh.empty or not mesh.shape_tuple:
         return x
     spec = rules.spec(*names)
-    manual = {n for n, t in zip(mesh.axis_names, mesh.axis_types)
-              if t == jax.sharding.AxisType.Manual}
+    manual = manual_axis_names(mesh)
     if manual:
         def keep(axis):
             axes = axis if isinstance(axis, tuple) else (axis,)
@@ -220,6 +224,36 @@ def prune_spec(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
     return P(*out)
 
 
+_LOGICAL_AXES = tuple(f.name for f in dataclasses.fields(ShardingRules))
+
+
+def resolve_logical_spec(spec: P, rules: ShardingRules) -> P:
+    """Translate logical axis names in ``spec`` to physical mesh axes through
+    ``rules``. flax 0.10's ``nnx.get_partition_spec`` returns the raw logical
+    metadata names (newer flax resolves them itself, making this a no-op —
+    physical axis names are not in the logical vocabulary). Nested tuples
+    flatten; axes that resolve to nothing become ``None`` (replicated)."""
+    def resolve_one(a) -> tuple:
+        if a is None:
+            return ()
+        if isinstance(a, tuple):
+            out: tuple = ()
+            for el in a:
+                out += resolve_one(el)
+            return out
+        if a in _LOGICAL_AXES:
+            target = getattr(rules, a)
+            if target != a:  # e.g. rules.seq == "seq": already physical
+                return resolve_one(target)
+        return (a,)
+
+    out = []
+    for a in tuple(spec):
+        r = resolve_one(a)
+        out.append(None if not r else (r[0] if len(r) == 1 else r))
+    return P(*out)
+
+
 def partition_specs(state: Any) -> Any:
     """PartitionSpec pytree for an nnx state, resolving logical names through
     the ambient rules (falls back to raw names if no rules installed)."""
@@ -242,7 +276,8 @@ def shard_model(model: nnx.Module, mesh: Mesh,
             s = spec.get_value() if isinstance(spec, nnx.Variable) else spec
             if not isinstance(s, P):
                 s = P()
-            s = prune_spec(s, np.shape(val), mesh)
+            s = prune_spec(resolve_logical_spec(s, rules), np.shape(val),
+                           mesh)
             return jax.device_put(val, NamedSharding(mesh, s))
 
         new_state = jax.tree.map(put, state, specs,
@@ -269,7 +304,8 @@ def create_sharded(ctor: Callable[[], nnx.Module], mesh: Mesh,
             s = spec.get_value() if isinstance(spec, nnx.Variable) else spec
             if not isinstance(s, P):
                 s = P()
-            s = prune_spec(s, np.shape(val), mesh)
+            s = prune_spec(resolve_logical_spec(s, rules), np.shape(val),
+                           mesh)
             return jax.lax.with_sharding_constraint(val, s)
 
         state = jax.tree.map(constrain, state, specs,

@@ -22,7 +22,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
-from jax import shard_map
+from jimm_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

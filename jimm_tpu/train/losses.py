@@ -17,7 +17,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from jimm_tpu.utils.compat import axis_size, shard_map
 
 
 def clip_softmax_loss(img: jax.Array, txt: jax.Array, logit_scale: jax.Array
@@ -61,7 +61,7 @@ def _ring_sigmoid_local(img: jax.Array, txt: jax.Array, scale: jax.Array,
     ``axis_name`` may be a tuple of mesh axes (e.g. ``("replica", "data")``
     on a hybrid DCN x ICI mesh) — the ring then runs over the linearized
     product axis."""
-    n_dev = jax.lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     b = img.shape[0]
     img = img / jnp.linalg.norm(img, axis=-1, keepdims=True)
     txt = txt / jnp.linalg.norm(txt, axis=-1, keepdims=True)
@@ -109,7 +109,7 @@ def _ring_infonce_local(img: jax.Array, txt: jax.Array, scale: jax.Array,
     The positive logit is the diagonal of the step-0 (own-chunk) block. No
     device ever materializes more than its local b x b logit tile.
     """
-    n_dev = jax.lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     b = img.shape[0]
     img = img / jnp.linalg.norm(img, axis=-1, keepdims=True)
     txt = txt / jnp.linalg.norm(txt, axis=-1, keepdims=True)

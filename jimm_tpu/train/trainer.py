@@ -22,6 +22,7 @@ from flax import nnx
 
 from jimm_tpu.train.losses import (clip_softmax_loss, ring_clip_infonce_loss,
                                    ring_sigmoid_loss, sigmoid_pairwise_loss)
+from jimm_tpu.utils.compat import optimizer_update
 
 
 @dataclass(frozen=True)
@@ -99,7 +100,7 @@ def make_classifier_train_step(*, donate: bool = False) -> Callable:
             return loss, logits
 
         (loss, logits), grads = nnx.value_and_grad(loss_fn, has_aux=True)(model)
-        optimizer.update(model, grads)
+        optimizer_update(optimizer, model, grads)
         accuracy = jnp.mean(jnp.argmax(logits, axis=-1) == labels)
         return {"loss": loss, "accuracy": accuracy}
 
@@ -172,7 +173,7 @@ def make_contrastive_train_step(kind: str = "siglip_ring", *, mesh=None,
             return loss(model, images, text)
 
         loss_val, grads = nnx.value_and_grad(loss_fn)(model)
-        optimizer.update(model, grads)
+        optimizer_update(optimizer, model, grads)
         return {"loss": loss_val}
 
     return train_step

@@ -1,0 +1,236 @@
+"""Version-compatibility shims for the JAX / flax API surface this package
+uses. The supported floor (pyproject.toml, enforced in ``jimm_tpu/__init__``)
+is JAX 0.4.35 / flax 0.10; several names this codebase was written against
+moved or first appeared on the JAX 0.5/0.6 and flax 0.11/0.12 lines. Every
+cross-version difference lives HERE — model/training code imports the shim,
+never branches on versions itself (`jimm_tpu.lint` rule JL001 guards the
+config-key flavor of this hazard).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+import jax
+from flax import nnx
+
+try:  # JAX >= 0.5: top-level export
+    from jax import shard_map as _raw_shard_map  # type: ignore[attr-defined]
+except ImportError:  # JAX 0.4.x
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+#: manual-axis sets of compat shard_maps currently being traced (a stack:
+#: shard_maps nest). 0.4.x meshes carry no AxisType metadata, so this is how
+#: :func:`manual_axis_names` answers inside a mapped body on that line.
+_MANUAL_AXES_STACK: list[frozenset[str]] = []
+
+if "check_vma" in inspect.signature(_raw_shard_map).parameters:
+    shard_map = _raw_shard_map
+else:
+    def shard_map(f, *args, **kwargs):
+        """JAX 0.4.x shard_map with the modern calling convention:
+
+        - ``check_vma`` translates to its old name ``check_rep``, defaulting
+          OFF — 0.4.x lacks replication rules for several primitives used in
+          this package's mapped bodies (e.g. sharding_constraint);
+        - ``axis_names={...}`` (modern: the axes to map over) translates to
+          the complementary ``auto`` set, and the partially-manual result is
+          jit-wrapped because 0.4.x only implements ``auto`` under jit;
+        - the mapped body runs with its manual-axis set pushed on
+          :data:`_MANUAL_AXES_STACK` for :func:`manual_axis_names`.
+        """
+        kwargs["check_rep"] = kwargs.pop("check_vma", False)
+        mesh = kwargs.get("mesh", args[0] if args else None)
+        if mesh is None:
+            # modern convention: no mesh argument means the ambient mesh;
+            # 0.4.x requires it explicitly, so pull it from the resource env
+            ambient = get_abstract_mesh()
+            if ambient is not None and not getattr(ambient, "empty", True):
+                mesh = kwargs["mesh"] = ambient
+        axis_names = kwargs.pop("axis_names", None)
+        manual = (frozenset(axis_names) if axis_names is not None
+                  else frozenset(getattr(mesh, "axis_names", ())))
+        auto = frozenset(getattr(mesh, "axis_names", ())) - manual
+        if auto:
+            kwargs["auto"] = auto
+
+        def body(*xs):
+            _MANUAL_AXES_STACK.append(manual)
+            try:
+                return f(*xs)
+            finally:
+                _MANUAL_AXES_STACK.pop()
+
+        mapped = _raw_shard_map(body, *args, **kwargs)
+        if auto:
+            mapped = jax.jit(mapped)
+        return mapped
+
+try:  # flax >= 0.12
+    from flax.core import spmd as core_spmd  # type: ignore[attr-defined]
+except ImportError:  # flax 0.10/0.11: the same functions live in linen
+    from flax.linen import spmd as core_spmd  # type: ignore
+
+__all__ = ["shard_map", "core_spmd", "set_mesh", "get_abstract_mesh",
+           "manual_axis_names", "pallas_tpu_compiler_params",
+           "optimizer_update", "ensure_stacked_rng_state", "axis_size"]
+
+
+def set_mesh(mesh: jax.sharding.Mesh):
+    """Context manager installing ``mesh`` as the ambient mesh:
+    ``jax.set_mesh`` on JAX >= 0.6, the classic ``with mesh:`` resource-env
+    context on 0.4.x (a Mesh is its own context manager there)."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh
+
+
+def get_abstract_mesh():
+    """The ambient mesh installed by :func:`set_mesh` (empty when unset).
+    JAX 0.4.x predates abstract meshes; the physical resource-env mesh
+    carries the same ``.empty`` / ``.shape`` / ``.axis_names`` /
+    ``.shape_tuple`` interface the callers use."""
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        return jax.sharding.get_abstract_mesh()
+    from jax._src import mesh as _mesh_lib
+    return _mesh_lib.thread_resources.env.physical_mesh
+
+
+def manual_axis_names(mesh: Any) -> frozenset[str]:
+    """Mesh axes in Manual (shard_map) mode. JAX 0.4.x meshes predate
+    ``AxisType``, so there the answer comes from the innermost compat
+    :func:`shard_map` being traced (falling back to the named-axis env —
+    axes named there are mapped, constraining them is always wrong)."""
+    axis_types = getattr(mesh, "axis_types", None)
+    if axis_types is not None:
+        manual = jax.sharding.AxisType.Manual
+        return frozenset(n for n, t in zip(mesh.axis_names, axis_types)
+                         if t == manual)
+    mesh_axes = frozenset(getattr(mesh, "axis_names", ()))
+    if _MANUAL_AXES_STACK:
+        return _MANUAL_AXES_STACK[-1] & mesh_axes
+    try:
+        from jax._src import core as _core
+        return frozenset(_core.get_axis_env().axis_sizes) & mesh_axes
+    except (ImportError, AttributeError):
+        return frozenset()
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis (or tuple of axes) from inside
+    ``shard_map``: ``jax.lax.axis_size`` on JAX >= 0.6; on 0.4.x a
+    ``psum(1, axis)`` of a Python int constant-folds to the same static
+    value."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (JAX >= 0.6) / ``pltpu.TPUCompilerParams``
+    (0.4.x/0.5.x) — same fields, renamed class."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+_UPDATE_TAKES_MODEL = "model" in inspect.signature(
+    nnx.Optimizer.update).parameters
+
+
+def optimizer_update(optimizer: nnx.Optimizer, model: nnx.Module,
+                     grads: Any) -> None:
+    """``optimizer.update(model, grads)`` on flax >= 0.11; flax 0.10 bound
+    the model at construction and takes only ``grads``."""
+    if _UPDATE_TAKES_MODEL:
+        optimizer.update(model, grads)
+    else:
+        optimizer.update(grads)
+
+
+def ensure_stacked_rng_state(module: nnx.Module, depth: int) -> None:
+    """Stack any 0-d RngState leaves of a vmapped-constructor module to
+    ``(depth,)``. flax 0.10's ``nnx.vmap`` broadcasts RngState created inside
+    the mapped constructor instead of stacking it alongside the params, and
+    ``nnx.scan(..., in_axes=0)`` then fails slicing the scalars ("axis 0 is
+    out of bounds for array of dimension 0"). Keys are split per layer (so
+    dropout masks differ across layers, matching flax >= 0.11 semantics);
+    counts are broadcast. No-op when the state is already stacked."""
+    import jax.numpy as jnp
+
+    state = nnx.state(module, nnx.RngState)
+
+    def fix(v):
+        if getattr(v, "ndim", None) == 0:
+            if jnp.issubdtype(v.dtype, jax.dtypes.prng_key):
+                return jax.random.split(v, depth)
+            return jnp.broadcast_to(v, (depth,))
+        return v
+
+    nnx.update(module, jax.tree.map(fix, state))
+
+
+# flax 0.10 has no nnx.to_flat_state/from_flat_state module functions; the
+# same data lives on State.flat_state() / State.from_flat_path(). Backfill
+# the module-level names (imported for side effect by jimm_tpu/__init__, so
+# every later `nnx.to_flat_state` call sees them).
+if not hasattr(nnx, "to_flat_state"):
+    def _to_flat_state(state):
+        if not isinstance(state, nnx.State):
+            state = nnx.state(state)
+        # 0.10 modules keep disabled params around as Param(None) (e.g.
+        # Linear(use_bias=False).bias); newer flax omits them, and None is
+        # an empty pytree node anyway — drop for parity
+        return sorted((path, leaf) for path, leaf
+                      in state.flat_state().items()
+                      if getattr(leaf, "value", leaf) is not None)
+    nnx.to_flat_state = _to_flat_state  # type: ignore[attr-defined]
+    del _to_flat_state
+if not hasattr(nnx, "from_flat_state"):
+    def _from_flat_state(flat):
+        items = flat.items() if hasattr(flat, "items") else flat
+        return nnx.State.from_flat_path(dict(items))
+    nnx.from_flat_state = _from_flat_state  # type: ignore[attr-defined]
+    del _from_flat_state
+
+
+# flax 0.10's nnx.state chokes on State inputs ("Arrays leaves are not
+# supported") — but nnx.grad returns one, and filtering a grad State with
+# nnx.state(g, nnx.Param) is the natural modern spelling. Route State
+# inputs through State.filter instead (newer flax handles State natively,
+# so only patch the versions that need it).
+if hasattr(nnx, "VariableState"):  # flax 0.10/0.11 marker (dropped in 0.12)
+    _raw_nnx_state = nnx.state
+
+    def _nnx_state(node, *filters):
+        if isinstance(node, nnx.State):
+            return node.filter(*filters) if filters else node
+        return _raw_nnx_state(node, *filters)
+
+    nnx.state = _nnx_state
+
+# flax < 0.12 has no Variable.get_value/set_value (0.12 deprecates .value
+# access in their favor). Backfill them so call sites can use the modern
+# spelling everywhere. NB: hasattr on an *instanceless class* bypasses the
+# proxying ``Variable.__getattr__``, so this probes the class dict chain.
+_variable_classes = [nnx.Variable]
+if hasattr(nnx, "VariableState"):  # flax 0.10/0.11 state leaves
+    _variable_classes.append(nnx.VariableState)
+for _cls in _variable_classes:
+    if not hasattr(_cls, "get_value"):
+        _cls.get_value = lambda self: self.value  # type: ignore
+    if not hasattr(_cls, "set_value"):
+        def _set_value(self, value):
+            self.value = value
+        _cls.set_value = _set_value  # type: ignore
+        del _set_value
+    # newer Variables proxy array metadata to .value; 0.10 VariableState
+    # doesn't, so shape-census code (e.g. cli param counts) breaks on it
+    for _attr in ("shape", "dtype", "ndim", "size", "nbytes"):
+        if not hasattr(_cls, _attr):
+            setattr(_cls, _attr,
+                    property(lambda self, _a=_attr: getattr(self.value, _a)))
+del _variable_classes

@@ -18,6 +18,29 @@ import os
 _explicit: set[str] = set()
 
 
+def set_host_device_count(n: int) -> None:
+    """Request ``n`` virtual host (CPU) devices, portably across JAX
+    versions. The ``jax_num_cpu_devices`` config key exists only on
+    JAX >= 0.5; older versions fall back to the XLA flag, which takes
+    effect only if set before the backend first initializes. Raises
+    ``RuntimeError`` (from jax) if the backend is already up on >= 0.5."""
+    import jax
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except AttributeError:
+        import re
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={int(n)}"
+        if "--xla_force_host_platform_device_count" in flags:
+            # replace, don't skip: a stale value (e.g. inherited through the
+            # environment from a parent process) must not win over this call
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags)
+        else:
+            flags = f"{flags} {flag}".strip()
+        os.environ["XLA_FLAGS"] = flags
+
+
 def configure_platform(platform: str | None = None,
                        host_devices: int | None = None) -> None:
     """Apply backend overrides from arguments, falling back to the
@@ -42,4 +65,4 @@ def configure_platform(platform: str | None = None,
     if plat:
         jax.config.update("jax_platforms", plat)
     if n:
-        jax.config.update("jax_num_cpu_devices", int(n))
+        set_host_device_count(int(n))

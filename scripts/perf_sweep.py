@@ -41,7 +41,7 @@ def run(name: str, batch: int, remat: bool, attn: str, steps: int = 30,
     model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
                    param_dtype=jnp.bfloat16)
     optimizer = make_optimizer(model, OptimizerConfig(learning_rate=1e-3))
-    step_fn = make_contrastive_train_step("siglip")
+    step_fn = make_contrastive_train_step("siglip", donate=True)
     rng = np.random.RandomState(0)
     images = jnp.asarray(rng.randn(batch, 256, 256, 3), jnp.bfloat16)
     text = jnp.asarray(rng.randint(1, cfg.text.vocab_size, size=(batch, 64)),

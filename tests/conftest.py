@@ -13,7 +13,12 @@ os.environ.setdefault("XLA_FLAGS",
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # JAX < 0.5 has no jax_num_cpu_devices config key; the XLA_FLAGS
+    # fallback set above already forces 8 virtual host devices.
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,6 @@
+"""JL001 fixture: version-gated config key with no guard (line 6)."""
+
+import jax
+
+
+jax.config.update("jax_num_cpu_devices", 8)  # line 6: JL001

@@ -1,0 +1,15 @@
+"""JL003 fixtures: optimizer-carrying jit without donation (line 8) and a
+train-step builder call without an explicit donate= (line 15)."""
+
+from flax import nnx
+
+
+@nnx.jit
+def train_step(model, optimizer, images, labels):  # line 8: JL003
+    del images, labels
+    return model, optimizer
+
+
+def build():
+    from jimm_tpu.train import make_contrastive_train_step
+    return make_contrastive_train_step("siglip")  # line 15: JL003

@@ -1,0 +1,13 @@
+"""JL002 fixtures: host-device syncs inside a jitted function."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def leaky_step(x, threshold):
+    lr = float(threshold)          # line 9: JL002 float() on traced value
+    host = np.asarray(x)           # line 10: JL002 device->host copy
+    if x > 0:                      # line 11: JL002 branch on traced value
+        return x * lr + host.sum()
+    return x.sum().item()          # line 13: JL002 .item() sync

@@ -1,0 +1,15 @@
+"""JL005 fixtures: Pallas block shapes off the (8, 128) TPU tile and a VMEM
+scratch allocation over the budget."""
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import jax.numpy as jnp
+
+BLOCK_Q = 100
+ROWS = 12
+
+BAD_LANES = pl.BlockSpec((16, BLOCK_Q), lambda i: (i, 0))  # line 11: JL005
+BAD_SUBLANES = pl.BlockSpec((ROWS, 256), lambda i: (i, 0))  # line 12: JL005
+HUGE_SCRATCH = pltpu.VMEM((4096, 4096), jnp.float32)  # line 13: JL005 budget
+GOOD = pl.BlockSpec((8, 128), lambda i: (i, 0))
+GOOD_SCRATCH = pltpu.VMEM((8, 128), jnp.float32)

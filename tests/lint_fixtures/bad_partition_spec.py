@@ -1,0 +1,10 @@
+"""JL004 fixture: PartitionSpec axis outside the canonical mesh vocabulary.
+
+``"batch"`` is a *logical* axis name — putting it straight into a
+PartitionSpec silently shards nothing on a {data, model, ...} mesh.
+"""
+
+from jax.sharding import PartitionSpec as P
+
+SPEC = P("batch", None)  # line 9: JL004
+GOOD = P("data", None)

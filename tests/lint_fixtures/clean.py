@@ -1,0 +1,31 @@
+"""Clean counterexamples: the same shapes of code as the bad fixtures, but
+guarded/donated/canonical — plus suppression-comment demonstrations."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def guarded_config():
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)  # guarded: no JL001
+    except AttributeError:
+        pass
+
+
+SPEC = P("data", "model")  # canonical axes: no JL004
+
+# suppression on the same line:
+BAD_BUT_WAIVED = P("batch")  # jaxlint: disable=JL004 logical name on purpose
+
+# standalone-comment suppression applies to the next line:
+# jaxlint: disable=JL001 exercised by tests on both JAX lines
+jax.config.update("jax_num_cpu_devices", 8)
+
+
+@jax.jit
+def static_branches_ok(x, mask=None):
+    if mask is not None:      # `is None` test is static: no JL002
+        x = x + mask
+    if x.ndim == 3:           # shape metadata is static: no JL002
+        x = x.reshape(x.shape[0], -1)
+    return x

@@ -4,7 +4,10 @@ oracle on arbitrary text (not just the hand-picked prompts)."""
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+pytest.importorskip("hypothesis", reason="property-based tests need the "
+                                         "hypothesis package")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from jimm_tpu.data.tfrecord import (decode_example, encode_example,
                                     read_tfrecord, write_tfrecord)

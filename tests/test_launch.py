@@ -15,7 +15,7 @@ initialize_distributed()   # coordinator/world/rank all from launcher env
 assert jax.process_count() == 2, jax.process_count()
 assert jax.local_device_count() == 2
 import numpy as np
-from jax import shard_map
+from jimm_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 mesh = make_mesh({"data": -1})
 out = jax.jit(shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,

@@ -1,0 +1,115 @@
+"""Tests for the jimm_tpu.lint static analyzer (Layer 1 + CLI).
+
+The fixtures under tests/lint_fixtures/ are excluded from normal lint walks
+(see EXCLUDED_DIRS) and only linted when named explicitly, so the shipped
+tree stays clean while each rule keeps a living positive example.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from jimm_tpu.lint import ERROR, lint_file, lint_paths
+from jimm_tpu.lint.rules_ast import CANONICAL_MESH_AXES
+
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+REPO = Path(__file__).resolve().parent.parent
+
+
+def findings_for(name):
+    return lint_file(FIXTURES / name)
+
+
+def rules_and_lines(findings):
+    return {(f.rule, f.line) for f in findings}
+
+
+class TestRuleFixtures:
+    def test_jl001_unguarded_version_gated_config(self):
+        findings = findings_for("bad_config_gate.py")
+        assert rules_and_lines(findings) == {("JL001", 6)}
+        assert findings[0].severity == ERROR
+        assert "jax_num_cpu_devices" in findings[0].message
+
+    def test_jl002_host_sync_in_jit(self):
+        findings = findings_for("bad_host_sync.py")
+        assert rules_and_lines(findings) == {
+            ("JL002", 9),   # float() on traced value
+            ("JL002", 10),  # np.asarray on traced value
+            ("JL002", 11),  # Python `if` on traced value
+            ("JL002", 13),  # .item()
+        }
+
+    def test_jl003_missing_donation(self):
+        findings = findings_for("bad_donation.py")
+        assert rules_and_lines(findings) == {
+            ("JL003", 8),   # optimizer-carrying nnx.jit without donate_argnums
+            ("JL003", 15),  # builder call without donate=
+        }
+
+    def test_jl004_non_canonical_partition_spec(self):
+        findings = findings_for("bad_partition_spec.py")
+        assert rules_and_lines(findings) == {("JL004", 9)}
+        assert "'batch'" in findings[0].message
+
+    def test_jl005_pallas_tiling_and_vmem(self):
+        findings = findings_for("bad_pallas.py")
+        assert rules_and_lines(findings) == {
+            ("JL005", 11),  # lane dim 100 not %128
+            ("JL005", 12),  # sublane dim 12 not %8
+            ("JL005", 13),  # VMEM scratch over budget
+        }
+
+    def test_jl005_budget_is_configurable(self):
+        findings = lint_file(FIXTURES / "bad_pallas.py",
+                             vmem_budget=256 * 1024 * 1024)
+        # with a 256 MiB budget the 64 MiB scratch is fine; tiling still fires
+        assert rules_and_lines(findings) == {("JL005", 11), ("JL005", 12)}
+
+    def test_clean_counterexamples_and_suppression(self):
+        # guarded config, canonical specs, static branches, and both
+        # same-line and next-line `# jaxlint: disable=` forms: no findings
+        assert findings_for("clean.py") == []
+
+
+class TestTreeInvariants:
+    def test_canonical_axes_match_mesh_module(self):
+        from jimm_tpu.parallel.mesh import MESH_AXES
+        assert CANONICAL_MESH_AXES == frozenset(MESH_AXES)
+
+    def test_fixtures_excluded_from_directory_walks(self):
+        findings = lint_paths([str(FIXTURES.parent)])
+        assert not any("lint_fixtures" in f.path for f in findings)
+
+    def test_shipped_tree_is_clean(self):
+        findings = [f for f in lint_paths([str(REPO / "jimm_tpu")])
+                    if f.severity == ERROR]
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
+class TestCli:
+    def run_cli(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "jimm_tpu.lint", *args],
+            capture_output=True, text=True, cwd=REPO,
+        )
+
+    def test_broken_fixture_fails_with_json_report(self):
+        proc = self.run_cli(str(FIXTURES / "bad_partition_spec.py"), "--json")
+        assert proc.returncode == 1
+        report = json.loads(proc.stdout)
+        assert [(f["rule"], f["line"]) for f in report] == [("JL004", 9)]
+        assert report[0]["path"].endswith("bad_partition_spec.py")
+        assert report[0]["severity"] == "error"
+
+    def test_clean_fixture_exits_zero(self):
+        proc = self.run_cli(str(FIXTURES / "clean.py"), "--json")
+        assert proc.returncode == 0
+        assert json.loads(proc.stdout) == []
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))
