@@ -986,7 +986,9 @@ def cmd_classify(args: argparse.Namespace) -> int:
     from jimm_tpu.data.preprocess import (CLIP_MEAN, CLIP_STD, SIGLIP_MEAN,
                                           SIGLIP_STD, preprocess_batch)
     from jimm_tpu.data.records import decode_image, pad_tokens
-    from jimm_tpu.utils import jit_forward
+    from jimm_tpu.serve.cache import class_embedding_cache, prompt_set_key
+    from jimm_tpu.utils.zero_shot import (weights_from_rows,
+                                          zero_shot_logits_from_features)
 
     model_cls = _model_cls(args.model)
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
@@ -1050,6 +1052,23 @@ def cmd_classify(args: argparse.Namespace) -> int:
     text = jnp.asarray(np.stack(
         [pad_tokens(r, cfg.text.context_length) for r in rows]))
 
+    # class weights go through the serving embedding cache, keyed on
+    # (checkpoint, family, dtype, token rows): repeat classify calls in one
+    # process — and the `jimm-tpu serve` endpoint — skip the text tower.
+    # Non-ensemble is the one-row-per-class special case of the same
+    # normalize/mean/renormalize math, so every path shares one matmul form.
+    if args.ensemble:
+        n_templates = text.shape[0] // len(labels)
+        owner = [i // n_templates for i in range(text.shape[0])]
+    else:
+        owner = list(range(len(labels)))
+    model_key = (f"{args.model}:{args.ckpt}:"
+                 f"{'bf16' if args.bf16 else 'f32'}")
+    weights = class_embedding_cache().get_or_build(
+        prompt_set_key(model_key, np.asarray(text)),
+        lambda: np.asarray(
+            weights_from_rows(model, text, owner, len(labels)), np.float32))
+
     with open(args.image, "rb") as f:
         img = decode_image(f.read())
     mean, std = ((CLIP_MEAN, CLIP_STD) if args.model == "clip"
@@ -1067,19 +1086,9 @@ def cmd_classify(args: argparse.Namespace) -> int:
         patches, shapes, mask = patchify_naflex(
             [im], patch_size=cfg.vision.patch_size,
             max_num_patches=cfg.vision.num_patches)
-        if args.ensemble:
-            from jimm_tpu.utils.zero_shot import (
-                classifier_weights, zero_shot_logits_from_features)
-            weights = classifier_weights(model, text, len(labels))
-            feats = model.encode_image_naflex(
-                jnp.asarray(patches, dtype), jnp.asarray(shapes),
-                jnp.asarray(mask))
-            logits = np.asarray(zero_shot_logits_from_features(
-                model, feats, weights), np.float32)[0]
-        else:
-            logits = np.asarray(model.logits_naflex(
-                jnp.asarray(patches, dtype), jnp.asarray(shapes),
-                jnp.asarray(mask), text), np.float32)[0]
+        feats = model.encode_image_naflex(
+            jnp.asarray(patches, dtype), jnp.asarray(shapes),
+            jnp.asarray(mask))
     else:
         # CLIP checkpoints are trained with shortest-side resize + center
         # crop; SigLIP's processor resizes straight to the square
@@ -1087,16 +1096,9 @@ def cmd_classify(args: argparse.Namespace) -> int:
                                  image_size=cfg.vision.image_size,
                                  mean=mean, std=std,
                                  crop=args.model == "clip")
-        images = jnp.asarray(batch, dtype)
-        if args.ensemble:
-            from jimm_tpu.utils.zero_shot import (classifier_weights,
-                                                  zero_shot_logits)
-            weights = classifier_weights(model, text, len(labels))
-            logits = np.asarray(zero_shot_logits(model, images, weights),
-                                np.float32)[0]
-        else:
-            logits = np.asarray(jit_forward(model)(images, text),
-                                np.float32)[0]
+        feats = model.encode_image(jnp.asarray(batch, dtype))
+    logits = np.asarray(zero_shot_logits_from_features(
+        model, feats, jnp.asarray(weights)), np.float32)[0]
     if args.model == "siglip":
         scores = 1.0 / (1.0 + np.exp(-logits))  # per-pair sigmoid
     else:
@@ -1217,6 +1219,83 @@ def cmd_bench_forward(args: argparse.Namespace) -> int:
     dt = (time.perf_counter() - t0) / args.steps
     print(f"{args.preset}: {args.batch_size / dt:.1f} images/sec "
           f"({dt * 1e3:.2f} ms/batch of {args.batch_size})")
+    return 0
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    """HTTP micro-batching inference server (see docs/serving.md).
+
+    Loads a checkpoint (or random-initializes a preset — wiring and latency
+    smoke tests without weights), warm-compiles every batch bucket, then
+    serves ``/v1/embed`` and ``/v1/classify`` with bounded-queue admission
+    control. ``/healthz`` and ``/metrics`` report engine state.
+    """
+    _configure_backend(args)
+    import json
+    import time
+
+    import jax.numpy as jnp
+    from flax import nnx
+
+    from jimm_tpu import preset
+    from jimm_tpu.serve import (AdmissionPolicy, BucketTable, InferenceEngine,
+                                ServingServer, ZeroShotService,
+                                counting_forward, default_buckets)
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    if args.ckpt:
+        fam = args.model or (_family(args.preset) if args.preset else None)
+        if fam is None:
+            raise SystemExit("--ckpt needs --model (or --preset) to pick "
+                             "the model family")
+        model = _model_cls(fam).from_pretrained(args.ckpt, dtype=dtype)
+        model_key = f"{fam}:{args.ckpt}"
+    elif args.preset:
+        fam = _family(args.preset)
+        cfg = preset(args.preset)
+        if args.tiny:
+            cfg = _tiny_override(cfg)
+        model = _model_cls(fam)(cfg, rngs=nnx.Rngs(0), dtype=dtype,
+                                param_dtype=dtype)
+        model_key = f"{fam}:{args.preset}" + (":tiny" if args.tiny else "")
+    else:
+        raise SystemExit("need --ckpt (with --model) or --preset")
+    model_key += ":bf16" if args.bf16 else ":f32"
+
+    method = "encode_image" if fam in ("clip", "siglip") else "__call__"
+    forward, trace_count = counting_forward(model, method)
+    buckets = (BucketTable(tuple(int(s) for s in args.buckets.split(",")))
+               if args.buckets else default_buckets())
+    policy = AdmissionPolicy(max_queue=args.queue_size,
+                             default_timeout_s=args.timeout_s,
+                             shed_fraction=args.shed_fraction)
+    size = model.config.vision.image_size
+    engine = InferenceEngine(forward, item_shape=(size, size, 3),
+                             buckets=buckets,
+                             max_delay_ms=args.max_delay_ms, policy=policy,
+                             trace_count=trace_count)
+    zero_shot = (ZeroShotService(model, model_key=model_key)
+                 if fam in ("clip", "siglip") else None)
+    logger = None
+    if args.metrics_file:
+        from jimm_tpu.train.metrics import MetricsLogger
+        logger = MetricsLogger(path=args.metrics_file,
+                               print_every=10 ** 9)  # JSONL only, no console
+    server = ServingServer(engine, zero_shot=zero_shot, host=args.host,
+                           port=args.port, metrics_logger=logger,
+                           metrics_log_every_s=args.metrics_every_s)
+    t0 = time.monotonic()
+    server.start()
+    print(json.dumps({"status": "serving", "host": args.host,
+                      "port": server.port, "model": model_key,
+                      "buckets": list(buckets.sizes),
+                      "warmup_s": round(time.monotonic() - t0, 3),
+                      "compile_count": trace_count()}), flush=True)
+    if args.max_seconds:
+        time.sleep(args.max_seconds)
+        server.stop()
+    else:
+        server.serve_forever()
     return 0
 
 
@@ -1465,6 +1544,44 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("build-native",
                         help="compile native/libjimm_preprocess.so")
     sp.set_defaults(fn=cmd_build_native)
+
+    sp = sub.add_parser("serve",
+                        help="HTTP micro-batching inference server")
+    sp.add_argument("--ckpt", default=None,
+                    help="checkpoint: local safetensors file/dir or HF repo")
+    sp.add_argument("--model", default=None,
+                    choices=["vit", "clip", "siglip"],
+                    help="model family of --ckpt")
+    sp.add_argument("--preset", default=None,
+                    help="random-init a preset instead of --ckpt (wiring/"
+                         "latency smoke tests)")
+    sp.add_argument("--tiny", action="store_true")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8000,
+                    help="listening port (0 = pick a free one)")
+    sp.add_argument("--buckets", default=None,
+                    help="comma-separated batch buckets to warm-compile, "
+                         'e.g. "1,4,16,64" (default: platform table)')
+    sp.add_argument("--max-delay-ms", type=float, default=5.0,
+                    help="micro-batch coalescing window")
+    sp.add_argument("--queue-size", type=int, default=256,
+                    help="admission bound; requests past it get a 503 "
+                         "queue_full")
+    sp.add_argument("--timeout-s", type=float, default=5.0,
+                    help="default per-request deadline")
+    sp.add_argument("--shed-fraction", type=float, default=0.5,
+                    help="queue fill fraction past which the batcher stops "
+                         "waiting for stragglers")
+    sp.add_argument("--max-seconds", type=float, default=None,
+                    help="serve this long then exit (scripted smoke runs; "
+                         "default: until Ctrl-C)")
+    sp.add_argument("--metrics-file", default=None,
+                    help="append metric snapshots as JSONL "
+                         "(train/metrics.py format)")
+    sp.add_argument("--metrics-every-s", type=float, default=10.0)
+    sp.add_argument("--bf16", action="store_true")
+    _add_backend_flags(sp)
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("bench-forward", help="jitted forward throughput")
     sp.add_argument("--preset", required=True)

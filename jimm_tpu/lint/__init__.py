@@ -1,6 +1,6 @@
 """``jimm_tpu.lint`` — TPU-correctness static analyzer.
 
-Layer 1 (always on) is pure-``ast`` rules JL001–JL005 over the source tree;
+Layer 1 (always on) is pure-``ast`` rules JL001–JL006 over the source tree;
 layer 2 (``--trace``) lowers registered model entry points and asserts
 program-text properties JLT101–JLT103. See ``docs/static_analysis.md`` for
 the rule catalog and suppression syntax (``# jaxlint: disable=<rule>``).

@@ -15,6 +15,10 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   vocabulary (a typo'd axis silently shards nothing).
 - **JL005** Pallas block/VMEM shapes that violate the TPU (8, 128)
   sublane/lane tiling or exceed the VMEM budget estimate.
+- **JL006** blocking host sync (``.block_until_ready()``, ``np.asarray``,
+  ``jax.device_get``, ``.item()``) inside an ``async def`` in serving code —
+  it stalls the event loop that is supposed to keep coalescing batches;
+  device waits belong in sync ``*_blocking`` helpers run via an executor.
 """
 
 from __future__ import annotations
@@ -441,6 +445,72 @@ def check_pallas_tiling(tree: ast.AST, path: str,
 
 
 # ---------------------------------------------------------------------------
+# JL006 — blocking host sync on the serve event loop
+# ---------------------------------------------------------------------------
+
+#: dotted call names that materialize device data on host (block the caller)
+HOST_SYNC_CALLS = frozenset({"np.asarray", "np.array", "numpy.asarray",
+                             "numpy.array", "onp.asarray", "jax.device_get",
+                             "device_get"})
+
+
+def _path_is_serve(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "serve" in parts or parts[-1] == "serve.py"
+
+
+def check_async_host_sync(tree: ast.AST, path: str) -> list[Finding]:
+    """JL006: in serving code, ``async def`` bodies run on the engine's
+    event loop — the thing that must stay free to coalesce batches. A
+    blocking host sync there stalls every in-flight request. Sync helper
+    functions (run via ``run_in_executor``) are the sanctioned home for
+    device waits, so nested sync ``def``/``lambda`` bodies are exempt."""
+    if not _path_is_serve(path):
+        return []
+    findings = []
+    for fn in ast.walk(tree):
+        if isinstance(fn, ast.AsyncFunctionDef):
+            findings += _scan_async_body(fn, path)
+    return findings
+
+
+def _scan_async_body(fn: ast.AsyncFunctionDef, path: str) -> list[Finding]:
+    findings = []
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # different execution context (executor helpers / the
+            #           outer walk already visits nested async defs)
+        if isinstance(node, ast.Call):
+            fname = _dotted(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "block_until_ready":
+                findings.append(Finding(
+                    "JL006", ERROR, path, node.lineno,
+                    f".block_until_ready() inside async `{fn.name}` blocks "
+                    f"the serve event loop — move the device wait into a "
+                    f"sync *_blocking helper run via run_in_executor"))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                findings.append(Finding(
+                    "JL006", ERROR, path, node.lineno,
+                    f".item() inside async `{fn.name}` forces a host sync "
+                    f"on the serve event loop — read results in a sync "
+                    f"*_blocking helper run via run_in_executor"))
+            elif fname in HOST_SYNC_CALLS:
+                findings.append(Finding(
+                    "JL006", ERROR, path, node.lineno,
+                    f"{fname}() inside async `{fn.name}` can block the "
+                    f"serve event loop on a device transfer — do host "
+                    f"materialization in a sync *_blocking helper run via "
+                    f"run_in_executor"))
+        stack.extend(ast.iter_child_nodes(node))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -451,4 +521,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_train_step_donation(tree, path)
     findings += check_partition_spec_axes(tree, path)
     findings += check_pallas_tiling(tree, path, vmem_budget)
+    findings += check_async_host_sync(tree, path)
     return findings

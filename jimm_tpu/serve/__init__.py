@@ -1,0 +1,34 @@
+"""``jimm_tpu.serve`` — async micro-batching inference serving.
+
+The path from a loaded checkpoint to sustained request traffic: an asyncio
+engine coalesces single requests into micro-batches, pads them into a fixed
+set of warm-compiled shape buckets (zero recompiles after warmup — the
+runtime side of the linter's JLT103 discipline), a small LRU skips the text
+tower on repeat zero-shot label sets, and bounded-queue admission control
+with per-request deadlines keeps overload behavior predictable. Front end
+and client are stdlib-only. See ``docs/serving.md``.
+"""
+
+from jimm_tpu.serve.admission import (AdmissionController, AdmissionPolicy,
+                                      DeadlineExceededError, EngineClosedError,
+                                      QueueFullError, RequestError,
+                                      ServeError, ServeMetrics)
+from jimm_tpu.serve.buckets import (DEFAULT_BATCH_BUCKETS, TPU_BATCH_BUCKETS,
+                                    BucketTable, default_buckets, pad_batch)
+from jimm_tpu.serve.cache import (EmbeddingCache, class_embedding_cache,
+                                  prompt_set_key)
+from jimm_tpu.serve.client import (ServeClient, ServeClientError,
+                                   encode_image_payload)
+from jimm_tpu.serve.engine import InferenceEngine, counting_forward
+from jimm_tpu.serve.server import (ServingServer, ZeroShotService,
+                                   decode_image_payload)
+
+__all__ = [
+    "AdmissionController", "AdmissionPolicy", "BucketTable",
+    "DEFAULT_BATCH_BUCKETS", "DeadlineExceededError", "EmbeddingCache",
+    "EngineClosedError", "InferenceEngine", "QueueFullError", "RequestError",
+    "ServeClient", "ServeClientError", "ServeError", "ServeMetrics",
+    "ServingServer", "TPU_BATCH_BUCKETS", "ZeroShotService",
+    "class_embedding_cache", "counting_forward", "decode_image_payload",
+    "default_buckets", "encode_image_payload", "pad_batch", "prompt_set_key",
+]

@@ -1,0 +1,196 @@
+"""Backpressure, deadlines, and serve observability.
+
+A server in front of a fixed-rate accelerator must bound its queue: without
+admission control a burst turns into unbounded memory growth and every
+request timing out at once. The policy here is the standard trio —
+
+- **bounded queue**: past ``max_queue`` pending requests, new submissions are
+  rejected immediately with a typed :class:`QueueFullError` (the client can
+  back off; a 503 beats a silent 30 s stall),
+- **per-request deadlines**: every request carries one; expired requests are
+  cancelled (client side) and dropped at dispatch (server side) instead of
+  wasting a batch slot on an answer nobody is waiting for,
+- **graceful degradation**: above the ``shed_fraction`` watermark the
+  batcher stops waiting out the coalescing window and dispatches the largest
+  already-full *smaller* bucket — latency degrades to compute-bound, not
+  queue-bound.
+
+Metrics are plain counters/gauges with a Prometheus text rendering and a
+flat-float ``snapshot()`` that plugs straight into
+``jimm_tpu.train.metrics.MetricsLogger.log`` (same JSONL plumbing training
+uses).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+
+class ServeError(Exception):
+    """Base class of typed serving errors; carries an HTTP status and a
+    stable machine-readable code for clients."""
+
+    code = "serve_error"
+    http_status = 500
+
+
+class QueueFullError(ServeError):
+    code = "queue_full"
+    http_status = 503
+
+
+class DeadlineExceededError(ServeError):
+    code = "deadline_exceeded"
+    http_status = 504
+
+
+class RequestError(ServeError):
+    """Malformed request (wrong image shape, bad payload)."""
+
+    code = "bad_request"
+    http_status = 400
+
+
+class EngineClosedError(ServeError):
+    code = "engine_closed"
+    http_status = 503
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionPolicy:
+    """Queue bound, default deadline, and the shed watermark."""
+
+    max_queue: int = 256
+    default_timeout_s: float = 5.0
+    shed_fraction: float = 0.5
+
+    @property
+    def shed_depth(self) -> int:
+        """Queue depth at which coalescing stops waiting (>= 1 so an empty
+        queue never counts as pressure)."""
+        return max(1, int(self.max_queue * self.shed_fraction))
+
+
+class ServeMetrics:
+    """Counters, gauges, and a bounded latency reservoir for p50/p99.
+
+    Thread-safe: the HTTP front end observes from handler threads while the
+    engine loop observes from the event loop. ``bind_gauge`` registers a
+    callable gauge (cache hit rate, compile count) evaluated at render time.
+    """
+
+    COUNTERS = ("requests_total", "responses_total", "timeouts_total",
+                "rejected_total", "cancelled_total", "shed_batches_total",
+                "errors_total", "batches_total", "batch_items_total",
+                "batch_slots_total")
+
+    def __init__(self, latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self._counters = {name: 0 for name in self.COUNTERS}
+        self._latencies: deque[float] = deque(maxlen=latency_window)
+        self._gauges: dict[str, Callable[[], float]] = {}
+        self.queue_depth = 0
+        self._t_start = time.monotonic()
+
+    # -- observation ------------------------------------------------------
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + by
+
+    def set_queue_depth(self, depth: int) -> None:
+        self.queue_depth = depth
+
+    def observe_batch(self, items: int, bucket: int, *,
+                      shed: bool = False) -> None:
+        with self._lock:
+            self._counters["batches_total"] += 1
+            self._counters["batch_items_total"] += items
+            self._counters["batch_slots_total"] += bucket
+            if shed:
+                self._counters["shed_batches_total"] += 1
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(seconds)
+
+    def bind_gauge(self, name: str, fn: Callable[[], float]) -> None:
+        self._gauges[name] = fn
+
+    # -- derived ----------------------------------------------------------
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def latency_percentile(self, pct: float) -> float:
+        with self._lock:
+            data = sorted(self._latencies)
+        if not data:
+            return 0.0
+        idx = min(len(data) - 1, int(round(pct / 100.0 * (len(data) - 1))))
+        return data[idx]
+
+    @property
+    def batch_fill_ratio(self) -> float:
+        with self._lock:
+            slots = self._counters["batch_slots_total"]
+            items = self._counters["batch_items_total"]
+        return items / slots if slots else 0.0
+
+    def snapshot(self) -> dict:
+        """Flat float/int dict: healthz payload, and directly loggable via
+        ``MetricsLogger.log(step, **metrics.snapshot())``."""
+        with self._lock:
+            out = dict(self._counters)
+        out["queue_depth"] = self.queue_depth
+        out["batch_fill_ratio"] = round(self.batch_fill_ratio, 4)
+        out["latency_p50_ms"] = round(self.latency_percentile(50) * 1e3, 3)
+        out["latency_p99_ms"] = round(self.latency_percentile(99) * 1e3, 3)
+        out["uptime_s"] = round(time.monotonic() - self._t_start, 3)
+        for name, fn in self._gauges.items():
+            try:
+                out[name] = float(fn())
+            except Exception:  # noqa: BLE001 — a gauge must not kill /metrics
+                pass
+        return out
+
+    def render_prometheus(self, prefix: str = "jimm_serve") -> str:
+        """Prometheus text exposition of the snapshot (counters keep their
+        ``_total`` names; everything else renders as a gauge)."""
+        lines = []
+        for key, value in sorted(self.snapshot().items()):
+            kind = "counter" if key.endswith("_total") else "gauge"
+            lines.append(f"# TYPE {prefix}_{key} {kind}")
+            lines.append(f"{prefix}_{key} {value}")
+        return "\n".join(lines) + "\n"
+
+
+class AdmissionController:
+    """Applies an :class:`AdmissionPolicy` at the submit boundary."""
+
+    def __init__(self, policy: AdmissionPolicy | None = None,
+                 metrics: ServeMetrics | None = None):
+        self.policy = policy or AdmissionPolicy()
+        self.metrics = metrics or ServeMetrics()
+
+    def admit(self, queue_depth: int) -> None:
+        """Raise :class:`QueueFullError` when the queue is at capacity."""
+        if queue_depth >= self.policy.max_queue:
+            self.metrics.inc("rejected_total")
+            raise QueueFullError(
+                f"queue full ({queue_depth}/{self.policy.max_queue} pending);"
+                f" retry with backoff")
+
+    def under_pressure(self, queue_depth: int) -> bool:
+        """True when the batcher should shed (skip the coalescing wait)."""
+        return queue_depth >= self.policy.shed_depth
+
+    def deadline_for(self, timeout_s: float | None, now: float) -> float:
+        timeout = (self.policy.default_timeout_s
+                   if timeout_s is None else timeout_s)
+        return now + max(timeout, 0.0)

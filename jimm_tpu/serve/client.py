@@ -1,0 +1,96 @@
+"""Pure-Python client for the jimm-tpu serving endpoint.
+
+Stdlib only (``http.client`` + ``json`` + ``base64``): usable from any
+process without installing jimm_tpu's accelerator stack. Arrays go over the
+wire as base64 raw float32 when the input quacks like a numpy array
+(``astype``/``tobytes``), else as nested JSON lists — matching what
+``serve.server`` accepts.
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+
+
+class ServeClientError(Exception):
+    """Server-reported error: carries the HTTP status and the typed code
+    (``queue_full``, ``deadline_exceeded``, ``bad_request``, ...)."""
+
+    def __init__(self, status: int, code: str, message: str):
+        super().__init__(f"{code} (HTTP {status}): {message}")
+        self.status = status
+        self.code = code
+
+
+def encode_image_payload(image) -> dict:
+    """The wire form of one image: b64 float32 for array-likes, nested
+    lists otherwise."""
+    if hasattr(image, "astype") and hasattr(image, "tobytes"):
+        arr = image.astype("float32")
+        return {"image_b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+                "shape": list(arr.shape), "dtype": "float32"}
+    return {"image": image}
+
+
+class ServeClient:
+    """One server endpoint; each call opens a fresh connection, so a client
+    instance is safe to share across threads."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 timeout_s: float = 30.0):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+
+    # -- transport --------------------------------------------------------
+
+    def _request(self, method: str, path: str, payload: dict | None = None):
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout_s)
+        try:
+            body = None if payload is None else json.dumps(payload).encode()
+            headers = {"Content-Type": "application/json"} if body else {}
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+        finally:
+            conn.close()
+        content_type = resp.getheader("Content-Type") or ""
+        if not content_type.startswith("application/json"):
+            if resp.status >= 400:
+                raise ServeClientError(resp.status, "http_error",
+                                       raw.decode(errors="replace")[:200])
+            return raw.decode(errors="replace")
+        obj = json.loads(raw)
+        if resp.status >= 400:
+            raise ServeClientError(resp.status,
+                                   obj.get("error", "http_error"),
+                                   obj.get("message", ""))
+        return obj
+
+    # -- API --------------------------------------------------------------
+
+    def healthz(self) -> dict:
+        return self._request("GET", "/healthz")
+
+    def metrics_text(self) -> str:
+        return self._request("GET", "/metrics")
+
+    def embed(self, image, timeout_s: float | None = None) -> list:
+        payload = encode_image_payload(image)
+        if timeout_s is not None:
+            payload["timeout_s"] = timeout_s
+        return self._request("POST", "/v1/embed", payload)["features"]
+
+    def classify(self, image, tokens: dict,
+                 timeout_s: float | None = None) -> dict:
+        """``tokens``: ``{label: [ids]}`` (or ``{label: [[ids], ...]}`` for
+        prompt ensembles). Returns ``{"scores": {label: p}, "cached": b}``.
+        """
+        payload = encode_image_payload(image)
+        payload["tokens"] = tokens
+        if timeout_s is not None:
+            payload["timeout_s"] = timeout_s
+        return self._request("POST", "/v1/classify", payload)

@@ -1,0 +1,329 @@
+"""Stdlib HTTP front end for the inference engine.
+
+``ThreadingHTTPServer`` handler threads bridge into the engine's asyncio
+loop with ``run_coroutine_threadsafe`` — the loop does all coalescing and
+dispatch; handler threads only parse/serialize JSON and block on their own
+request's future. No framework, no new dependencies.
+
+Endpoints::
+
+    GET  /healthz      liveness + queue depth / fill ratio snapshot
+    GET  /metrics      Prometheus text exposition (jimm_serve_* series)
+    POST /v1/embed     {"image": [[...]]} -> {"features": [...]}
+    POST /v1/classify  {"image": ..., "tokens": {label: [ids]}}
+                       -> {"scores": {label: p}, "cached": bool}
+
+Images ride as nested JSON lists or as ``{"image_b64": base64(raw float32),
+"shape": [H, W, C]}`` (the client picks b64 when it can). Typed
+:class:`~jimm_tpu.serve.admission.ServeError`\\ s map to their HTTP status
+with a machine-readable ``error`` code in the JSON body.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from jimm_tpu.serve.admission import RequestError, ServeError, ServeMetrics
+from jimm_tpu.serve.cache import (EmbeddingCache, class_embedding_cache,
+                                  prompt_set_key)
+from jimm_tpu.serve.engine import InferenceEngine
+
+
+def decode_image_payload(payload: dict, *, dtype=np.float32) -> np.ndarray:
+    """Pull the image array out of a request body (list or b64 form)."""
+    if "image" in payload:
+        try:
+            return np.asarray(payload["image"], dtype)
+        except (TypeError, ValueError) as e:
+            raise RequestError(f"bad 'image' payload: {e}") from None
+    if "image_b64" in payload:
+        if "shape" not in payload:
+            raise RequestError("'image_b64' needs 'shape'")
+        raw = base64.b64decode(payload["image_b64"])
+        wire = np.dtype(payload.get("dtype", "float32"))
+        try:
+            arr = np.frombuffer(raw, wire).reshape(payload["shape"])
+        except ValueError as e:
+            raise RequestError(f"bad 'image_b64' payload: {e}") from None
+        return arr.astype(dtype, copy=False)
+    raise RequestError("request needs 'image' or 'image_b64'")
+
+
+class ZeroShotService:
+    """Zero-shot classification over the engine's image features.
+
+    Class weights come from the embedding cache keyed by (model, token
+    rows); on repeat label sets the text tower never runs. The per-request
+    work after the engine returns features is one small host matmul.
+    """
+
+    def __init__(self, model, *, model_key: str,
+                 cache: EmbeddingCache | None = None):
+        self.model = model
+        self.model_key = model_key
+        self.cache = cache if cache is not None else class_embedding_cache()
+        self.context_length = model.config.text.context_length
+        self._scale = float(np.exp(np.asarray(model.logit_scale[...],
+                                              np.float32)))
+        bias = getattr(model, "logit_bias", None)
+        self._bias = (None if bias is None
+                      else float(np.asarray(bias[...], np.float32)))
+
+    def class_weights_blocking(self, table: dict
+                               ) -> tuple[list[str], np.ndarray, bool]:
+        """(labels, (C, D) unit-norm weights, was_cached). Runs the text
+        tower only on a cache miss; call from a handler thread, not the
+        event loop."""
+        from jimm_tpu.utils.zero_shot import (token_table_rows,
+                                              weights_from_rows)
+        try:
+            labels, rows, owner = token_table_rows(table, self.context_length)
+        except (ValueError, TypeError) as e:
+            raise RequestError(str(e)) from None
+        key = prompt_set_key(self.model_key, np.asarray(rows))
+        cached = self.cache.get(key)
+        if cached is not None:
+            return labels, cached, True
+        weights = np.asarray(
+            weights_from_rows(self.model, rows, owner, len(labels)),
+            np.float32)
+        self.cache.put(key, weights)
+        return labels, weights, False
+
+    def scores(self, features: np.ndarray, weights: np.ndarray) -> np.ndarray:
+        """Calibrated per-class scores from one feature row: softmax over
+        labels (CLIP) or per-class sigmoid (SigLIP, has logit_bias)."""
+        feat = features.astype(np.float32)
+        feat /= np.linalg.norm(feat)
+        logits = self._scale * feat @ weights.T
+        if self._bias is not None:
+            return 1.0 / (1.0 + np.exp(-(logits + self._bias)))
+        e = np.exp(logits - logits.max())
+        return e / e.sum()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ---------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # noqa: A003 — silence per-request log
+        pass
+
+    def _send(self, status: int, body: bytes,
+              content_type: str = "application/json") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, status: int, obj) -> None:
+        self._send(status, json.dumps(obj).encode())
+
+    def _send_error_obj(self, e: Exception) -> None:
+        if isinstance(e, ServeError):
+            self._send_json(e.http_status,
+                            {"error": e.code, "message": str(e)})
+        else:
+            self.server.app.metrics.inc("errors_total")
+            self._send_json(500, {"error": "internal", "message": str(e)})
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            raise RequestError("empty request body")
+        try:
+            payload = json.loads(self.rfile.read(length))
+        except ValueError as e:
+            raise RequestError(f"bad JSON body: {e}") from None
+        if not isinstance(payload, dict):
+            raise RequestError("request body must be a JSON object")
+        return payload
+
+    # -- routes -----------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        app = self.server.app
+        if self.path == "/healthz":
+            self._send_json(200, app.healthz())
+        elif self.path == "/metrics":
+            self._send(200, app.metrics.render_prometheus().encode(),
+                       "text/plain; version=0.0.4")
+        else:
+            self._send_json(404, {"error": "not_found",
+                                  "message": self.path})
+
+    def do_POST(self) -> None:  # noqa: N802
+        app = self.server.app
+        try:
+            payload = self._read_body()
+            if self.path == "/v1/embed":
+                self._send_json(200, app.embed(payload))
+            elif self.path == "/v1/classify":
+                self._send_json(200, app.classify(payload))
+            else:
+                self._send_json(404, {"error": "not_found",
+                                      "message": self.path})
+        except Exception as e:  # noqa: BLE001 — every error gets a response
+            self._send_error_obj(e)
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    app: "ServingServer"
+
+
+class ServingServer:
+    """Owns the engine loop thread and the HTTP server thread.
+
+    ``start()`` warm-compiles every bucket, spins up the asyncio loop,
+    starts the engine on it, then opens the listening socket — so the first
+    client request already hits warm executables.
+    """
+
+    def __init__(self, engine: InferenceEngine, *,
+                 zero_shot: ZeroShotService | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 request_timeout_s: float = 30.0, warmup: bool = True,
+                 metrics_logger=None, metrics_log_every_s: float = 10.0):
+        self.engine = engine
+        self.zero_shot = zero_shot
+        self.metrics: ServeMetrics = engine.metrics
+        if zero_shot is not None:
+            self.metrics.bind_gauge("cache_hit_rate",
+                                    lambda: zero_shot.cache.hit_rate)
+        self.host = host
+        self._requested_port = port
+        self.request_timeout_s = request_timeout_s
+        self._warmup = warmup
+        #: train/metrics.py-compatible plumbing: a MetricsLogger (or
+        #: anything with .log(step, **metrics)) gets a snapshot every
+        #: metrics_log_every_s — same JSONL/TensorBoard sinks training uses
+        self.metrics_logger = metrics_logger
+        self.metrics_log_every_s = metrics_log_every_s
+        self._log_thread: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._loop_thread: threading.Thread | None = None
+        self._httpd: _Server | None = None
+        self._http_thread: threading.Thread | None = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        if self._loop is not None:
+            return
+        if self._warmup:
+            self.engine.warmup_blocking()
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+
+        def run() -> None:
+            asyncio.set_event_loop(loop)
+            loop.call_soon(started.set)
+            loop.run_forever()
+
+        self._loop_thread = threading.Thread(target=run, daemon=True,
+                                             name="jimm-serve-loop")
+        self._loop_thread.start()
+        started.wait()
+        self._loop = loop
+        asyncio.run_coroutine_threadsafe(self.engine.start(), loop).result(10)
+        self._httpd = _Server((self.host, self._requested_port), _Handler)
+        self._httpd.app = self
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="jimm-serve-http")
+        self._http_thread.start()
+        if self.metrics_logger is not None:
+            self._log_thread = threading.Thread(
+                target=self._metrics_log_loop, daemon=True,
+                name="jimm-serve-metrics")
+            self._log_thread.start()
+
+    def _metrics_log_loop(self) -> None:
+        import time
+        step = 0
+        while self._httpd is not None:
+            time.sleep(self.metrics_log_every_s)
+            if self._httpd is None:
+                break
+            self.metrics_logger.log(step, **self.metrics.snapshot())
+            step += 1
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("server not started")
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._loop is not None:
+            asyncio.run_coroutine_threadsafe(self.engine.stop(),
+                                             self._loop).result(10)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            if self._loop_thread is not None:
+                self._loop_thread.join(timeout=10)
+            self._loop.close()
+            self._loop = None
+
+    def serve_forever(self) -> None:
+        """Block until KeyboardInterrupt (the CLI foreground mode)."""
+        assert self._http_thread is not None
+        try:
+            while self._http_thread.is_alive():
+                self._http_thread.join(timeout=1.0)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    # -- request handling (called from HTTP handler threads) --------------
+
+    def _submit(self, image: np.ndarray,
+                timeout_s: float | None) -> np.ndarray:
+        assert self._loop is not None
+        future = asyncio.run_coroutine_threadsafe(
+            self.engine.submit(image, timeout_s=timeout_s), self._loop)
+        return future.result(timeout=self.request_timeout_s)
+
+    def embed(self, payload: dict) -> dict:
+        image = decode_image_payload(payload, dtype=self.engine.dtype)
+        features = self._submit(image, payload.get("timeout_s"))
+        return {"features": np.asarray(features, np.float32).tolist()}
+
+    def classify(self, payload: dict) -> dict:
+        if self.zero_shot is None:
+            raise RequestError("this server has no zero-shot service "
+                               "(started without a text tower)")
+        tokens = payload.get("tokens")
+        if not isinstance(tokens, dict) or not tokens:
+            raise RequestError("classify needs 'tokens': {label: [ids]}")
+        labels, weights, cached = \
+            self.zero_shot.class_weights_blocking(tokens)
+        image = decode_image_payload(payload, dtype=self.engine.dtype)
+        features = self._submit(image, payload.get("timeout_s"))
+        scores = self.zero_shot.scores(np.asarray(features), weights)
+        return {"scores": {label: round(float(s), 6)
+                           for label, s in zip(labels, scores)},
+                "cached": cached}
+
+    def healthz(self) -> dict:
+        snap = self.metrics.snapshot()
+        return {"status": "ok",
+                "buckets": list(self.engine.buckets.sizes),
+                "queue_depth": snap["queue_depth"],
+                "batch_fill_ratio": snap["batch_fill_ratio"],
+                "latency_p50_ms": snap["latency_p50_ms"],
+                "latency_p99_ms": snap["latency_p99_ms"],
+                "uptime_s": snap["uptime_s"]}

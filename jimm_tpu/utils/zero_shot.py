@@ -63,6 +63,55 @@ def classifier_weights(model, text_rows: jax.Array, n_classes: int
     return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True)
 
 
+def token_table_rows(table: dict, context_length: int,
+                     labels: Sequence[str] | None = None
+                     ) -> tuple[list[str], "jnp.ndarray", list[int]]:
+    """Flatten a ``{label: [ids]}`` / ``{label: [[ids], ...]}`` token table
+    into padded class-major rows.
+
+    Returns ``(labels, (N, L) token rows, owner)`` where ``owner[i]`` is the
+    class index row ``i`` belongs to (classes may carry different template
+    counts). Raises ``ValueError`` for rows longer than ``context_length``
+    (silent truncation would drop CLIP's EOT pooling token).
+    """
+    from jimm_tpu.data.records import pad_tokens
+    import numpy as np
+
+    labels = list(table) if labels is None else list(labels)
+    missing = [label for label in labels if label not in table]
+    if missing:
+        raise ValueError(f"token table lacks entries for {missing[:5]}")
+    rows, owner = [], []
+    for ci, label in enumerate(labels):
+        entry = table[label]
+        per_class = entry if entry and isinstance(entry[0], list) else [entry]
+        for r in per_class:
+            if len(r) > context_length:
+                raise ValueError(
+                    f"tokens for {label!r} are {len(r)} ids but "
+                    f"context_length is {context_length}; re-tokenize to fit")
+            rows.append(pad_tokens(r, context_length))
+            owner.append(ci)
+    return labels, jnp.asarray(np.stack(rows)), owner
+
+
+def weights_from_rows(model, rows: jax.Array, owner: Sequence[int],
+                      n_classes: int) -> jax.Array:
+    """Ensemble class weights from flat prompt rows with per-row class
+    ownership (the ragged-template generalization of `classifier_weights`):
+    per-prompt L2 normalization, mean over each class's rows, renormalized.
+    """
+    import numpy as np
+
+    emb = np.array(model.encode_text(rows), np.float32)  # copy: writable
+    emb /= np.linalg.norm(emb, axis=-1, keepdims=True)
+    owner_arr = np.asarray(owner)
+    weights = np.stack([emb[owner_arr == ci].mean(axis=0)
+                        for ci in range(n_classes)])
+    weights /= np.linalg.norm(weights, axis=-1, keepdims=True)
+    return jnp.asarray(weights)
+
+
 def zero_shot_logits_from_features(model, img_features: jax.Array,
                                    class_embeds: jax.Array) -> jax.Array:
     """Like `zero_shot_logits` but over precomputed (unnormalized) image

@@ -85,7 +85,17 @@ def main() -> int:
     args = p.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
-    batch = args.batch or (256 if on_tpu else 4)
+    # auto batch comes from the serving bucket table (serve/buckets.py), so
+    # the bench times the exact shapes `jimm-tpu serve` warm-compiles: the
+    # largest bucket on TPU (256, BASELINE's inference batch), the bucket
+    # holding 4 on the CPU-smoke table
+    from jimm_tpu.serve.buckets import default_buckets
+    table = default_buckets()
+    batch = args.batch or (table.max_size if on_tpu else table.select(4))
+    if batch not in table.sizes:
+        print(json.dumps({"note": f"batch {batch} is not a serving bucket "
+                                  f"{list(table.sizes)}; the server would "
+                                  f"pad it"}), flush=True)
     rng = np.random.RandomState(0)
 
     # BASELINE config #1: ViT-B/16-224 classification forward

@@ -1,0 +1,191 @@
+"""Closed-loop load generator for the jimm_tpu.serve engine.
+
+Each simulated client issues its next request the moment the previous one
+answers (a *closed* loop), so the measured rps is the engine's sustained
+throughput at that concurrency — no open-loop arrival-rate assumption. The
+default mode drives the in-process engine (no sockets: engine + compute
+only); ``--http`` stands up the full `ServingServer` and goes through the
+stdlib client, measuring the stack a real deployment runs.
+
+Prints one MEASUREMENTS.jsonl-format JSON line (``--record`` appends it to
+the repo ledger with the same ts/phase provenance the training benches use)
+and exits nonzero if any recompile happened after warmup — the serving
+shape-bucket discipline (docs/serving.md) made enforceable by the engine's
+compile-count instrumentation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def build_engine(args):
+    import jax
+    import jax.numpy as jnp
+    from flax import nnx
+
+    from jimm_tpu import preset
+    from jimm_tpu.cli import _family, _model_cls, _tiny_override
+    from jimm_tpu.serve import (AdmissionPolicy, BucketTable, InferenceEngine,
+                                counting_forward, default_buckets)
+
+    on_tpu = jax.default_backend() == "tpu"
+    name = args.preset or ("clip-vit-base-patch32" if on_tpu
+                           else "clip-vit-base-patch16")
+    fam = _family(name)
+    cfg = preset(name)
+    if args.tiny or not on_tpu:  # off-TPU always smoke-sizes (like bench.py)
+        cfg = _tiny_override(cfg)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    model = _model_cls(fam)(cfg, rngs=nnx.Rngs(0), dtype=dtype,
+                            param_dtype=dtype)
+    method = "encode_image" if fam in ("clip", "siglip") else "__call__"
+    forward, traces = counting_forward(model, method)
+    buckets = (BucketTable(tuple(int(s) for s in args.buckets.split(",")))
+               if args.buckets else default_buckets())
+    size = cfg.vision.image_size
+    engine = InferenceEngine(
+        forward, item_shape=(size, size, 3), buckets=buckets,
+        max_delay_ms=args.max_delay_ms,
+        policy=AdmissionPolicy(max_queue=max(4 * args.clients, 64),
+                               default_timeout_s=120.0),
+        trace_count=traces)
+    return engine, traces, size, on_tpu, name
+
+
+def drive_engine(engine, item, clients: int, per_client: int) -> int:
+    """In-process closed loop on the engine's own event loop."""
+    import asyncio
+
+    async def one_client():
+        done = 0
+        for _ in range(per_client):
+            await engine.submit(item)
+            done += 1
+        return done
+
+    async def go():
+        await engine.start()
+        try:
+            counts = await asyncio.gather(
+                *[one_client() for _ in range(clients)])
+        finally:
+            await engine.stop()
+        return sum(counts)
+
+    return asyncio.run(go())
+
+
+def drive_http(server, item, clients: int, per_client: int) -> int:
+    """Closed loop through the HTTP front end, one thread per client."""
+    import concurrent.futures
+
+    from jimm_tpu.serve import ServeClient
+
+    client = ServeClient(port=server.port, timeout_s=120.0)
+
+    def one_client(_):
+        done = 0
+        for _ in range(per_client):
+            client.embed(item)
+            done += 1
+        return done
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=clients) as pool:
+        return sum(pool.map(one_client, range(clients)))
+
+
+def main() -> int:
+    import jimm_tpu.utils.env
+    jimm_tpu.utils.env.configure_platform()
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default=None,
+                   help="model preset (default: CLIP-B/32 on TPU, tiny "
+                        "CLIP-B/16 off-TPU)")
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--buckets", default=None,
+                   help='comma-separated bucket table, e.g. "1,4,16,64" '
+                        "(default: platform table)")
+    p.add_argument("--clients", type=int, default=16,
+                   help="concurrent closed-loop clients")
+    p.add_argument("--requests", type=int, default=0,
+                   help="total requests (0 = 16 per client)")
+    p.add_argument("--max-delay-ms", type=float, default=5.0)
+    p.add_argument("--http", action="store_true",
+                   help="measure through the full HTTP stack instead of "
+                        "the in-process engine")
+    p.add_argument("--record", action="store_true",
+                   help="append the result line to MEASUREMENTS.jsonl")
+    args = p.parse_args()
+
+    import numpy as np
+
+    engine, traces, size, on_tpu, name = build_engine(args)
+    per_client = max(1, (args.requests or 16 * args.clients) // args.clients)
+    total = per_client * args.clients
+    item = np.random.RandomState(0).rand(size, size, 3).astype(np.float32)
+
+    t_warm = time.monotonic()
+    engine.warmup_blocking()
+    warmup_s = time.monotonic() - t_warm
+    compiles_before = traces()
+
+    server = None
+    if args.http:
+        from jimm_tpu.serve import ServingServer
+        server = ServingServer(engine, port=0, warmup=False,
+                               request_timeout_s=120.0)
+        server.start()
+    t0 = time.monotonic()
+    try:
+        if server is not None:
+            done = drive_http(server, item, args.clients, per_client)
+        else:
+            done = drive_engine(engine, item, args.clients, per_client)
+    finally:
+        if server is not None:
+            server.stop()
+    dt = time.monotonic() - t0
+
+    metrics = engine.metrics
+    compile_delta = traces() - compiles_before
+    rec = {
+        "metric": ("serve_rps" if on_tpu else "serve_rps (cpu smoke)"),
+        "value": round(done / dt, 2),
+        "unit": "requests/sec",
+        "mode": "http" if args.http else "engine",
+        "model": name + (":tiny" if (args.tiny or not on_tpu) else ""),
+        "clients": args.clients,
+        "requests": total,
+        "p50_ms": metrics.snapshot()["latency_p50_ms"],
+        "p99_ms": metrics.snapshot()["latency_p99_ms"],
+        "batch_fill_ratio": round(metrics.batch_fill_ratio, 4),
+        "batches": metrics.count("batches_total"),
+        "buckets": list(engine.buckets.sizes),
+        "warmup_s": round(warmup_s, 3),
+        "compile_count_delta": compile_delta,
+    }
+    print(json.dumps(rec), flush=True)
+    if args.record:
+        from scripts._measurements import MEASUREMENTS
+        full = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "phase": "serve_bench", **rec}
+        with open(MEASUREMENTS, "a") as f:
+            f.write(json.dumps(full) + "\n")
+    if done != total:
+        print(json.dumps({"error": f"only {done}/{total} requests "
+                                   f"completed"}), flush=True)
+        return 1
+    if compile_delta:
+        print(json.dumps({"error": f"{compile_delta} recompile(s) after "
+                                   f"warmup — bucket table does not cover "
+                                   f"the traffic"}), flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,22 @@
+"""JL006 fixture: blocking host syncs on the serve event loop."""
+import asyncio
+
+import numpy as np
+
+
+async def handle(engine, item):
+    arr = np.asarray(item, np.float32)        # JL006: host copy on the loop
+    out = await engine.submit(arr)
+    out.block_until_ready()                   # JL006: device wait on the loop
+    return float(out.item())                  # JL006: host sync on the loop
+
+
+def pad_blocking(item):
+    # ok: sync helper — the sanctioned home for host materialization
+    return np.asarray(item, np.float32)
+
+
+async def ok_path(engine, item):
+    loop = asyncio.get_running_loop()
+    # ok: the lambda runs on the executor, not the event loop
+    return await loop.run_in_executor(None, lambda: np.asarray(item))

@@ -34,6 +34,27 @@ def test_classify_clip(tmp_path, image_file, capsys):
     assert {line.split()[1] for line in out} == {"cat", "dog"}
 
 
+def test_classify_reuses_cached_class_embeddings(tmp_path, image_file,
+                                                 capsys):
+    """Repeat invocations in one process hit the serve embedding cache:
+    the text tower runs once per (checkpoint, label set), not per call."""
+    from jimm_tpu.serve.cache import class_embedding_cache
+
+    ckpt = save_tiny_clip(tmp_path / "ckpt")
+    tokens = tmp_path / "tokens.json"
+    tokens.write_text(json.dumps({"owl": [3, 7, 63], "jay": [4, 8, 63]}))
+    cache = class_embedding_cache()
+    hits0, misses0 = cache.hits, cache.misses
+    argv = ["classify", image_file, "--ckpt", str(ckpt), "--model", "clip",
+            "--tokens-file", str(tokens), "--platform", "cpu"]
+    assert main(argv) == 0
+    first = capsys.readouterr().out
+    assert cache.misses - misses0 == 1  # cold: built and inserted
+    assert main(argv) == 0
+    assert capsys.readouterr().out == first  # cached weights, same scores
+    assert cache.hits - hits0 >= 1  # warm: text tower skipped
+
+
 def test_classify_siglip(tmp_path, image_file, capsys):
     ckpt = save_tiny_siglip(tmp_path / "ckpt")
     tokens = tmp_path / "tokens.json"

@@ -69,6 +69,28 @@ class TestRuleFixtures:
         # with a 256 MiB budget the 64 MiB scratch is fine; tiling still fires
         assert rules_and_lines(findings) == {("JL005", 11), ("JL005", 12)}
 
+    def test_jl006_async_host_sync_in_serve(self):
+        findings = findings_for("serve/bad_async_sync.py")
+        assert rules_and_lines(findings) == {
+            ("JL006", 8),   # np.asarray on the event loop
+            ("JL006", 10),  # .block_until_ready() on the event loop
+            ("JL006", 11),  # .item() on the event loop
+        }
+        assert all(f.severity == ERROR for f in findings)
+        # sync helpers and executor lambdas in the same file stay clean
+        assert not any(f.line > 11 for f in findings)
+
+    def test_jl006_scoped_to_serve_paths(self):
+        # the identical source outside a serve/ path segment is not JL006's
+        # business (general async code may sync freely)
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_async_host_sync
+        src = (FIXTURES / "serve" / "bad_async_sync.py").read_text()
+        tree = ast.parse(src)
+        assert check_async_host_sync(tree, "jimm_tpu/train/loop.py") == []
+        assert check_async_host_sync(tree, "jimm_tpu/serve/engine.py") != []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings

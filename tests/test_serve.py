@@ -1,0 +1,443 @@
+"""jimm_tpu.serve: buckets, cache, admission, engine, and the HTTP stack.
+
+The e2e class runs a real `ServingServer` over a tiny random-init CLIP and
+asserts the two acceptance properties of the serving design: zero recompiles
+after warmup under 64-way concurrent load (trace-count instrumentation), and
+>90% class-embedding cache hit rate on a repeated label set.
+"""
+
+import asyncio
+import concurrent.futures
+import threading
+
+import numpy as np
+import pytest
+
+from jimm_tpu.serve import (AdmissionController, AdmissionPolicy, BucketTable,
+                            DeadlineExceededError, EmbeddingCache,
+                            EngineClosedError, InferenceEngine, QueueFullError,
+                            RequestError, ServeClient, ServeClientError,
+                            ServeMetrics, ServingServer, ZeroShotService,
+                            counting_forward, pad_batch, prompt_set_key)
+from jimm_tpu.serve.buckets import DEFAULT_BATCH_BUCKETS, default_buckets
+
+
+# ---------------------------------------------------------------------------
+# buckets
+# ---------------------------------------------------------------------------
+
+class TestBuckets:
+    def test_sorted_and_deduped(self):
+        assert BucketTable((8, 1, 4, 4, 2)).sizes == (1, 2, 4, 8)
+
+    def test_rejects_empty_and_nonpositive(self):
+        with pytest.raises(ValueError):
+            BucketTable(())
+        with pytest.raises(ValueError):
+            BucketTable((0, 4))
+
+    def test_select_smallest_fitting(self):
+        table = BucketTable((1, 2, 4, 8))
+        assert table.select(1) == 1
+        assert table.select(3) == 4
+        assert table.select(8) == 8
+        assert table.select(9) is None
+        with pytest.raises(ValueError):
+            table.select(0)
+
+    def test_shed_largest_full(self):
+        table = BucketTable((2, 4, 8))
+        assert table.shed(1) == 2  # never below the smallest bucket
+        assert table.shed(5) == 4
+        assert table.shed(64) == 8
+
+    def test_pad_batch(self):
+        rows = [np.full(3, i, np.float32) for i in range(3)]
+        out = pad_batch(rows, 4)
+        assert out.shape == (4, 3)
+        assert np.allclose(out[2], 2.0)
+        assert np.allclose(out[3], 0.0)  # zero padding
+        assert pad_batch(rows, 3).shape == (3, 3)
+        with pytest.raises(ValueError):
+            pad_batch(rows, 2)
+        with pytest.raises(ValueError):
+            pad_batch([], 2)
+
+    def test_default_table_on_cpu(self):
+        assert default_buckets("cpu").sizes == DEFAULT_BATCH_BUCKETS
+        assert default_buckets("tpu").max_size == 256
+
+
+# ---------------------------------------------------------------------------
+# cache
+# ---------------------------------------------------------------------------
+
+class TestEmbeddingCache:
+    def test_prompt_set_key_separates_models_and_rows(self):
+        rows = [[1, 2, 3], [4, 5, 6]]
+        k1 = prompt_set_key("clip:a", rows)
+        assert k1 == prompt_set_key("clip:a", np.asarray(rows))
+        assert k1 != prompt_set_key("clip:b", rows)
+        assert k1 != prompt_set_key("clip:a", [[1, 2, 3], [4, 5, 7]])
+        # shape is hashed too: (6,) and (2, 3) with equal bytes differ
+        assert (prompt_set_key("m", np.arange(6))
+                != prompt_set_key("m", np.arange(6).reshape(2, 3)))
+
+    def test_hit_miss_accounting(self):
+        cache = EmbeddingCache(capacity=4)
+        assert cache.get("k") is None
+        cache.put("k", np.ones(2))
+        assert cache.get("k") is not None
+        assert (cache.hits, cache.misses) == (1, 1)
+        assert cache.hit_rate == 0.5
+
+    def test_lru_eviction_order(self):
+        cache = EmbeddingCache(capacity=2)
+        cache.put("a", np.zeros(1))
+        cache.put("b", np.zeros(1))
+        cache.get("a")              # refresh a; b is now least-recent
+        cache.put("c", np.zeros(1))
+        assert cache.get("b") is None
+        assert cache.get("a") is not None
+        assert cache.get("c") is not None
+        assert cache.evictions == 1
+
+    def test_get_or_build_builds_once(self):
+        cache = EmbeddingCache()
+        built = []
+
+        def builder():
+            built.append(1)
+            return np.arange(3)
+
+        a = cache.get_or_build("k", builder)
+        b = cache.get_or_build("k", builder)
+        assert built == [1]
+        assert np.array_equal(a, b)
+
+    def test_repeat_label_set_hit_rate_over_90(self):
+        cache = EmbeddingCache()
+        key = prompt_set_key("m", [[1, 2], [3, 4]])
+        for _ in range(20):
+            cache.get_or_build(key, lambda: np.ones((2, 8)))
+        assert cache.hit_rate > 0.9
+        assert cache.stats()["cache_entries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# admission + metrics
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_admit_bounds_queue(self):
+        ctl = AdmissionController(AdmissionPolicy(max_queue=2))
+        ctl.admit(0)
+        ctl.admit(1)
+        with pytest.raises(QueueFullError) as ei:
+            ctl.admit(2)
+        assert ei.value.http_status == 503
+        assert ctl.metrics.count("rejected_total") == 1
+
+    def test_shed_watermark(self):
+        ctl = AdmissionController(AdmissionPolicy(max_queue=8,
+                                                  shed_fraction=0.25))
+        assert ctl.policy.shed_depth == 2
+        assert not ctl.under_pressure(1)
+        assert ctl.under_pressure(2)
+        # empty queue never counts as pressure even with tiny fractions
+        assert AdmissionPolicy(max_queue=4, shed_fraction=0.01).shed_depth == 1
+
+    def test_deadline_default_and_override(self):
+        ctl = AdmissionController(AdmissionPolicy(default_timeout_s=5.0))
+        assert ctl.deadline_for(None, 100.0) == 105.0
+        assert ctl.deadline_for(0.5, 100.0) == 100.5
+
+    def test_metrics_snapshot_and_prometheus(self):
+        m = ServeMetrics()
+        m.inc("requests_total", 3)
+        m.observe_batch(3, 4)
+        m.observe_latency(0.010)
+        m.bind_gauge("compile_count", lambda: 2)
+        m.bind_gauge("broken", lambda: 1 / 0)  # must not kill rendering
+        snap = m.snapshot()
+        assert snap["requests_total"] == 3
+        assert snap["batch_fill_ratio"] == 0.75
+        assert snap["latency_p50_ms"] == 10.0
+        assert snap["compile_count"] == 2.0
+        assert "broken" not in snap
+        text = m.render_prometheus()
+        assert "# TYPE jimm_serve_requests_total counter" in text
+        assert "jimm_serve_batch_fill_ratio 0.75" in text
+
+
+# ---------------------------------------------------------------------------
+# engine (fake forward — no model, no JAX compile)
+# ---------------------------------------------------------------------------
+
+def _make_engine(fwd=None, **kw):
+    calls = []
+
+    def default_fwd(batch):
+        calls.append(batch.shape)
+        return batch * 2.0
+
+    kw.setdefault("buckets", BucketTable((1, 2, 4)))
+    engine = InferenceEngine(fwd or default_fwd, item_shape=(3,), **kw)
+    return engine, calls
+
+
+class TestEngine:
+    def test_roundtrip_single_request(self):
+        async def go():
+            engine, calls = _make_engine(max_delay_ms=1.0)
+            await engine.start()
+            out = await engine.submit(np.full(3, 5.0, np.float32))
+            await engine.stop()
+            return out, calls
+
+        out, calls = asyncio.run(go())
+        assert np.allclose(out, 10.0)
+        assert calls == [(1, 3)]  # n=1 picks the 1-bucket
+
+    def test_concurrent_submits_coalesce_into_one_batch(self):
+        async def go():
+            engine, calls = _make_engine(max_delay_ms=50.0)
+            await engine.start()
+            outs = await asyncio.gather(*[
+                engine.submit(np.full(3, i, np.float32)) for i in range(3)])
+            await engine.stop()
+            return outs, calls, engine.metrics
+
+        outs, calls, metrics = asyncio.run(go())
+        assert calls == [(4, 3)]  # one batch, padded 3 -> bucket 4
+        for i, out in enumerate(outs):  # row i answers request i
+            assert np.allclose(out, 2.0 * i)
+        assert metrics.batch_fill_ratio == 0.75
+        assert metrics.count("responses_total") == 3
+
+    def test_bucket_padding_under_deadline_window(self):
+        # 5 concurrent submits > max bucket 4: the batcher caps the batch at
+        # the largest bucket and the straggler rides the next batch
+        async def go():
+            engine, calls = _make_engine(max_delay_ms=20.0)
+            await engine.start()
+            outs = await asyncio.gather(*[
+                engine.submit(np.full(3, i, np.float32)) for i in range(5)])
+            await engine.stop()
+            return outs, calls
+
+        outs, calls = asyncio.run(go())
+        assert sorted(c[0] for c in calls) == [1, 4]
+        for i, out in enumerate(outs):
+            assert np.allclose(out, 2.0 * i)
+
+    def test_wrong_shape_rejected(self):
+        async def go():
+            engine, _ = _make_engine()
+            await engine.start()
+            try:
+                with pytest.raises(RequestError):
+                    await engine.submit(np.zeros(5, np.float32))
+            finally:
+                await engine.stop()
+
+        asyncio.run(go())
+
+    def test_submit_before_start_is_engine_closed(self):
+        async def go():
+            engine, _ = _make_engine()
+            with pytest.raises(EngineClosedError):
+                await engine.submit(np.zeros(3, np.float32))
+
+        asyncio.run(go())
+
+    def test_deadline_timeout_cancels_request(self):
+        def slow(batch):
+            import time
+            time.sleep(0.3)
+            return batch
+
+        async def go():
+            engine, _ = _make_engine(slow, max_delay_ms=1.0)
+            await engine.start()
+            try:
+                with pytest.raises(DeadlineExceededError) as ei:
+                    await engine.submit(np.zeros(3, np.float32),
+                                        timeout_s=0.05)
+                assert ei.value.http_status == 504
+            finally:
+                await engine.stop()
+            return engine.metrics
+
+        metrics = asyncio.run(go())
+        assert metrics.count("timeouts_total") == 1
+
+    def test_queue_full_rejection(self):
+        release = threading.Event()
+
+        def blocked(batch):
+            release.wait(5)
+            return batch
+
+        async def go():
+            engine, _ = _make_engine(
+                blocked, buckets=BucketTable((1,)), max_delay_ms=1.0,
+                policy=AdmissionPolicy(max_queue=2, default_timeout_s=10.0))
+            await engine.start()
+            item = np.zeros(3, np.float32)
+            inflight = [asyncio.create_task(engine.submit(item))]
+            await asyncio.sleep(0.05)  # batcher takes it; executor blocked
+            inflight += [asyncio.create_task(engine.submit(item))
+                         for _ in range(2)]
+            await asyncio.sleep(0.05)  # both queued: depth == max_queue
+            with pytest.raises(QueueFullError):
+                await engine.submit(item)
+            release.set()
+            await asyncio.gather(*inflight)
+            await engine.stop()
+            return engine.metrics
+
+        metrics = asyncio.run(go())
+        assert metrics.count("rejected_total") == 1
+        assert metrics.count("responses_total") == 3
+
+    def test_shed_skips_coalescing_wait_under_pressure(self):
+        # window is 5 s; without shedding, 3 submits (< max bucket) would sit
+        # out the window and the 3 s harness timeout below would trip
+        async def go():
+            engine, calls = _make_engine(
+                max_delay_ms=5000.0,
+                policy=AdmissionPolicy(max_queue=8, shed_fraction=0.25,
+                                       default_timeout_s=30.0))
+            await engine.start()
+            outs = await asyncio.gather(*[
+                engine.submit(np.full(3, i, np.float32)) for i in range(3)])
+            await engine.stop()
+            return outs, calls, engine.metrics
+
+        outs, calls, metrics = asyncio.run(asyncio.wait_for(go(), timeout=3))
+        assert calls == [(4, 3)]
+        assert metrics.count("shed_batches_total") == 1
+        for i, out in enumerate(outs):
+            assert np.allclose(out, 2.0 * i)
+
+    def test_warmup_compiles_every_bucket(self):
+        engine, calls = _make_engine()
+        times = engine.warmup_blocking()
+        assert set(times) == {1, 2, 4}
+        assert sorted(calls) == [(1, 3), (2, 3), (4, 3)]
+
+
+# ---------------------------------------------------------------------------
+# HTTP e2e over a tiny random-init CLIP
+# ---------------------------------------------------------------------------
+
+TOKENS_A = {"cat": [[1, 2, 3], [4, 5]], "dog": [6, 7]}   # ragged ensemble
+TOKENS_B = {"ant": [8, 9], "bee": [10, 11], "fly": [12]}
+
+
+@pytest.fixture(scope="module")
+def clip_server():
+    from flax import nnx
+
+    from jimm_tpu import CLIP, preset
+    from jimm_tpu.cli import _tiny_override
+
+    cfg = _tiny_override(preset("clip-vit-base-patch16"))
+    model = CLIP(cfg, rngs=nnx.Rngs(0))
+    forward, traces = counting_forward(model, "encode_image")
+    engine = InferenceEngine(
+        forward, item_shape=(cfg.vision.image_size, cfg.vision.image_size, 3),
+        buckets=BucketTable((1, 2, 4)), max_delay_ms=5.0,
+        policy=AdmissionPolicy(max_queue=256, default_timeout_s=30.0),
+        trace_count=traces)
+    zero_shot = ZeroShotService(model, model_key="clip:test-tiny:f32",
+                                cache=EmbeddingCache(capacity=8))
+    server = ServingServer(engine, zero_shot=zero_shot, port=0)
+    server.start()
+    try:
+        yield server, model, traces
+    finally:
+        server.stop()
+
+
+@pytest.fixture()
+def client(clip_server):
+    server, _, _ = clip_server
+    return ServeClient(port=server.port, timeout_s=60.0)
+
+
+def _image(seed=0, size=32):
+    return np.random.RandomState(seed).rand(size, size, 3).astype(np.float32)
+
+
+class TestHttpEndToEnd:
+    def test_healthz(self, client):
+        health = client.healthz()
+        assert health["status"] == "ok"
+        assert health["buckets"] == [1, 2, 4]
+
+    def test_embed_matches_direct_forward(self, clip_server, client):
+        _, model, _ = clip_server
+        image = _image(1)
+        got = np.asarray(client.embed(image), np.float32)
+        want = np.asarray(model.encode_image(image[None]))[0]
+        assert np.allclose(got, want, atol=1e-4)
+
+    def test_classify_request_to_logits(self, client):
+        result = client.classify(_image(2), TOKENS_A)
+        assert set(result["scores"]) == {"cat", "dog"}
+        probs = np.array(list(result["scores"].values()))
+        assert abs(probs.sum() - 1.0) < 1e-3  # CLIP: softmax over labels
+        assert result["cached"] is False
+        again = client.classify(_image(3), TOKENS_A)
+        assert again["cached"] is True
+
+    def test_cache_hit_rate_over_90_on_repeated_labels(self, clip_server,
+                                                       client):
+        server, _, _ = clip_server
+        cache = server.zero_shot.cache
+        hits0, misses0 = cache.hits, cache.misses
+        for i in range(20):
+            client.classify(_image(10 + i), TOKENS_B)
+        dh, dm = cache.hits - hits0, cache.misses - misses0
+        assert dm <= 1  # one cold build for this label set, then all hits
+        assert dh / (dh + dm) > 0.9
+
+    def test_64_concurrent_clients_zero_recompiles(self, clip_server, client):
+        server, _, traces = clip_server
+        before = traces()
+        assert before == 3  # warmup compiled exactly the three buckets
+        responses0 = server.metrics.count("responses_total")
+
+        def one_client(i):
+            if i % 2:
+                return client.classify(_image(i), TOKENS_B)["scores"]
+            return client.embed(_image(i))
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=64) as pool:
+            results = list(pool.map(one_client, range(128)))
+        assert len(results) == 128
+        assert traces() == before  # zero recompiles under concurrent load
+        assert server.metrics.count("responses_total") - responses0 == 128
+        # micro-batching actually batched: fewer dispatches than requests
+        assert server.metrics.count("batches_total") \
+            < server.metrics.count("responses_total")
+
+    def test_bad_requests_get_typed_errors(self, clip_server, client):
+        with pytest.raises(ServeClientError) as ei:
+            client.embed(np.zeros((8, 8, 3), np.float32))  # wrong shape
+        assert (ei.value.status, ei.value.code) == (400, "bad_request")
+        raw = ServeClient(port=clip_server[0].port)
+        with pytest.raises(ServeClientError) as ei:
+            raw._request("POST", "/v1/classify", {"tokens": TOKENS_B})
+        assert ei.value.code == "bad_request"  # missing image
+        with pytest.raises(ServeClientError) as ei:
+            client.classify(_image(), {"cat": list(range(99))})  # ctx is 8
+        assert ei.value.code == "bad_request"
+
+    def test_metrics_endpoint(self, client):
+        text = client.metrics_text()
+        assert "# TYPE jimm_serve_requests_total counter" in text
+        assert "jimm_serve_compile_count" in text
+        assert "jimm_serve_cache_hit_rate" in text
