@@ -488,6 +488,18 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
     sync_all()
     dt = (time.perf_counter() - t0) / args.steps
 
+    # per-step spread via the shared obs percentile helper: a short synced
+    # probe (the chain-timed dt above stays the metric of record — per-step
+    # sync adds overhead, but the p50/p99 spread it yields catches
+    # stragglers a mean cannot)
+    from jimm_tpu.obs import percentile as _pctl
+    probe_times = []
+    for _ in range(min(args.steps, 8)):
+        tp = time.perf_counter()
+        metrics = step_fn(model, optimizer, *data)
+        sync_all()
+        probe_times.append(time.perf_counter() - tp)
+
     images_per_sec = batch / dt
     # analytic model FLOPs — XLA cost analysis counts scanned layers once
     flops = train_step_flops(cfg, batch)
@@ -512,6 +524,8 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
         "mfu": round(achieved_mfu, 4),
         "images_per_sec": round(images_per_sec, 2),
         "step_time_ms": round(dt * 1e3, 2),
+        "step_time_p50_ms": round(_pctl(probe_times, 50) * 1e3, 2),
+        "step_time_p99_ms": round(_pctl(probe_times, 99) * 1e3, 2),
         "batch_size": batch,
         "steps_timed": args.steps,
         "remat": args.remat,

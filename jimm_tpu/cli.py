@@ -15,6 +15,9 @@ Subcommands::
     python -m jimm_tpu bench-forward --preset ...   # jitted forward throughput
     python -m jimm_tpu profile-analyze DIR          # per-op trace summary
     python -m jimm_tpu build-native                 # compile the C++ preprocessing lib
+    python -m jimm_tpu obs snapshot URL|FILE        # print/save a unified metric dump
+    python -m jimm_tpu obs tail URL|JSONL           # follow metrics live
+    python -m jimm_tpu obs diff BEFORE AFTER        # structural metric diff
 
 `train` runs entirely offline on procedural data (`jimm_tpu.data.synthetic`)
 so it works with zero network on CPU or TPU, and exercises the real stack:
@@ -573,9 +576,15 @@ def cmd_train(args: argparse.Namespace) -> int:
         for _ in range(start_step):
             next(data)
 
+    from jimm_tpu import obs
     logger = MetricsLogger(path=args.metrics_file, print_every=args.log_every,
-                           tensorboard_dir=args.tensorboard_dir)
+                           tensorboard_dir=args.tensorboard_dir,
+                           registry=obs.get_registry("jimm_train"))
     timer = StepTimer()
+    # goodput ledger: every loop region below runs under a measure() bucket,
+    # so the end-of-run report decomposes wall time into
+    # compile/data_wait/step/checkpoint/host_sync/other
+    acct = obs.GoodputAccounter()
     profiler_ctx = None
 
     def place(batch):
@@ -596,6 +605,7 @@ def cmd_train(args: argparse.Namespace) -> int:
     # whole run when it is shorter than that
     profile_start = min(start_step + 2, max(args.steps - 1, start_step))
     profile_stop = min(start_step + 4, args.steps - 1)
+    dt = None
     try:
         with use_sharding(mesh, rules):
             for step in range(start_step, args.steps):
@@ -603,23 +613,31 @@ def cmd_train(args: argparse.Namespace) -> int:
                     from jimm_tpu.train.profile import trace
                     profiler_ctx = trace(args.profile_dir)
                     profiler_ctx.__enter__()
-                batch = next(data)
-                timer.start()
-                metrics = step_fn(model, optimizer, *batch)
-                dt = timer.stop(metrics["loss"])
+                with acct.measure("data_wait"):
+                    batch = next(data)
+                # the first step traces + compiles under the same call; it
+                # lands in the "compile" bucket, steady-state in "step"
+                # (timer.stop's device_get sync keeps device time in-bucket)
+                with acct.measure("compile" if step == start_step
+                                  else "step"):
+                    timer.start()
+                    metrics = step_fn(model, optimizer, *batch)
+                    dt = timer.stop(metrics["loss"])
                 if profiler_ctx is not None and step == profile_stop:
                     profiler_ctx.__exit__(None, None, None)
                     profiler_ctx = None
                     print(f"profile trace written to {args.profile_dir}")
-                logger.log(step, step_time_s=dt,
-                           **{k: float(v) for k, v in metrics.items()})
+                with acct.measure("host_sync"):
+                    logger.log(step, step_time_s=dt,
+                               **{k: float(v) for k, v in metrics.items()})
                 if ckpt is not None:
                     extra = None
                     if grain_stream is not None:
                         import base64
                         extra = {"grain_state": base64.b64encode(
                             grain_stream.consumed_state).decode("ascii")}
-                    ckpt.save(step, model, optimizer, extra=extra)
+                    with acct.measure("checkpoint"):
+                        ckpt.save(step, model, optimizer, extra=extra)
                 if args.fake_failure_at_step is not None \
                         and step == args.fake_failure_at_step:
                     # failure-injection drill (SURVEY §5 failure-detection
@@ -641,6 +659,12 @@ def cmd_train(args: argparse.Namespace) -> int:
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()
+    import json as _json
+
+    from jimm_tpu.train.metrics import mfu as _mfu, train_step_flops
+    achieved_mfu = (None if dt is None
+                    else _mfu(train_step_flops(cfg, args.batch_size), dt))
+    print("goodput: " + _json.dumps(acct.report(mfu=achieved_mfu)))
     return 0
 
 
@@ -1591,6 +1615,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--bf16", action="store_true")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_bench_forward)
+
+    # jimm-tpu obs {snapshot,tail,diff} — pure-host metric tooling (no jax)
+    from jimm_tpu.obs.cli import add_obs_parser
+    add_obs_parser(sub)
 
     return p
 

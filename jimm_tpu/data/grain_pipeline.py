@@ -178,8 +178,23 @@ def make_grain_loader(data: str | Sequence[str], batch_size: int, *,
 
 def grain_batches(loader) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     """Adapter: a grain DataLoader -> the plain ``(images, aux)`` tuple
-    stream the trainer consumes (`jimm_tpu.cli.cmd_train`)."""
-    for batch in loader:
+    stream the trainer consumes (`jimm_tpu.cli.cmd_train`). Per-batch
+    production time lands in the ``jimm_train`` registry
+    (``grain_produce_seconds``) so input-bound runs show up in the unified
+    dump, not just as mysteriously slow steps."""
+    import time
+
+    from jimm_tpu.obs.registry import enabled as _obs_enabled, get_registry
+    it = iter(loader)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        if _obs_enabled():
+            get_registry("jimm_train").histogram(
+                "grain_produce_seconds").observe(time.perf_counter() - t0)
         yield tuple(np.asarray(b) for b in batch)
 
 

@@ -19,6 +19,10 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   ``jax.device_get``, ``.item()``) inside an ``async def`` in serving code —
   it stalls the event loop that is supposed to keep coalescing batches;
   device waits belong in sync ``*_blocking`` helpers run via an executor.
+- **JL007** bare ``print(`` in ``jimm_tpu/`` library code — telemetry
+  belongs in the ``jimm_tpu.obs`` registry / ``MetricsLogger`` where it is
+  structured, rate-limited, and exportable; CLI entry points
+  (``cli.py``/``__main__.py``/``launch.py``) and scripts are exempt.
 """
 
 from __future__ import annotations
@@ -511,6 +515,43 @@ def _scan_async_body(fn: ast.AsyncFunctionDef, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL007 — bare print() in library code
+# ---------------------------------------------------------------------------
+
+#: basenames where print IS the product (user-facing command entry points)
+PRINT_EXEMPT_BASENAMES = frozenset({"cli.py", "__main__.py", "launch.py"})
+
+
+def _path_is_library(path: str) -> bool:
+    """True for files inside the ``jimm_tpu`` package that are not command
+    entry points (scripts/ and tests/ are outside the package entirely)."""
+    parts = path.replace("\\", "/").split("/")
+    return "jimm_tpu" in parts[:-1] \
+        and parts[-1] not in PRINT_EXEMPT_BASENAMES
+
+
+def check_bare_print(tree: ast.AST, path: str) -> list[Finding]:
+    """JL007: library code must not ``print`` — a stray print per step is
+    unstructured, unrateable console spam that bypasses every exporter.
+    Route output through ``jimm_tpu.obs`` (registry/span) or
+    ``train.metrics.MetricsLogger``; a deliberate console sink carries a
+    ``# jaxlint: disable=JL007`` justification."""
+    if not _path_is_library(path):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "print":
+            findings.append(Finding(
+                "JL007", ERROR, path, node.lineno,
+                "bare print() in library code — log through the "
+                "jimm_tpu.obs registry or MetricsLogger (CLI modules and "
+                "scripts are exempt; suppress deliberate console sinks "
+                "with # jaxlint: disable=JL007)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -522,4 +563,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_partition_spec_axes(tree, path)
     findings += check_pallas_tiling(tree, path, vmem_budget)
     findings += check_async_host_sync(tree, path)
+    findings += check_bare_print(tree, path)
     return findings

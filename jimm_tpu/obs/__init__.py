@@ -1,0 +1,38 @@
+"""jimm_tpu.obs — unified observability: one registry, spans, goodput.
+
+Public surface::
+
+    from jimm_tpu import obs
+
+    reg = obs.get_registry("jimm_train")        # namespaced registry
+    reg.counter("steps_total").inc()
+    with obs.span("checkpoint_save"): ...        # host timing + TraceAnnotation
+    acct = obs.GoodputAccounter()
+    with acct.measure("data_wait"): batch = next(it)
+    obs.snapshot()                               # unified {prefix_name: value}
+    obs.render_prometheus()                      # one text dump, all namespaces
+
+Disable all optional instrumentation with ``JIMM_OBS=0`` (or
+``obs.set_enabled(False)``): spans and goodput measures become no-ops;
+registries keep counting (serve counters are product behavior).
+"""
+
+from jimm_tpu.obs.exporters import (JsonlExporter, console_table,
+                                    diff_snapshots, parse_prometheus_text,
+                                    render_prometheus_text)
+from jimm_tpu.obs.goodput import BUCKETS, GoodputAccounter
+from jimm_tpu.obs.registry import (Counter, DuplicateMetricError, Gauge,
+                                   Histogram, MetricRegistry, enabled,
+                                   get_registry, percentile, publish,
+                                   registries, render_prometheus,
+                                   set_enabled, snapshot, unpublish)
+from jimm_tpu.obs.spans import new_trace_id, span
+
+__all__ = [
+    "BUCKETS", "Counter", "DuplicateMetricError", "Gauge", "GoodputAccounter",
+    "Histogram", "JsonlExporter", "MetricRegistry", "console_table",
+    "diff_snapshots", "enabled", "get_registry", "new_trace_id",
+    "parse_prometheus_text", "percentile", "publish", "registries",
+    "render_prometheus", "render_prometheus_text", "set_enabled", "snapshot",
+    "span", "unpublish",
+]

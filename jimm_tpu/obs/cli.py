@@ -1,0 +1,182 @@
+"""``jimm-tpu obs`` — tail, snapshot, and diff metric dumps.
+
+Three verbs over the exporter formats (stdlib only, no jax import):
+
+- ``snapshot`` — fetch a ``/metrics`` endpoint (or read a saved dump) and
+  print it as a console table, JSON, or raw Prometheus text; ``-o`` saves
+  the parsed snapshot as JSON for a later ``diff``.
+- ``tail``     — follow a MEASUREMENTS.jsonl-style ledger (``tail -f`` with
+  JSON pretty-keys), or poll a ``/metrics`` URL and print only the series
+  that changed between polls.
+- ``diff``     — structural diff of two dumps (JSON snapshot or Prometheus
+  text, auto-detected): added / removed / changed with deltas.
+
+Wired as a subparser under the main ``jimm-tpu`` CLI (see jimm_tpu/cli.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+from jimm_tpu.obs.exporters import (console_table, diff_snapshots,
+                                    parse_prometheus_text)
+
+__all__ = ["add_obs_parser", "cmd_obs"]
+
+
+def _load_dump(source: str, timeout_s: float = 10.0) -> dict[str, float]:
+    """Read a metrics dump from a URL, JSON file, or Prometheus text file."""
+    if source.startswith(("http://", "https://")):
+        with urllib.request.urlopen(source, timeout=timeout_s) as resp:
+            text = resp.read().decode("utf-8")
+    else:
+        with open(source) as f:
+            text = f.read()
+    text = text.strip()
+    if text.startswith("{"):
+        data = json.loads(text)
+        return {k: v for k, v in data.items()
+                if isinstance(v, (int, float))}
+    return parse_prometheus_text(text)
+
+
+def _cmd_snapshot(args) -> int:
+    series = _load_dump(args.source)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(series, f, indent=2, sort_keys=True)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(series, indent=2, sort_keys=True))
+    else:
+        print(console_table(series, title=f"metrics: {args.source}"),
+              end="")
+    return 0
+
+
+def _tail_jsonl(path: str, follow: bool) -> int:
+    with open(path) as f:
+        while True:
+            line = f.readline()
+            if line:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                ts = rec.pop("ts", "")
+                phase = rec.pop("phase", "")
+                keys = ", ".join(f"{k}={v}" for k, v in sorted(rec.items()))
+                print(f"{ts} [{phase}] {keys}", flush=True)
+            elif follow:
+                time.sleep(0.5)
+            else:
+                return 0
+
+
+def _tail_url(url: str, interval_s: float) -> int:
+    prev: dict[str, float] = {}
+    while True:
+        try:
+            cur = _load_dump(url)
+        except OSError as e:
+            print(f"# fetch failed: {e}", file=sys.stderr, flush=True)
+            time.sleep(interval_s)
+            continue
+        changes = diff_snapshots(prev, cur)
+        stamp = time.strftime("%H:%M:%S")
+        for name, value in sorted(changes["added"].items()):
+            print(f"{stamp} {name} = {value}", flush=True)
+        for name, d in sorted(changes["changed"].items()):
+            print(f"{stamp} {name} = {d['after']} ({d['delta']:+g})",
+                  flush=True)
+        prev = cur
+        time.sleep(interval_s)
+
+
+def _cmd_tail(args) -> int:
+    if args.source.startswith(("http://", "https://")):
+        try:
+            return _tail_url(args.source, args.interval)
+        except KeyboardInterrupt:
+            return 0
+    try:
+        return _tail_jsonl(args.source, follow=args.follow)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cmd_diff(args) -> int:
+    before = _load_dump(args.before)
+    after = _load_dump(args.after)
+    d = diff_snapshots(before, after)
+    if args.json:
+        print(json.dumps(d, indent=2, sort_keys=True))
+    else:
+        for name, value in sorted(d["added"].items()):
+            print(f"+ {name} = {value}")
+        for name, value in sorted(d["removed"].items()):
+            print(f"- {name} = {value}")
+        for name, c in sorted(d["changed"].items()):
+            print(f"~ {name}: {c['before']} -> {c['after']} "
+                  f"({c['delta']:+g})")
+        if not (d["added"] or d["removed"] or d["changed"]):
+            print("(no differences)")
+    return 1 if (d["added"] or d["removed"] or d["changed"]) else 0
+
+
+def add_obs_parser(subparsers) -> None:
+    """Attach the ``obs`` subcommand tree to the main CLI's subparsers."""
+    p = subparsers.add_parser(
+        "obs", help="tail, snapshot, and diff metric dumps")
+    p.set_defaults(fn=cmd_obs)
+    sub = p.add_subparsers(dest="obs_cmd", required=True)
+
+    ps = sub.add_parser("snapshot",
+                        help="fetch/read a metrics dump and print it")
+    ps.add_argument("source",
+                    help="/metrics URL, JSON snapshot, or Prometheus "
+                         "text file")
+    ps.add_argument("--json", action="store_true",
+                    help="print as JSON instead of a table")
+    ps.add_argument("-o", "--out", default=None,
+                    help="also save the parsed snapshot as JSON")
+    ps.set_defaults(obs_func=_cmd_snapshot)
+
+    pt = sub.add_parser("tail",
+                        help="follow a metrics JSONL ledger or poll a "
+                             "/metrics URL")
+    pt.add_argument("source", help="JSONL path or /metrics URL")
+    pt.add_argument("-f", "--follow", action="store_true",
+                    help="keep following a JSONL file (tail -f)")
+    pt.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval for URLs (seconds)")
+    pt.set_defaults(obs_func=_cmd_tail)
+
+    pd = sub.add_parser("diff", help="diff two metric dumps")
+    pd.add_argument("before")
+    pd.add_argument("after")
+    pd.add_argument("--json", action="store_true")
+    pd.set_defaults(obs_func=_cmd_diff)
+
+
+def cmd_obs(args) -> int:
+    return args.obs_func(args)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="jimm-tpu-obs")
+    sub = parser.add_subparsers(dest="command", required=True)
+    add_obs_parser(sub)
+    args = parser.parse_args(argv)
+    return cmd_obs(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

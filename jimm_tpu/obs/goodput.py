@@ -1,0 +1,124 @@
+"""Goodput accounting: classify training wall time into named buckets.
+
+"Goodput" here is the fraction of wall-clock time the accelerator spends on
+actual training steps, as opposed to compiling, waiting for data, writing
+checkpoints, or syncing scalars back to the host. The accounter is a small
+stopwatch ledger: wrap each region of the training loop in
+``acct.measure("bucket")`` and ask for a :meth:`report` at the end — the
+residual (startup code, python glue) is attributed to ``other`` so the
+buckets always sum to exactly the wall time.
+
+Buckets (the fixed vocabulary the docs and CI smoke assert on):
+
+- ``compile``    — first-step tracing/compilation (and explicit AOT compiles)
+- ``data_wait``  — blocked on the input pipeline (``next(iterator)``)
+- ``step``       — dispatched training step incl. the device sync that
+                   realizes the loss on host
+- ``checkpoint`` — orbax save/restore
+- ``host_sync``  — metric logging, console/JSONL writes
+- ``other``      — residual wall time not covered by a measure() region
+
+MFU-adjusted goodput = goodput × MFU: the fraction of *peak hardware* FLOPs
+the whole loop achieves, not just the step function — the number that tells
+you whether to optimize the kernel or the pipeline around it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+from jimm_tpu.obs.registry import MetricRegistry, enabled, get_registry
+
+__all__ = ["BUCKETS", "GoodputAccounter"]
+
+BUCKETS = ("compile", "data_wait", "step", "checkpoint", "host_sync")
+
+
+class GoodputAccounter:
+    """Wall-time ledger over the fixed bucket vocabulary.
+
+    Also mirrors per-bucket cumulative seconds into the ``jimm_train``
+    registry as ``goodput_{bucket}_seconds_total`` counters plus a
+    ``goodput_ratio`` gauge, so the unified snapshot carries the breakdown
+    without a separate report call.
+    """
+
+    def __init__(self, registry: MetricRegistry | None = None):
+        self._lock = threading.Lock()
+        self._seconds = {name: 0.0 for name in BUCKETS}
+        self._t_start = time.monotonic()
+        self.registry = registry if registry is not None \
+            else get_registry("jimm_train")
+        self._counters = {
+            name: self.registry.counter(f"goodput_{name}_seconds_total")
+            for name in BUCKETS}
+        self.registry.gauge("goodput_ratio", self.goodput)
+        self.registry.gauge("goodput_wall_s", self.wall_s)
+
+    @contextmanager
+    def measure(self, bucket: str):
+        """Attribute the wrapped region's wall time to ``bucket``."""
+        if bucket not in self._seconds:
+            raise KeyError(f"unknown goodput bucket {bucket!r}; "
+                           f"expected one of {BUCKETS}")
+        if not enabled():
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._seconds[bucket] += dt
+            self._counters[bucket].inc(dt)
+
+    def add(self, bucket: str, seconds: float) -> None:
+        """Attribute already-measured time (e.g. a StepTimer reading)."""
+        if bucket not in self._seconds:
+            raise KeyError(f"unknown goodput bucket {bucket!r}")
+        with self._lock:
+            self._seconds[bucket] += seconds
+        self._counters[bucket].inc(seconds)
+
+    # -- read -------------------------------------------------------------
+
+    def wall_s(self) -> float:
+        return time.monotonic() - self._t_start
+
+    def seconds(self, wall: float | None = None) -> dict[str, float]:
+        with self._lock:
+            out = dict(self._seconds)
+        # Residual: wall time no measure() region claimed. Clamped at 0 so
+        # overlapping regions (a bug, but survivable) can't go negative.
+        if wall is None:
+            wall = self.wall_s()
+        out["other"] = max(0.0, wall - sum(out.values()))
+        return out
+
+    def goodput(self) -> float:
+        """step-time / wall-time, in [0, 1]."""
+        wall = self.wall_s()
+        if wall <= 0:
+            return 0.0
+        with self._lock:
+            step = self._seconds["step"]
+        return min(1.0, step / wall)
+
+    def report(self, mfu: float | None = None) -> dict[str, float]:
+        """Flat report: per-bucket seconds + fractions (summing to 1.0 by
+        construction), goodput, and MFU-adjusted goodput when an MFU is
+        supplied."""
+        wall = self.wall_s()
+        secs = self.seconds(wall)
+        out: dict[str, float] = {"wall_s": round(wall, 4)}
+        for name, s in secs.items():
+            out[f"{name}_s"] = round(s, 4)
+            out[f"{name}_frac"] = round(s / wall, 4) if wall > 0 else 0.0
+        out["goodput"] = round(self.goodput(), 4)
+        if mfu is not None:
+            out["mfu"] = round(mfu, 4)
+            out["mfu_adjusted_goodput"] = round(self.goodput() * mfu, 4)
+        return out

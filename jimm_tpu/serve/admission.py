@@ -15,8 +15,10 @@ request timing out at once. The policy here is the standard trio —
   already-full *smaller* bucket — latency degrades to compute-bound, not
   queue-bound.
 
-Metrics are plain counters/gauges with a Prometheus text rendering and a
-flat-float ``snapshot()`` that plugs straight into
+Metrics are counters/gauges/histograms backed by the unified
+``jimm_tpu.obs`` registry (published under the ``jimm_serve`` namespace so
+train + serve read as one dump), with the same Prometheus text rendering
+and flat-float ``snapshot()`` that plugs straight into
 ``jimm_tpu.train.metrics.MetricsLogger.log`` (same JSONL plumbing training
 uses).
 """
@@ -26,8 +28,9 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from collections import deque
 from typing import Callable
+
+from jimm_tpu.obs.registry import MetricRegistry, publish
 
 
 class ServeError(Exception):
@@ -81,6 +84,13 @@ class ServeMetrics:
     Thread-safe: the HTTP front end observes from handler threads while the
     engine loop observes from the event loop. ``bind_gauge`` registers a
     callable gauge (cache hit rate, compile count) evaluated at render time.
+
+    Every instrument is backed by a :class:`jimm_tpu.obs.MetricRegistry`
+    published under the ``jimm_serve`` namespace (latest server wins), so
+    the same counters appear in the unified ``obs.snapshot()`` dump next to
+    the ``jimm_train_*`` series. ``observe_phase`` records the per-request
+    latency decomposition (queue / pad / device / readback) fed by the
+    engine's span instrumentation.
     """
 
     COUNTERS = ("requests_total", "responses_total", "timeouts_total",
@@ -88,69 +98,101 @@ class ServeMetrics:
                 "errors_total", "batches_total", "batch_items_total",
                 "batch_slots_total")
 
+    PHASES = ("queue", "pad", "device", "readback")
+
     def __init__(self, latency_window: int = 4096):
         self._lock = threading.Lock()
-        self._counters = {name: 0 for name in self.COUNTERS}
-        self._latencies: deque[float] = deque(maxlen=latency_window)
+        self.registry = publish(MetricRegistry("jimm_serve"))
+        self._counters = {name: self.registry.counter(name)
+                          for name in self.COUNTERS}
+        self._latency = self.registry.histogram(
+            "request_latency_seconds", window=latency_window)
+        self._phases = {name: self.registry.histogram(
+            f"span_{name}_seconds", window=latency_window)
+            for name in self.PHASES}
         self._gauges: dict[str, Callable[[], float]] = {}
         self.queue_depth = 0
         self._t_start = time.monotonic()
+        self.registry.gauge("queue_depth", lambda: self.queue_depth)
+        self.registry.gauge("batch_fill_ratio",
+                            lambda: round(self.batch_fill_ratio, 4))
+        self.registry.gauge("uptime_s",
+                            lambda: round(time.monotonic()
+                                          - self._t_start, 3))
 
     # -- observation ------------------------------------------------------
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + by
+            counter = self._counters.get(name)
+            if counter is None:
+                counter = self._counters[name] = self.registry.counter(name)
+        counter.inc(by)
 
     def set_queue_depth(self, depth: int) -> None:
         self.queue_depth = depth
 
     def observe_batch(self, items: int, bucket: int, *,
                       shed: bool = False) -> None:
-        with self._lock:
-            self._counters["batches_total"] += 1
-            self._counters["batch_items_total"] += items
-            self._counters["batch_slots_total"] += bucket
-            if shed:
-                self._counters["shed_batches_total"] += 1
+        self._counters["batches_total"].inc()
+        self._counters["batch_items_total"].inc(items)
+        self._counters["batch_slots_total"].inc(bucket)
+        if shed:
+            self._counters["shed_batches_total"].inc()
 
     def observe_latency(self, seconds: float) -> None:
-        with self._lock:
-            self._latencies.append(seconds)
+        self._latency.observe(seconds)
+
+    def observe_phase(self, phase: str, seconds: float) -> None:
+        """Record one request's time in a dispatch phase (queue / pad /
+        device / readback)."""
+        hist = self._phases.get(phase)
+        if hist is None:
+            with self._lock:
+                hist = self._phases.setdefault(
+                    phase, self.registry.histogram(f"span_{phase}_seconds"))
+        hist.observe(seconds)
 
     def bind_gauge(self, name: str, fn: Callable[[], float]) -> None:
         self._gauges[name] = fn
+        self.registry.gauge(name, fn)
 
     # -- derived ----------------------------------------------------------
 
     def count(self, name: str) -> int:
         with self._lock:
-            return self._counters.get(name, 0)
+            counter = self._counters.get(name)
+        return counter.value if counter is not None else 0
 
     def latency_percentile(self, pct: float) -> float:
-        with self._lock:
-            data = sorted(self._latencies)
-        if not data:
-            return 0.0
-        idx = min(len(data) - 1, int(round(pct / 100.0 * (len(data) - 1))))
-        return data[idx]
+        return self._latency.percentile(pct)
+
+    def phase_percentile(self, phase: str, pct: float) -> float:
+        hist = self._phases.get(phase)
+        return hist.percentile(pct) if hist is not None else 0.0
 
     @property
     def batch_fill_ratio(self) -> float:
-        with self._lock:
-            slots = self._counters["batch_slots_total"]
-            items = self._counters["batch_items_total"]
+        slots = self._counters["batch_slots_total"].value
+        items = self._counters["batch_items_total"].value
         return items / slots if slots else 0.0
 
     def snapshot(self) -> dict:
         """Flat float/int dict: healthz payload, and directly loggable via
         ``MetricsLogger.log(step, **metrics.snapshot())``."""
         with self._lock:
-            out = dict(self._counters)
+            out = {name: counter.value
+                   for name, counter in self._counters.items()}
         out["queue_depth"] = self.queue_depth
         out["batch_fill_ratio"] = round(self.batch_fill_ratio, 4)
         out["latency_p50_ms"] = round(self.latency_percentile(50) * 1e3, 3)
         out["latency_p99_ms"] = round(self.latency_percentile(99) * 1e3, 3)
+        for phase, hist in self._phases.items():
+            if hist.count:
+                out[f"span_{phase}_p50_ms"] = round(
+                    hist.percentile(50) * 1e3, 3)
+                out[f"span_{phase}_p99_ms"] = round(
+                    hist.percentile(99) * 1e3, 3)
         out["uptime_s"] = round(time.monotonic() - self._t_start, 3)
         for name, fn in self._gauges.items():
             try:

@@ -26,11 +26,13 @@ from __future__ import annotations
 import asyncio
 import functools
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
 
 import numpy as np
 
+from jimm_tpu.obs.spans import new_trace_id, span
 from jimm_tpu.serve.admission import (AdmissionController, AdmissionPolicy,
                                       DeadlineExceededError, EngineClosedError,
                                       RequestError, ServeMetrics)
@@ -61,14 +63,15 @@ def counting_forward(model, method: str = "encode_image"
 
 
 class _Request:
-    __slots__ = ("item", "future", "deadline", "t0")
+    __slots__ = ("item", "future", "deadline", "t0", "rid")
 
     def __init__(self, item: np.ndarray, future: asyncio.Future,
-                 deadline: float, t0: float):
+                 deadline: float, t0: float, rid: str):
         self.item = item
         self.future = future
         self.deadline = deadline
         self.t0 = t0
+        self.rid = rid
 
 
 class InferenceEngine:
@@ -116,6 +119,9 @@ class InferenceEngine:
         self._pool = ThreadPoolExecutor(max_workers=1,
                                         thread_name_prefix="jimm-serve-fwd")
         self._running = False
+        # Per-request phase decomposition (trace id -> phase seconds),
+        # newest last; read by /healthz debugging and tests.
+        self.recent_traces: deque[dict] = deque(maxlen=64)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -127,7 +133,8 @@ class InferenceEngine:
         for size in self.buckets.sizes:
             zeros = np.zeros((size,) + self.item_shape, self.dtype)
             t0 = time.monotonic()
-            self._forward_blocking(zeros)
+            with span("serve_warmup_compile"):
+                self._forward_blocking(zeros)
             times[size] = round(time.monotonic() - t0, 4)
         return times
 
@@ -153,11 +160,14 @@ class InferenceEngine:
     # -- submission -------------------------------------------------------
 
     async def submit(self, item: np.ndarray,
-                     timeout_s: float | None = None) -> np.ndarray:
+                     timeout_s: float | None = None,
+                     trace_id: str | None = None) -> np.ndarray:
         """One request in, one output row out. Raises
         :class:`QueueFullError` (backpressure), :class:`RequestError`
         (shape mismatch), or :class:`DeadlineExceededError` (deadline hit
-        while queued or in flight)."""
+        while queued or in flight). ``trace_id`` (admission-assigned, or
+        generated here) follows the request into bucket dispatch and keys
+        its phase decomposition in ``recent_traces``."""
         if not self._running or self._queue is None:
             raise EngineClosedError("engine is not running; call start()")
         item = self._coerce(item)
@@ -166,7 +176,8 @@ class InferenceEngine:
         now = time.monotonic()
         deadline = self.admission.deadline_for(timeout_s, now)
         future = asyncio.get_running_loop().create_future()
-        self._queue.put_nowait(_Request(item, future, deadline, now))
+        self._queue.put_nowait(_Request(item, future, deadline, now,
+                                        trace_id or new_trace_id()))
         self.metrics.set_queue_depth(self._queue.qsize())
         try:
             return await asyncio.wait_for(future, timeout=deadline - now)
@@ -251,18 +262,27 @@ class InferenceEngine:
         if not live:
             return
         n = len(live)
+        # queue phase ends here: time from submit to the start of dispatch
+        for req in live:
+            self.metrics.observe_phase("queue", now - req.t0)
         bucket = self.buckets.select(n) or self.buckets.max_size
-        padded = pad_batch([req.item for req in live], bucket)
+        t_pad = time.perf_counter()
+        with span("serve_pad"):
+            padded = pad_batch([req.item for req in live], bucket)
+        pad_s = time.perf_counter() - t_pad
+        self.metrics.observe_phase("pad", pad_s)
         loop = asyncio.get_running_loop()
         try:
-            out = await loop.run_in_executor(self._pool,
-                                             self._forward_blocking, padded)
+            out, device_s, readback_s = await loop.run_in_executor(
+                self._pool, self._forward_blocking_timed, padded)
         except Exception as e:  # noqa: BLE001 — surface to every waiter
             self.metrics.inc("errors_total")
             for req in live:
                 if not req.future.done():
                     req.future.set_exception(e)
             return
+        self.metrics.observe_phase("device", device_s)
+        self.metrics.observe_phase("readback", readback_s)
         self.metrics.observe_batch(n, bucket, shed=shed)
         done = time.monotonic()
         for i, req in enumerate(live):
@@ -270,10 +290,34 @@ class InferenceEngine:
                 req.future.set_result(out[i])
                 self.metrics.inc("responses_total")
                 self.metrics.observe_latency(done - req.t0)
+                self.recent_traces.append({
+                    "trace_id": req.rid,
+                    "bucket": bucket,
+                    "queue_s": round(now - req.t0, 6),
+                    "pad_s": round(pad_s, 6),
+                    "device_s": round(device_s, 6),
+                    "readback_s": round(readback_s, 6),
+                    "total_s": round(done - req.t0, 6),
+                })
 
     # -- device side (executor thread, never the event loop) --------------
 
     def _forward_blocking(self, padded: np.ndarray) -> np.ndarray:
         """Runs the warm forward and materializes the result on host. The
         only place in the engine that blocks on the device."""
-        return np.asarray(self.forward(padded))
+        return self._forward_blocking_timed(padded)[0]
+
+    def _forward_blocking_timed(
+            self, padded: np.ndarray) -> tuple[np.ndarray, float, float]:
+        """`_forward_blocking` plus the device/readback split: seconds the
+        device spent computing (dispatch + ``block_until_ready``) vs.
+        copying the result back to host memory (``np.asarray``)."""
+        t0 = time.perf_counter()
+        with span("serve_device"):
+            out = self.forward(padded)
+            if hasattr(out, "block_until_ready"):
+                out.block_until_ready()
+        t1 = time.perf_counter()
+        with span("serve_readback"):
+            host = np.asarray(out)
+        return host, t1 - t0, time.perf_counter() - t1

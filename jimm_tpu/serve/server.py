@@ -29,6 +29,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from jimm_tpu.obs.registry import registries as _obs_registries
+from jimm_tpu.obs.exporters import render_prometheus_text
+from jimm_tpu.obs.spans import new_trace_id
 from jimm_tpu.serve.admission import RequestError, ServeError, ServeMetrics
 from jimm_tpu.serve.cache import (EmbeddingCache, class_embedding_cache,
                                   prompt_set_key)
@@ -154,7 +157,7 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             self._send_json(200, app.healthz())
         elif self.path == "/metrics":
-            self._send(200, app.metrics.render_prometheus().encode(),
+            self._send(200, app.metrics_text().encode(),
                        "text/plain; version=0.0.4")
         else:
             self._send_json(404, {"error": "not_found",
@@ -290,33 +293,54 @@ class ServingServer:
 
     # -- request handling (called from HTTP handler threads) --------------
 
-    def _submit(self, image: np.ndarray,
-                timeout_s: float | None) -> np.ndarray:
+    def _submit(self, image: np.ndarray, timeout_s: float | None,
+                trace_id: str | None = None) -> np.ndarray:
         assert self._loop is not None
         future = asyncio.run_coroutine_threadsafe(
-            self.engine.submit(image, timeout_s=timeout_s), self._loop)
+            self.engine.submit(image, timeout_s=timeout_s,
+                               trace_id=trace_id), self._loop)
         return future.result(timeout=self.request_timeout_s)
 
     def embed(self, payload: dict) -> dict:
+        rid = new_trace_id()
         image = decode_image_payload(payload, dtype=self.engine.dtype)
-        features = self._submit(image, payload.get("timeout_s"))
-        return {"features": np.asarray(features, np.float32).tolist()}
+        features = self._submit(image, payload.get("timeout_s"), rid)
+        return {"features": np.asarray(features, np.float32).tolist(),
+                "trace_id": rid}
 
     def classify(self, payload: dict) -> dict:
         if self.zero_shot is None:
             raise RequestError("this server has no zero-shot service "
                                "(started without a text tower)")
+        rid = new_trace_id()
         tokens = payload.get("tokens")
         if not isinstance(tokens, dict) or not tokens:
             raise RequestError("classify needs 'tokens': {label: [ids]}")
         labels, weights, cached = \
             self.zero_shot.class_weights_blocking(tokens)
         image = decode_image_payload(payload, dtype=self.engine.dtype)
-        features = self._submit(image, payload.get("timeout_s"))
+        features = self._submit(image, payload.get("timeout_s"), rid)
         scores = self.zero_shot.scores(np.asarray(features), weights)
         return {"scores": {label: round(float(s), 6)
                            for label, s in zip(labels, scores)},
-                "cached": cached}
+                "cached": cached,
+                "trace_id": rid}
+
+    def metrics_text(self) -> str:
+        """Unified Prometheus dump for ``/metrics``: this server's
+        ``jimm_serve_*`` series (the exact ServeMetrics snapshot names, as
+        always) merged with every other namespace published to the obs hub
+        (``jimm_train_*`` goodput, ``jimm_spans_*``, ...) — one scrape sees
+        the whole process."""
+        series: dict = {}
+        for prefix, reg in _obs_registries().items():
+            if prefix == "jimm_serve":
+                continue  # ours comes from self.metrics below, not the hub
+            for name, value in reg.snapshot().items():
+                series[f"{prefix}_{name}"] = value
+        for name, value in self.metrics.snapshot().items():
+            series[f"jimm_serve_{name}"] = value
+        return render_prometheus_text(series)
 
     def healthz(self) -> dict:
         snap = self.metrics.snapshot()

@@ -113,19 +113,24 @@ class CheckpointManager:
              optimizer: nnx.Optimizer | None = None, *,
              extra: dict[str, Any] | None = None, force: bool = False) -> bool:
         """Async-save model (+ optimizer) state at ``step``."""
-        items: dict[str, Any] = {
-            "model": ocp.args.StandardSave(nnx.state(model, nnx.Param))}
-        if optimizer is not None:
-            items["opt"] = ocp.args.StandardSave(
-                nnx.state(optimizer, nnx.optimizer.OptState))
-        meta = dict(extra or {})
-        layout = _storage_layout(model)
-        if layout is not None:
-            meta["_storage_layout"] = layout
-        if meta:
-            items["extra"] = ocp.args.JsonSave(meta)
-        return self._mgr.save(step, args=ocp.args.Composite(**items),
-                              force=force)
+        from jimm_tpu.obs import get_registry, span
+        with span("checkpoint_save"):
+            items: dict[str, Any] = {
+                "model": ocp.args.StandardSave(nnx.state(model, nnx.Param))}
+            if optimizer is not None:
+                items["opt"] = ocp.args.StandardSave(
+                    nnx.state(optimizer, nnx.optimizer.OptState))
+            meta = dict(extra or {})
+            layout = _storage_layout(model)
+            if layout is not None:
+                meta["_storage_layout"] = layout
+            if meta:
+                items["extra"] = ocp.args.JsonSave(meta)
+            saved = self._mgr.save(step, args=ocp.args.Composite(**items),
+                                   force=force)
+        if saved:
+            get_registry("jimm_train").counter("checkpoint_saves_total").inc()
+        return saved
 
     def restore(self, model: nnx.Module,
                 optimizer: nnx.Optimizer | None = None,
@@ -139,40 +144,45 @@ class CheckpointManager:
         through canonical order (saved-storage -> canonical -> current-
         storage), so a pipelined run can be evaluated or fine-tuned with any
         other placement — including none."""
+        from jimm_tpu.obs import get_registry, span
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError("no checkpoint found")
-        model_state = nnx.state(model, nnx.Param)
-        items: dict[str, Any] = {
-            "model": ocp.args.StandardRestore(model_state)}
-        if optimizer is not None:
-            items["opt"] = ocp.args.StandardRestore(
-                nnx.state(optimizer, nnx.optimizer.OptState))
-        # probe for the optional extra/ item by its committed directory (the
-        # manager uses default step naming) instead of catch-and-retry: a
-        # corrupt/unreadable extra must FAIL the restore, not silently skip
-        # the placement guard below, and a genuine model-state error must not
-        # trigger a pointless second multi-GB restore attempt
-        has_extra = (self._mgr.directory / str(step) / "extra").exists()
-        if has_extra:
-            items["extra"] = ocp.args.JsonRestore()
-        restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
-        saved_meta = (restored.get("extra") or {}) if has_extra else {}
-        self.last_restored_extra = {k: v for k, v in saved_meta.items()
-                                    if k != "_storage_layout"}
-        saved = saved_meta.get("_storage_layout")
-        current = _storage_layout(model)
-        model_state = restored["model"]
-        opt_state = restored.get("opt")
-        if saved != current:
-            model_state = _relayout(model_state, saved, current)
-            if opt_state is not None:
-                # optimizer moments live under opt.model mirroring the
-                # param tree; same stacked rows, same re-permutation
-                opt_state = _relayout(opt_state, saved, current)
-        nnx.update(model, model_state)
-        if optimizer is not None:
-            nnx.update(optimizer, opt_state)
+        get_registry("jimm_train").counter("checkpoint_restores_total").inc()
+        with span("checkpoint_restore"):
+            model_state = nnx.state(model, nnx.Param)
+            items: dict[str, Any] = {
+                "model": ocp.args.StandardRestore(model_state)}
+            if optimizer is not None:
+                items["opt"] = ocp.args.StandardRestore(
+                    nnx.state(optimizer, nnx.optimizer.OptState))
+            # probe for the optional extra/ item by its committed directory
+            # (the manager uses default step naming) instead of
+            # catch-and-retry: a corrupt/unreadable extra must FAIL the
+            # restore, not silently skip the placement guard below, and a
+            # genuine model-state error must not trigger a pointless second
+            # multi-GB restore attempt
+            has_extra = (self._mgr.directory / str(step) / "extra").exists()
+            if has_extra:
+                items["extra"] = ocp.args.JsonRestore()
+            restored = self._mgr.restore(step,
+                                         args=ocp.args.Composite(**items))
+            saved_meta = (restored.get("extra") or {}) if has_extra else {}
+            self.last_restored_extra = {k: v for k, v in saved_meta.items()
+                                        if k != "_storage_layout"}
+            saved = saved_meta.get("_storage_layout")
+            current = _storage_layout(model)
+            model_state = restored["model"]
+            opt_state = restored.get("opt")
+            if saved != current:
+                model_state = _relayout(model_state, saved, current)
+                if opt_state is not None:
+                    # optimizer moments live under opt.model mirroring the
+                    # param tree; same stacked rows, same re-permutation
+                    opt_state = _relayout(opt_state, saved, current)
+            nnx.update(model, model_state)
+            if optimizer is not None:
+                nnx.update(optimizer, opt_state)
         return step
 
     def latest_step(self) -> int | None:

@@ -9,12 +9,15 @@ own cost analysis of the compiled step and divide by the chip's peak.
 from __future__ import annotations
 
 import json
+import math
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, IO
 
 import jax
+
+from jimm_tpu.obs.registry import MetricRegistry, get_registry
 
 #: Peak dense (bf16) TFLOP/s per chip. Sources: public TPU/GPU spec sheets.
 PEAK_TFLOPS: dict[str, float] = {
@@ -44,13 +47,28 @@ def compiled_flops(compiled) -> float | None:
         return None
 
 
-def mfu(flops_per_step: float, step_time_s: float,
+def mfu(flops_per_step: float | None, step_time_s: float,
         n_devices: int | None = None,
         device: jax.Device | None = None) -> float:
     """Model FLOPs utilization in [0, 1]. ``flops_per_step`` is the global
-    FLOP count of one step; peak scales with device count."""
+    FLOP count of one step; peak scales with device count.
+
+    Degenerate inputs — ``flops_per_step`` of ``None`` (the
+    :func:`compiled_flops` cost-analysis-failed path), a zero/negative/NaN
+    step time, or a NaN FLOP count — return 0.0 instead of raising, and
+    bump the ``jimm_train`` registry's ``mfu_degenerate_total`` counter so
+    a bench that silently reports 0 MFU is still diagnosable.
+    """
+    if (flops_per_step is None or step_time_s is None
+            or not math.isfinite(step_time_s) or step_time_s <= 0.0
+            or not math.isfinite(flops_per_step) or flops_per_step < 0.0):
+        get_registry("jimm_train").counter("mfu_degenerate_total").inc()
+        return 0.0
     n = n_devices if n_devices is not None else jax.device_count()
     peak = device_peak_tflops(device) * 1e12 * n
+    if peak <= 0.0:
+        get_registry("jimm_train").counter("mfu_degenerate_total").inc()
+        return 0.0
     return flops_per_step / (step_time_s * peak)
 
 
@@ -80,11 +98,20 @@ class StepTimer:
 class MetricsLogger:
     """Structured metrics: console + JSONL file (one object per step) +
     optional TensorBoard scalars (``tensorboard_dir``; writes event files
-    through the ``tensorboard`` package directly — no tensorflow)."""
+    through the ``tensorboard`` package directly — no tensorflow).
+
+    When ``registry`` is set (cmd_train passes the shared ``jimm_train``
+    registry), every logged scalar is mirrored into it: ``step`` as the
+    ``steps_logged_total`` counter, ``step_time_s`` into the
+    ``step_time_seconds`` histogram, and every other numeric value as a
+    last-value gauge — so the unified ``obs.snapshot()`` carries the same
+    series the JSONL does.
+    """
 
     path: str | Path | None = None
     print_every: int = 1
     tensorboard_dir: str | Path | None = None
+    registry: MetricRegistry | None = None
     _file: IO | None = field(default=None, repr=False)
     _tb: Any = field(default=None, repr=False)
     _step: int = 0
@@ -97,12 +124,30 @@ class MetricsLogger:
                 self._file = open(self.path, "a")
             self._file.write(json.dumps(record, default=float) + "\n")
             self._file.flush()
+        if self.registry is not None:
+            self._registry_log(metrics)
         if self.tensorboard_dir is not None:
             self._tb_log(step, metrics)
         if self.print_every and step % self.print_every == 0:
             parts = " ".join(f"{k}={float(v):.4g}" if isinstance(v, (int, float))
                              else f"{k}={v}" for k, v in metrics.items())
-            print(f"step {step}: {parts}")
+            print(f"step {step}: {parts}")  # jaxlint: disable=JL007 — the console sink IS the logger
+
+    def _registry_log(self, metrics: dict[str, Any]) -> None:
+        reg = self.registry
+        reg.counter("steps_logged_total").inc()
+        for k, v in metrics.items():
+            try:
+                value = float(v)
+            except (TypeError, ValueError):
+                continue  # non-numeric: JSONL-only, same as TensorBoard
+            if k == "step_time_s":
+                reg.histogram("step_time_seconds").observe(value)
+            else:
+                try:
+                    reg.gauge(k).set(value)
+                except Exception:  # noqa: BLE001 — name clash with a counter
+                    pass
 
     def _tb_log(self, step: int, metrics: dict[str, Any]) -> None:
         if self._tb is None:

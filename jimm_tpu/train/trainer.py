@@ -99,8 +99,13 @@ def make_classifier_train_step(*, donate: bool = False) -> Callable:
                 logits, labels).mean()
             return loss, logits
 
-        (loss, logits), grads = nnx.value_and_grad(loss_fn, has_aux=True)(model)
-        optimizer_update(optimizer, model, grads)
+        # named_scope (not obs.span — this is traced code) tags the emitted
+        # ops so profile.op_stats and obs trace lanes share one vocabulary
+        with jax.named_scope("fwd_bwd"):
+            (loss, logits), grads = nnx.value_and_grad(
+                loss_fn, has_aux=True)(model)
+        with jax.named_scope("optimizer_update"):
+            optimizer_update(optimizer, model, grads)
         accuracy = jnp.mean(jnp.argmax(logits, axis=-1) == labels)
         return {"loss": loss, "accuracy": accuracy}
 
@@ -172,8 +177,10 @@ def make_contrastive_train_step(kind: str = "siglip_ring", *, mesh=None,
         def loss_fn(model):
             return loss(model, images, text)
 
-        loss_val, grads = nnx.value_and_grad(loss_fn)(model)
-        optimizer_update(optimizer, model, grads)
+        with jax.named_scope("fwd_bwd"):
+            loss_val, grads = nnx.value_and_grad(loss_fn)(model)
+        with jax.named_scope("optimizer_update"):
+            optimizer_update(optimizer, model, grads)
         return {"loss": loss_val}
 
     return train_step

@@ -1,0 +1,95 @@
+"""CI tier-1 smoke for the unified observability stack.
+
+Runs a 3-step CPU training through the real CLI entry point, then serves one
+request through a real `InferenceEngine`, all in ONE process — and asserts
+the invariant the obs hub exists to provide: a single unified dump carrying
+``jimm_train_*`` AND ``jimm_serve_*`` series, in valid Prometheus text form,
+with no duplicate registrations. Exits nonzero (with a JSON error line) on
+any violation, so the CI step fails loudly.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m scripts.obs_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def fail(msg: str) -> int:
+    print(json.dumps({"metric": "obs_smoke", "value": 0.0, "error": msg}),
+          flush=True)
+    return 1
+
+
+def main() -> int:
+    import numpy as np
+
+    from jimm_tpu import cli, obs
+
+    # --- train: 3 synthetic steps through the shipped CLI ----------------
+    rc = cli.main(["train", "--preset", "vit-tiny-patch16-224", "--tiny",
+                   "--steps", "3", "--batch-size", "8"])
+    if rc:
+        return fail(f"cli train exited {rc}")
+
+    # --- serve: one request through a real engine -------------------------
+    import asyncio
+
+    from jimm_tpu.serve import BucketTable, InferenceEngine
+
+    def forward(batch):
+        return batch.reshape(batch.shape[0], -1)[:, :4]
+
+    engine = InferenceEngine(forward, item_shape=(8, 8, 3),
+                             buckets=BucketTable((1, 2)), max_delay_ms=2.0)
+
+    async def one_request():
+        await engine.start()
+        try:
+            await engine.submit(np.zeros((8, 8, 3), np.float32))
+        finally:
+            await engine.stop()
+
+    asyncio.run(one_request())
+
+    # --- the unified dump invariants --------------------------------------
+    text = obs.render_prometheus()
+    series = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.partition(" ")
+        if name in series:
+            return fail(f"duplicate series in unified dump: {name}")
+        series[name] = float(value)  # also validates the value renders
+
+    train = sorted(k for k in series if k.startswith("jimm_train_"))
+    serve = sorted(k for k in series if k.startswith("jimm_serve_"))
+    if not train:
+        return fail("no jimm_train_* series after a 3-step train")
+    if not serve:
+        return fail("no jimm_serve_* series after a serve request")
+    for required in ("jimm_train_steps_logged_total",
+                     "jimm_serve_responses_total",
+                     "jimm_train_goodput_ratio"):
+        if required not in series:
+            return fail(f"missing required series {required}")
+    if series["jimm_serve_responses_total"] < 1:
+        return fail("serve request not counted")
+
+    # per-request span decomposition reached the serve registry
+    for phase in ("queue", "pad", "device", "readback"):
+        if f"jimm_serve_span_{phase}_seconds_count" not in series:
+            return fail(f"serve span phase {phase!r} never observed")
+
+    print(json.dumps({"metric": "obs_smoke", "value": 1.0,
+                      "train_series": len(train),
+                      "serve_series": len(serve),
+                      "total_series": len(series)}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,10 +108,15 @@ def main():
         m = step_fn(model, optimizer, images, text)
     float(m["loss"])
 
+    from jimm_tpu import obs
+
     jax.profiler.start_trace(args.dir)
     t0 = time.perf_counter()
-    for _ in range(args.steps):
-        m = step_fn(model, optimizer, images, text)
+    # obs.span bridges to jax.profiler.TraceAnnotation while a trace is
+    # live, so each dispatch shows up as a named host lane in the capture
+    for i in range(args.steps):
+        with obs.span(f"profile_step_{i}"):
+            m = step_fn(model, optimizer, images, text)
     float(m["loss"])
     dt = (time.perf_counter() - t0) / args.steps
     jax.profiler.stop_trace()

@@ -55,14 +55,20 @@ def build_engine(args):
     return engine, traces, size, on_tpu, name
 
 
-def drive_engine(engine, item, clients: int, per_client: int) -> int:
-    """In-process closed loop on the engine's own event loop."""
+def drive_engine(engine, item, clients: int, per_client: int,
+                 latency) -> int:
+    """In-process closed loop on the engine's own event loop. ``latency``
+    is the shared obs histogram every completed request's client-observed
+    seconds land in (serve-side p50/p99 come from the engine's own
+    ServeMetrics; this measures what the caller saw, queuing included)."""
     import asyncio
 
     async def one_client():
         done = 0
         for _ in range(per_client):
+            t0 = time.perf_counter()
             await engine.submit(item)
+            latency.observe(time.perf_counter() - t0)
             done += 1
         return done
 
@@ -78,7 +84,7 @@ def drive_engine(engine, item, clients: int, per_client: int) -> int:
     return asyncio.run(go())
 
 
-def drive_http(server, item, clients: int, per_client: int) -> int:
+def drive_http(server, item, clients: int, per_client: int, latency) -> int:
     """Closed loop through the HTTP front end, one thread per client."""
     import concurrent.futures
 
@@ -89,7 +95,9 @@ def drive_http(server, item, clients: int, per_client: int) -> int:
     def one_client(_):
         done = 0
         for _ in range(per_client):
+            t0 = time.perf_counter()
             client.embed(item)
+            latency.observe(time.perf_counter() - t0)
             done += 1
         return done
 
@@ -133,6 +141,12 @@ def main() -> int:
     warmup_s = time.monotonic() - t_warm
     compiles_before = traces()
 
+    # client-observed latency reservoir: the shared obs histogram, sized to
+    # hold the whole run so its nearest-rank p50/p99 match ServeMetrics' math
+    from jimm_tpu.obs import Histogram
+    client_latency = Histogram("client_latency_seconds",
+                               window=max(total, 1))
+
     server = None
     if args.http:
         from jimm_tpu.serve import ServingServer
@@ -142,9 +156,11 @@ def main() -> int:
     t0 = time.monotonic()
     try:
         if server is not None:
-            done = drive_http(server, item, args.clients, per_client)
+            done = drive_http(server, item, args.clients, per_client,
+                              client_latency)
         else:
-            done = drive_engine(engine, item, args.clients, per_client)
+            done = drive_engine(engine, item, args.clients, per_client,
+                                client_latency)
     finally:
         if server is not None:
             server.stop()
@@ -162,6 +178,8 @@ def main() -> int:
         "requests": total,
         "p50_ms": metrics.snapshot()["latency_p50_ms"],
         "p99_ms": metrics.snapshot()["latency_p99_ms"],
+        "client_p50_ms": round(client_latency.percentile(50) * 1e3, 3),
+        "client_p99_ms": round(client_latency.percentile(99) * 1e3, 3),
         "batch_fill_ratio": round(metrics.batch_fill_ratio, 4),
         "batches": metrics.count("batches_total"),
         "buckets": list(engine.buckets.sizes),

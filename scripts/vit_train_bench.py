@@ -3,9 +3,11 @@
 Thin entry point the measurement watcher queues: execs ``bench.py --model
 vit_l16_384`` so the ViT-L/16-384 classifier train-MFU bench shares every
 piece of bench.py's outage hardening (probe/compile watchdogs, budget-aware
-retry, CPU-smoke fallback, analytic-vs-XLA MFU cross-check). Extra argv is
-forwarded, so e.g. ``python -m scripts.vit_train_bench --batch-size 64``
-works.
+retry, CPU-smoke fallback, analytic-vs-XLA MFU cross-check) and its
+measurement fields — including the ``step_time_p50_ms``/``step_time_p99_ms``
+spread computed with the shared `jimm_tpu.obs.percentile` helper, the same
+nearest-rank math the serve stack reports. Extra argv is forwarded, so e.g.
+``python -m scripts.vit_train_bench --batch-size 64`` works.
 """
 
 from __future__ import annotations

@@ -1,0 +1,21 @@
+"""Living JL007 fixture: bare print() in library code.
+
+The directory name puts ``jimm_tpu`` on the path, so the rule treats this
+file as library code (the same trick the JL006 fixture plays with
+``serve/``). Line markers below are asserted by tests/test_lint.py.
+"""
+
+
+def train_loop_fragment(step, loss):
+    print(f"step {step}: loss={loss}")  # JL007: bare library print
+    return loss
+
+
+def deliberate_console_sink(msg):
+    print(msg)  # jaxlint: disable=JL007 — fixture: sanctioned suppression
+    return msg
+
+
+def uses_logger(logger, step, loss):
+    logger.log(step, loss=loss)  # fine: structured sink, no finding
+    return loss

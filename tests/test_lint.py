@@ -91,6 +91,31 @@ class TestRuleFixtures:
         assert check_async_host_sync(tree, "jimm_tpu/train/loop.py") == []
         assert check_async_host_sync(tree, "jimm_tpu/serve/engine.py") != []
 
+    def test_jl007_bare_print_in_library_code(self):
+        findings = findings_for("jimm_tpu/bad_print.py")
+        # line 10 fires; the suppressed print on 15 and the logger call on
+        # 20 stay clean
+        assert rules_and_lines(findings) == {("JL007", 10)}
+        assert findings[0].severity == ERROR
+        assert "obs" in findings[0].message
+
+    def test_jl007_scoped_to_library_paths(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_bare_print
+        src = (FIXTURES / "jimm_tpu" / "bad_print.py").read_text()
+        tree = ast.parse(src)
+        # CLI entry points, scripts, and tests are print's legitimate home
+        assert check_bare_print(tree, "jimm_tpu/cli.py") == []
+        assert check_bare_print(tree, "jimm_tpu/obs/cli.py") == []
+        assert check_bare_print(tree, "jimm_tpu/__main__.py") == []
+        assert check_bare_print(tree, "jimm_tpu/launch.py") == []
+        assert check_bare_print(tree, "scripts/serve_bench.py") == []
+        assert check_bare_print(tree, "tests/test_obs.py") == []
+        # library modules are not
+        assert check_bare_print(tree, "jimm_tpu/train/metrics.py") != []
+        assert check_bare_print(tree, "jimm_tpu/serve/engine.py") != []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings

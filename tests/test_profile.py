@@ -1,11 +1,15 @@
 """Trace capture + offline per-op analysis (no TensorBoard)."""
 
+from pathlib import Path
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from jimm_tpu.train.profile import op_stats, summarize, trace
+
+FIXTURE_DIR = Path(__file__).parent / "fixtures" / "profile"
 
 
 def test_trace_capture_and_analysis(tmp_path):
@@ -26,6 +30,55 @@ def test_trace_capture_and_analysis(tmp_path):
     assert sum(s.total_us for s in stats) > 0
     text = summarize(stats, top=5, steps=3)
     assert "device op time" in text and "by category" in text
+
+
+class TestOpStatsFixture:
+    """Offline analyzer over the checked-in tiny.trace.json.gz: two device
+    pids (/device:TPU:0 and :1) each with an "XLA Ops" lane, a non-op
+    "Steps" lane, a host python process, real ops (fusion.1 x2, copy.2) and
+    one of every _NON_OP container-event shape."""
+
+    def test_per_op_aggregation_on_default_device(self):
+        stats = op_stats(FIXTURE_DIR)
+        by_name = {s.name: s for s in stats}
+        assert set(by_name) == {"fusion.1", "copy.2"}
+        fu = by_name["fusion.1"]
+        # both device-0 occurrences aggregated; the "Steps"-lane, device-1,
+        # and host-process events with the same name do not leak in
+        assert fu.count == 2
+        assert fu.total_us == pytest.approx(200.0)
+        assert fu.bytes_accessed == 2_000_000
+        assert fu.category == "fusion"
+        assert "fused_matmul" in fu.long_name
+        # 2 MB in 200 us = 10 GB/s
+        assert fu.gbps == pytest.approx(10.0)
+        cp = by_name["copy.2"]
+        assert (cp.count, cp.total_us, cp.category) == (1, 50.0, "copy")
+        # sorted by descending total time
+        assert [s.name for s in stats] == ["fusion.1", "copy.2"]
+
+    def test_non_op_container_events_filtered(self):
+        names = {s.name for s in op_stats(FIXTURE_DIR)}
+        for filtered in ("jit_train_step", "while.4", "12345",
+                         "SyncOnDone", "VitModule"):
+            assert filtered not in names
+
+    def test_device_selection(self):
+        # device=1 sees only the second pid's single occurrence
+        by_name = {s.name: s for s in op_stats(FIXTURE_DIR, device=1)}
+        assert by_name["fusion.1"].total_us == pytest.approx(40.0)
+        # device=None sums across devices (40 + 200), still no host events
+        all_dev = {s.name: s for s in op_stats(FIXTURE_DIR, device=None)}
+        assert all_dev["fusion.1"].total_us == pytest.approx(240.0)
+
+    def test_summarize_renders(self):
+        text = summarize(op_stats(FIXTURE_DIR), top=5)
+        assert "device op time" in text
+        assert "fusion.1" in text
+
+    def test_missing_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            op_stats(tmp_path)
 
 
 def test_metrics_logger_tensorboard(tmp_path):
