@@ -1,0 +1,52 @@
+"""jimm_tpu.aot — persistent ahead-of-time compile-artifact store.
+
+Cold start is the serve engine's last uninstrumented cost: every process
+restart re-traces and re-compiles every shape bucket before the first
+request can be answered. This package closes that gap with two layers:
+
+1. **Artifact store** (:mod:`keys` / :mod:`store` / :mod:`export`):
+   serve forwards are exported to StableHLO via ``jax.export``, keyed by
+   a byte-stable fingerprint over everything that shaped the program
+   (config hash, bucket, dtypes, mesh, backend, jax versions, donation),
+   and kept in a content-addressed on-disk store with atomic writes,
+   integrity hashes, LRU eviction, and quarantine-on-mismatch.
+2. **JAX persistent compilation cache**
+   (:func:`~jimm_tpu.aot.export.enable_persistent_cache`): backend
+   compiles — train steps, and the XLA half of deserialized serve
+   modules — become disk hits across restarts.
+
+:class:`~jimm_tpu.aot.warmup.AotForward` is the serve-side entry point:
+a drop-in for ``counting_forward`` that consults the store per bucket
+(``jimm_aot_hit_total``), write-throughs on a miss
+(``jimm_aot_miss_total``), and degrades to a fresh jit on any bad
+artifact (``jimm_aot_fallback_total``) — never a wrong answer, never a
+crash. ``jimm-tpu aot warmup|ls|gc|verify`` manages stores offline.
+"""
+
+from jimm_tpu.aot.keys import (AOT_FORMAT_VERSION, AotKey, canonical_json,
+                               config_hash, donation_signature,
+                               serve_forward_key)
+from jimm_tpu.aot.store import DEFAULT_MAX_BYTES, ArtifactStore, StoreEntry
+
+__all__ = [
+    "AOT_FORMAT_VERSION",
+    "AotKey",
+    "ArtifactStore",
+    "DEFAULT_MAX_BYTES",
+    "StoreEntry",
+    "canonical_json",
+    "config_hash",
+    "donation_signature",
+    "serve_forward_key",
+]
+
+
+def __getattr__(name):  # lazy: keep `import jimm_tpu.aot` jax-free
+    if name in ("AotForward", "aot_metrics", "warmup_store"):
+        from jimm_tpu.aot import warmup
+        return getattr(warmup, name)
+    if name in ("enable_persistent_cache", "load_serve_forward",
+                "serialize_serve_forward"):
+        from jimm_tpu.aot import export
+        return getattr(export, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,171 @@
+"""``jimm-tpu aot`` — manage the persistent compile-artifact store.
+
+Four verbs:
+
+- ``warmup``  — build a preset (or tiny override) and precompile every
+  serve bucket into the store, so the next ``jimm-tpu serve`` reaches
+  readiness with zero fresh jit compilations.
+- ``ls``      — list store entries (fingerprint, size, label, ages).
+- ``gc``      — evict least-recently-used entries down to a byte cap.
+- ``verify``  — re-hash every entry; quarantine any that fail integrity
+  or format-version checks.
+
+``ls``/``gc``/``verify`` never import jax (pure host tools, usable on a
+box with no accelerator — same rule as ``jimm-tpu obs``). ``warmup`` is
+the one verb that compiles.
+
+Wired as a subparser under the main ``jimm-tpu`` CLI (see jimm_tpu/cli.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from jimm_tpu.aot.store import ArtifactStore
+
+__all__ = ["add_aot_parser", "cmd_aot"]
+
+
+def _human(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _cmd_warmup(args) -> int:
+    # model construction reuses the main CLI's preset plumbing; imported
+    # lazily so `aot ls` never pays (or requires) a jax import
+    from jimm_tpu.cli import (_configure_backend, _family, _model_cls,
+                              _tiny_override)
+    _configure_backend(args)
+    import jax.numpy as jnp
+    from flax import nnx
+
+    from jimm_tpu import preset
+    from jimm_tpu.aot.warmup import warmup_store
+    from jimm_tpu.serve import BucketTable, default_buckets
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    fam = _family(args.preset)
+    cfg = preset(args.preset)
+    if args.tiny:
+        cfg = _tiny_override(cfg)
+    if args.ckpt:
+        model = _model_cls(fam).from_pretrained(args.ckpt, dtype=dtype)
+        label = f"{fam}:{args.ckpt}"
+    else:
+        model = _model_cls(fam)(cfg, rngs=nnx.Rngs(0), dtype=dtype,
+                                param_dtype=dtype)
+        label = f"{fam}:{args.preset}" + (":tiny" if args.tiny else "")
+    label += ":bf16" if args.bf16 else ":f32"
+    method = "encode_image" if fam in ("clip", "siglip") else "__call__"
+    buckets = (BucketTable(tuple(int(s) for s in args.buckets.split(",")))
+               if args.buckets else default_buckets())
+    size = model.config.vision.image_size
+    store = ArtifactStore(args.store)
+    report = warmup_store(model, method=method, buckets=buckets,
+                          item_shape=(size, size, 3), in_dtype="float32",
+                          store=store, label=label, force=args.force)
+    print(json.dumps({"store": str(store.root), "label": label,
+                      "method": method,
+                      "buckets": {str(k): v for k, v in report.items()}},
+                     indent=2))
+    return 0
+
+
+def _cmd_ls(args) -> int:
+    store = ArtifactStore(args.store)
+    rows = [e.to_row() for e in store.entries()]
+    if args.json:
+        print(json.dumps(rows, indent=2, sort_keys=True))
+        return 0
+    if not rows:
+        print(f"(empty store: {store.root})")
+        return 0
+    for r in sorted(rows, key=lambda r: r["last_used"], reverse=True):
+        print(f"{r['fingerprint'][:16]}  {_human(r['size']):>10}  "
+              f"bucket={r.get('bucket')}  {r.get('label') or '-'}  "
+              f"jax={r.get('jax') or '?'}")
+    print(f"total: {len(rows)} entries, {_human(store.total_bytes)}")
+    return 0
+
+
+def _cmd_gc(args) -> int:
+    store = ArtifactStore(args.store, max_bytes=args.max_bytes)
+    evicted = store.gc()
+    print(json.dumps({"evicted": evicted,
+                      "remaining_bytes": store.total_bytes,
+                      "cap_bytes": store.max_bytes}))
+    return 0
+
+
+def _cmd_verify(args) -> int:
+    store = ArtifactStore(args.store)
+    problems = store.verify()
+    print(json.dumps({"entries": len(store.entries()),
+                      "problems": problems}))
+    return 1 if problems else 0
+
+
+def add_aot_parser(subparsers) -> None:
+    """Attach the ``aot`` subcommand tree to the main CLI's subparsers."""
+    p = subparsers.add_parser(
+        "aot", help="manage the persistent AOT compile-artifact store")
+    p.set_defaults(fn=cmd_aot)
+    sub = p.add_subparsers(dest="aot_cmd", required=True)
+
+    pw = sub.add_parser("warmup",
+                        help="precompile every serve bucket for a preset "
+                             "into the store")
+    pw.add_argument("--preset", required=True)
+    pw.add_argument("--store", required=True,
+                    help="artifact store root directory")
+    pw.add_argument("--ckpt", default=None,
+                    help="load weights (safetensors/hub id) instead of "
+                         "random init — keys ignore weights, so this only "
+                         "changes the recorded label")
+    pw.add_argument("--tiny", action="store_true",
+                    help="shrink the preset to CPU-demo size")
+    pw.add_argument("--bf16", action="store_true")
+    pw.add_argument("--buckets", default=None,
+                    help="comma-separated batch buckets (default 1,2,4,8)")
+    pw.add_argument("--force", action="store_true",
+                    help="recompile buckets that already have entries")
+    pw.add_argument("--platform", choices=["cpu", "tpu"], default=None)
+    pw.add_argument("--host-devices", type=int, default=None)
+    pw.set_defaults(aot_func=_cmd_warmup)
+
+    pl = sub.add_parser("ls", help="list store entries")
+    pl.add_argument("--store", required=True)
+    pl.add_argument("--json", action="store_true")
+    pl.set_defaults(aot_func=_cmd_ls)
+
+    pg = sub.add_parser("gc", help="evict LRU entries down to a byte cap")
+    pg.add_argument("--store", required=True)
+    pg.add_argument("--max-bytes", type=int, default=None,
+                    help="override the store cap for this run")
+    pg.set_defaults(aot_func=_cmd_gc)
+
+    pv = sub.add_parser("verify",
+                        help="re-hash entries; quarantine failures")
+    pv.add_argument("--store", required=True)
+    pv.set_defaults(aot_func=_cmd_verify)
+
+
+def cmd_aot(args) -> int:
+    return args.aot_func(args)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="jimm-tpu-aot")
+    sub = parser.add_subparsers(dest="command", required=True)
+    add_aot_parser(sub)
+    args = parser.parse_args(argv)
+    return cmd_aot(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,161 @@
+"""Canonical cache keys for AOT compile artifacts.
+
+An artifact is reusable only when *everything* that shaped the compiled
+program matches: the model architecture (config), the entry method, the
+padded batch bucket and item shape, the batch dtype the engine assembles,
+the parameter dtype the weights live in, the mesh layout, the backend, the
+jax/jaxlib pair that produced the StableHLO, and the donation signature.
+One field drifting silently would hand a stale executable to a different
+program — so all of them are folded into a single SHA-256 fingerprint over
+a canonical JSON form (sorted keys, no whitespace, primitives only), which
+is byte-stable across processes and platforms by construction
+(``tests/test_aot.py`` pins a golden digest).
+
+jax is imported lazily and only to *default* the version/backend fields;
+passing them explicitly keeps this module usable from pure-host tooling
+(``jimm-tpu aot ls``/``gc`` never touch a backend).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Mapping, Sequence
+
+__all__ = ["AotKey", "canonical_json", "config_hash", "donation_signature",
+           "serve_forward_key"]
+
+#: bump when the artifact payload layout changes (meta schema, leaf
+#: partitioning, serialization framing) — old entries then quarantine
+#: instead of deserializing garbage
+AOT_FORMAT_VERSION = 1
+
+
+def _canonical(obj: Any) -> Any:
+    """Reduce ``obj`` to JSON primitives, deterministically.
+
+    Handles the types that appear in model configs and key fields:
+    dataclasses, mappings (key-sorted), sequences, dtypes (by name), and
+    scalars. Anything else falls back to ``repr`` — stable for the frozen
+    config values this module sees.
+    """
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return _canonical(dataclasses.asdict(obj))
+    if isinstance(obj, Mapping):
+        return {str(k): _canonical(obj[k]) for k in sorted(obj, key=str)}
+    if isinstance(obj, (list, tuple)):
+        return [_canonical(v) for v in obj]
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    if hasattr(obj, "name") and hasattr(obj, "itemsize"):  # np/jnp dtype
+        return str(obj.name)
+    return repr(obj)
+
+
+def canonical_json(obj: Any) -> str:
+    """The one serialization fingerprints hash: sorted keys, tightest
+    separators, no NaN laxness — identical bytes in every process."""
+    return json.dumps(_canonical(obj), sort_keys=True,
+                      separators=(",", ":"), allow_nan=False)
+
+
+def config_hash(config: Any) -> str:
+    """SHA-256 over the canonical JSON of a model config (dataclass or
+    mapping) — the architecture half of the key. Weights are *not* hashed:
+    artifacts hold the program, parameters ride in as call arguments, so
+    every checkpoint of one architecture shares the same executables."""
+    return hashlib.sha256(canonical_json(config).encode()).hexdigest()
+
+
+def donation_signature(donate_argnums: Sequence[int] = (),
+                       donate_argnames: Sequence[str] = ()) -> str:
+    """Stable encoding of buffer-donation settings. Donation changes the
+    compiled program's aliasing contract, so two jits differing only in
+    ``donate_argnums`` must never share an artifact."""
+    return canonical_json({"argnums": sorted(int(i) for i in donate_argnums),
+                           "argnames": sorted(str(s)
+                                              for s in donate_argnames)})
+
+
+def _default_versions() -> tuple[str, str]:
+    import jax
+    import jaxlib
+    return jax.__version__, jaxlib.__version__
+
+
+def _default_backend() -> str:
+    import jax
+    return jax.default_backend()
+
+
+@dataclasses.dataclass(frozen=True)
+class AotKey:
+    """Every field that must match for an artifact to be reusable."""
+
+    config_hash: str
+    method: str
+    bucket: int
+    item_shape: tuple[int, ...]
+    in_dtype: str
+    param_dtype: str
+    mesh_axes: tuple[tuple[str, int], ...]
+    backend: str
+    jax_version: str
+    jaxlib_version: str
+    donation: str
+    format_version: int = AOT_FORMAT_VERSION
+
+    def fingerprint(self) -> str:
+        """Hex SHA-256 over the canonical JSON of all fields — the store's
+        content address. Byte-stable across processes (golden-tested)."""
+        return hashlib.sha256(
+            canonical_json(dataclasses.asdict(self)).encode()).hexdigest()
+
+    def describe(self) -> dict:
+        """Human-facing metadata subset recorded in the store entry."""
+        return {"method": self.method, "bucket": self.bucket,
+                "item_shape": list(self.item_shape),
+                "in_dtype": self.in_dtype, "param_dtype": self.param_dtype,
+                "backend": self.backend, "jax": self.jax_version,
+                "jaxlib": self.jaxlib_version,
+                "config_hash": self.config_hash[:12]}
+
+
+def serve_forward_key(config: Any, *, method: str, bucket: int,
+                      item_shape: Sequence[int], in_dtype: Any,
+                      param_dtype: Any, mesh: Any = None,
+                      backend: str | None = None,
+                      jax_version: str | None = None,
+                      jaxlib_version: str | None = None,
+                      donation: str | None = None) -> AotKey:
+    """Build the key for one serve bucket's forward.
+
+    Version/backend fields default from the running jax, but every field
+    accepts an explicit value so keys can be computed (and golden-tested)
+    without a backend.
+    """
+    if jax_version is None or jaxlib_version is None:
+        jv, jlv = _default_versions()
+        jax_version = jax_version or jv
+        jaxlib_version = jaxlib_version or jlv
+    if backend is None:
+        backend = _default_backend()
+    mesh_axes: tuple[tuple[str, int], ...] = ()
+    if mesh is not None:
+        shape = dict(mesh.shape) if hasattr(mesh, "shape") else dict(mesh)
+        mesh_axes = tuple(sorted((str(k), int(v)) for k, v in shape.items()))
+    import numpy as np
+    return AotKey(
+        config_hash=config_hash(config),
+        method=str(method),
+        bucket=int(bucket),
+        item_shape=tuple(int(d) for d in item_shape),
+        in_dtype=str(np.dtype(in_dtype).name),
+        param_dtype=str(param_dtype),
+        mesh_axes=mesh_axes,
+        backend=str(backend),
+        jax_version=str(jax_version),
+        jaxlib_version=str(jaxlib_version),
+        donation=donation if donation is not None else donation_signature(),
+    )

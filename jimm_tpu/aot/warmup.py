@@ -1,0 +1,224 @@
+"""Store-first serve forward: AOT-loaded executables per bucket, fresh jit
+as the always-correct fallback, precompilation of whole bucket tables.
+
+:class:`AotForward` is a drop-in for the plain jitted pair
+``serve.engine.counting_forward`` returns: callable over one padded batch,
+plus a trace-count getter the engine exports as the ``compile_count``
+gauge. The difference is dispatch order — each bucket size first consults
+the :class:`~jimm_tpu.aot.store.ArtifactStore` (under an ``aot_load``
+span) and only falls back to the counting jitted forward on a miss or a
+bad artifact, so a fully warm store reaches readiness with **zero** fresh
+traces. Outcome counters land in the ``jimm_aot`` obs registry:
+
+- ``jimm_aot_hit_total``       artifact loaded and installed
+- ``jimm_aot_miss_total``      no artifact for the key (fresh compile;
+  write-through puts the new artifact unless disabled)
+- ``jimm_aot_fallback_total``  artifact existed but failed validation,
+  deserialization, or execution (quarantined; fresh compile served)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from jimm_tpu.aot.keys import AOT_FORMAT_VERSION, AotKey, serve_forward_key
+from jimm_tpu.aot.store import ArtifactStore
+
+__all__ = ["AotForward", "aot_metrics", "warmup_store"]
+
+
+def aot_metrics():
+    """The ``jimm_aot`` registry's (hit, miss, fallback) counters."""
+    from jimm_tpu import obs
+    reg = obs.get_registry("jimm_aot")
+    return (reg.counter("hit_total"), reg.counter("miss_total"),
+            reg.counter("fallback_total"))
+
+
+def _runtime_versions() -> dict:
+    import jax
+    import jaxlib
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__}
+
+
+class AotForward:
+    """Bucket-dispatching forward with store-first warm-start.
+
+    Args:
+        model: live nnx model (supplies parameters at call time).
+        method: forward method name (``encode_image`` / ``__call__``).
+        item_shape: per-request shape, no batch axis.
+        in_dtype: dtype the engine assembles batches in.
+        store: artifact store consulted before any fresh jit.
+        label: human-facing tag recorded in store metadata
+            (e.g. ``clip:clip-vit-base-patch16:f32``).
+        mesh: optional mesh folded into the cache key.
+        write_through: put freshly compiled buckets back into the store
+            (default True) so the next process starts warm.
+    """
+
+    def __init__(self, model, *, method: str, item_shape: tuple[int, ...],
+                 in_dtype: Any = np.float32, store: ArtifactStore,
+                 label: str = "", mesh: Any = None,
+                 write_through: bool = True):
+        from jimm_tpu.serve.engine import counting_forward
+        self.model = model
+        self.method = method
+        self.item_shape = tuple(int(d) for d in item_shape)
+        self.in_dtype = np.dtype(in_dtype)
+        self.store = store
+        self.label = label
+        self.mesh = mesh
+        self.write_through = write_through
+        self._loaded: dict[int, Callable] = {}
+        #: bucket -> "aot" | "miss" | "fallback" (how it was warmed)
+        self.sources: dict[int, str] = {}
+        self._fresh, self.trace_count = counting_forward(model, method)
+        param_dtype = "unknown"
+        try:
+            import jax
+            from flax import nnx
+            leaves = jax.tree.leaves(nnx.state(model))
+            if leaves:
+                param_dtype = str(leaves[0].dtype)
+        except Exception:  # noqa: BLE001 — key quality, not correctness
+            pass
+        self._param_dtype = param_dtype
+
+    # -- keys -------------------------------------------------------------
+
+    def key_for(self, bucket: int) -> AotKey:
+        return serve_forward_key(
+            self.model.config, method=self.method, bucket=bucket,
+            item_shape=self.item_shape, in_dtype=self.in_dtype,
+            param_dtype=self._param_dtype, mesh=self.mesh)
+
+    # -- warm-start -------------------------------------------------------
+
+    def prepare_bucket(self, bucket: int) -> str:
+        """Consult the store for one bucket; install the loaded executable
+        or arrange the fresh-compile fallback. Returns the source tag the
+        engine's warmup report records. Never raises: every failure path
+        degrades to the counting jitted forward."""
+        from jimm_tpu import obs
+        bucket = int(bucket)
+        if bucket in self.sources:
+            return self.sources[bucket]
+        hit, miss, fallback = aot_metrics()
+        key = self.key_for(bucket)
+        fp = key.fingerprint()
+        existed = self.store.contains(fp)
+        source = "miss"
+        with obs.span("aot_load"):
+            payload = self.store.get(
+                fp, expect_versions=_runtime_versions())
+            if payload is not None:
+                try:
+                    from jimm_tpu.aot.export import load_serve_forward
+                    self._loaded[bucket] = load_serve_forward(
+                        payload, self.model, self.method)
+                    source = "aot"
+                except Exception as e:  # noqa: BLE001 — degrade, never die
+                    self.store.quarantine(
+                        fp, f"deserialize/bind failed: {e}")
+                    source = "fallback"
+            elif existed:
+                source = "fallback"  # store.get already quarantined it
+        if source == "aot":
+            hit.inc()
+        elif source == "fallback":
+            fallback.inc()
+        else:
+            miss.inc()
+            if self.write_through:
+                self._compile_and_put(bucket, key, fp)
+        self.sources[bucket] = source
+        return source
+
+    def _compile_and_put(self, bucket: int, key: AotKey, fp: str) -> None:
+        """Write-through on a miss: export this bucket and store it for the
+        next process. Failure to serialize must not break serving."""
+        try:
+            from jimm_tpu.aot.export import serialize_serve_forward
+            payload = serialize_serve_forward(
+                self.model, self.method, bucket, self.item_shape,
+                self.in_dtype)
+            self.store.put(fp, payload,
+                           meta={"label": self.label, **key.describe(),
+                                 "format_version": AOT_FORMAT_VERSION})
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- dispatch ---------------------------------------------------------
+
+    def __call__(self, padded):
+        bucket = int(np.shape(padded)[0])
+        fn = self._loaded.get(bucket)
+        if fn is not None:
+            try:
+                return fn(padded)
+            except Exception:  # noqa: BLE001 — a bad artifact must not 500
+                # the request: drop it, quarantine, recompile fresh
+                _, _, fallback = aot_metrics()
+                fallback.inc()
+                del self._loaded[bucket]
+                self.sources[bucket] = "fallback"
+                self.store.quarantine(self.key_for(bucket).fingerprint(),
+                                      "loaded executable raised at call "
+                                      "time")
+        return self._fresh(padded)
+
+    def report(self) -> dict:
+        """Per-bucket warm-start outcome + totals (healthz/readiness)."""
+        counts = {"aot": 0, "miss": 0, "fallback": 0}
+        for src in self.sources.values():
+            counts[src] = counts.get(src, 0) + 1
+        return {"buckets": dict(sorted(self.sources.items())), **counts}
+
+
+def warmup_store(model, *, method: str, buckets, item_shape,
+                 in_dtype: Any = np.float32, store: ArtifactStore,
+                 label: str = "", mesh: Any = None,
+                 force: bool = False) -> dict:
+    """Precompile every bucket of a table into the store (the ``jimm-tpu
+    aot warmup`` core). Existing valid entries are kept unless ``force``.
+    Returns a per-bucket report of ``{fingerprint, seconds, action}``."""
+    import time
+
+    from jimm_tpu.aot.export import serialize_serve_forward
+    item_shape = tuple(int(d) for d in item_shape)
+    sizes = getattr(buckets, "sizes", buckets)
+    report: dict[int, dict] = {}
+    for bucket in sizes:
+        bucket = int(bucket)
+        key = serve_forward_key(
+            model.config, method=method, bucket=bucket,
+            item_shape=item_shape, in_dtype=in_dtype,
+            param_dtype=_model_param_dtype(model), mesh=mesh)
+        fp = key.fingerprint()
+        t0 = time.monotonic()
+        if store.contains(fp) and not force:
+            report[bucket] = {"fingerprint": fp, "seconds": 0.0,
+                              "action": "kept"}
+            continue
+        payload = serialize_serve_forward(model, method, bucket,
+                                          item_shape, in_dtype)
+        store.put(fp, payload, meta={"label": label, **key.describe(),
+                                     "format_version": AOT_FORMAT_VERSION})
+        report[bucket] = {"fingerprint": fp,
+                          "seconds": round(time.monotonic() - t0, 3),
+                          "action": "compiled",
+                          "bytes": len(payload)}
+    return report
+
+
+def _model_param_dtype(model) -> str:
+    try:
+        import jax
+        from flax import nnx
+        leaves = jax.tree.leaves(nnx.state(model))
+        return str(leaves[0].dtype) if leaves else "unknown"
+    except Exception:  # noqa: BLE001
+        return "unknown"

@@ -286,6 +286,11 @@ def cmd_presets(args: argparse.Namespace) -> int:
 
 def cmd_train(args: argparse.Namespace) -> int:
     _configure_backend(args)
+    if args.compilation_cache_dir:
+        # persistent XLA compile cache: restarted runs (preemption,
+        # resume, sweep retries) skip straight past the train-step compile
+        from jimm_tpu.aot.export import enable_persistent_cache
+        enable_persistent_cache(args.compilation_cache_dir)
     import jax.numpy as jnp
     import numpy as np
     from flax import nnx
@@ -1287,13 +1292,25 @@ def cmd_serve(args: argparse.Namespace) -> int:
     model_key += ":bf16" if args.bf16 else ":f32"
 
     method = "encode_image" if fam in ("clip", "siglip") else "__call__"
-    forward, trace_count = counting_forward(model, method)
+    size = model.config.vision.image_size
+    if args.aot_store:
+        # store-first warm start: buckets precompiled by `jimm-tpu aot
+        # warmup` deserialize instead of compiling; anything else compiles
+        # fresh and is written through for the next restart
+        from jimm_tpu.aot import ArtifactStore
+        from jimm_tpu.aot.warmup import AotForward
+        forward = AotForward(model, method=method,
+                             item_shape=(size, size, 3),
+                             store=ArtifactStore(args.aot_store),
+                             label=model_key)
+        trace_count = forward.trace_count
+    else:
+        forward, trace_count = counting_forward(model, method)
     buckets = (BucketTable(tuple(int(s) for s in args.buckets.split(",")))
                if args.buckets else default_buckets())
     policy = AdmissionPolicy(max_queue=args.queue_size,
                              default_timeout_s=args.timeout_s,
                              shed_fraction=args.shed_fraction)
-    size = model.config.vision.image_size
     engine = InferenceEngine(forward, item_shape=(size, size, 3),
                              buckets=buckets,
                              max_delay_ms=args.max_delay_ms, policy=policy,
@@ -1310,11 +1327,15 @@ def cmd_serve(args: argparse.Namespace) -> int:
                            metrics_log_every_s=args.metrics_every_s)
     t0 = time.monotonic()
     server.start()
-    print(json.dumps({"status": "serving", "host": args.host,
-                      "port": server.port, "model": model_key,
-                      "buckets": list(buckets.sizes),
-                      "warmup_s": round(time.monotonic() - t0, 3),
-                      "compile_count": trace_count()}), flush=True)
+    ready = {"status": "serving", "host": args.host,
+             "port": server.port, "model": model_key,
+             "buckets": list(buckets.sizes),
+             "warmup_s": round(time.monotonic() - t0, 3),
+             "compile_count": trace_count()}
+    if args.aot_store:
+        ready["aot"] = {str(k): v["source"]
+                        for k, v in sorted(engine.warmup_report.items())}
+    print(json.dumps(ready), flush=True)
     if args.max_seconds:
         time.sleep(args.max_seconds)
         server.stop()
@@ -1375,6 +1396,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--warmup-steps", type=int, default=0)
     sp.add_argument("--seed", type=int, default=0)
     sp.add_argument("--bf16", action="store_true")
+    sp.add_argument("--compilation-cache-dir", default=None,
+                    help="persist XLA compiles to this dir (jax "
+                         "compilation cache) so restarted runs skip the "
+                         "train-step compile")
     sp.add_argument("--mesh", default=None,
                     help='e.g. "data=4,model=2" (default: no mesh)')
     sp.add_argument("--rules", default=None,
@@ -1604,6 +1629,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "(train/metrics.py format)")
     sp.add_argument("--metrics-every-s", type=float, default=10.0)
     sp.add_argument("--bf16", action="store_true")
+    sp.add_argument("--aot-store", default=None,
+                    help="consult this AOT artifact store before any "
+                         "fresh compile (populate with `jimm-tpu aot "
+                         "warmup`); misses are written through")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_serve)
 
@@ -1619,6 +1648,10 @@ def build_parser() -> argparse.ArgumentParser:
     # jimm-tpu obs {snapshot,tail,diff} — pure-host metric tooling (no jax)
     from jimm_tpu.obs.cli import add_obs_parser
     add_obs_parser(sub)
+
+    # jimm-tpu aot {warmup,ls,gc,verify} — AOT compile-artifact store
+    from jimm_tpu.aot.cli import add_aot_parser
+    add_aot_parser(sub)
 
     return p
 

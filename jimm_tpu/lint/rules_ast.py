@@ -23,6 +23,11 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   belongs in the ``jimm_tpu.obs`` registry / ``MetricsLogger`` where it is
   structured, rate-limited, and exportable; CLI entry points
   (``cli.py``/``__main__.py``/``launch.py``) and scripts are exempt.
+- **JL008** ``jax.jit`` / ``nnx.jit`` invoked (or a jit-decorated function
+  defined) inside a loop body or per-request handler — every pass builds a
+  fresh jit wrapper with an empty compile cache, so the work recompiles
+  per iteration/request and defeats both bucket warmup and the AOT
+  artifact store. Hoist the jit to module/init scope; tests are exempt.
 """
 
 from __future__ import annotations
@@ -552,6 +557,89 @@ def check_bare_print(tree: ast.AST, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL008 — jit inside a loop body or per-request handler
+# ---------------------------------------------------------------------------
+
+#: method names that handle one network request per call
+#: (http.server's do_VERB convention; add as serving grows transports)
+REQUEST_HANDLER_NAMES = frozenset({"do_GET", "do_POST", "do_PUT",
+                                   "do_DELETE", "do_HEAD"})
+
+
+def _enclosing_loop(node: ast.AST) -> ast.AST | None:
+    """The innermost For/While/AsyncFor whose *body* (not iter/test)
+    contains ``node``, without crossing a function boundary — a jit inside
+    a ``def`` that merely sits in a loop runs once per call, not per
+    iteration of the outer loop."""
+    cur: ast.AST | None = node
+    while cur is not None:
+        parent = _parent(cur)
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            return None
+        if isinstance(parent, (ast.For, ast.AsyncFor, ast.While)) \
+                and cur not in (getattr(parent, "iter", None),
+                                getattr(parent, "test", None)):
+            return parent
+        cur = parent
+    return None
+
+
+def _enclosing_handler(node: ast.AST, path: str) -> str | None:
+    """Name of the per-request handler ``node`` sits in, if any: a
+    ``do_VERB`` method anywhere, or any ``async def`` in serving code
+    (the engine's event-loop coroutines each run per request/batch)."""
+    cur: ast.AST | None = _parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.FunctionDef) \
+                and cur.name in REQUEST_HANDLER_NAMES:
+            return cur.name
+        if isinstance(cur, ast.AsyncFunctionDef) and _path_is_serve(path):
+            return cur.name
+        cur = _parent(cur)
+    return None
+
+
+def check_jit_in_loop(tree: ast.AST, path: str) -> list[Finding]:
+    """JL008: a ``jit`` call in a loop body or request handler makes a new
+    wrapper (and a cold compile cache) every pass — the exact recompile
+    hazard bucket warmup and the AOT store exist to eliminate. Tests are
+    exempt: they intentionally construct jits per-case."""
+    if _path_is_test(path):
+        return []
+    findings = []
+    seen_lines: set[int] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_jit_expr(node.func)):
+            continue
+        where = None
+        if _enclosing_loop(node) is not None:
+            where = "a loop body"
+        else:
+            handler = _enclosing_handler(node, path)
+            if handler is not None:
+                where = f"per-request handler `{handler}`"
+        if where is None or node.lineno in seen_lines:
+            continue
+        seen_lines.add(node.lineno)
+        fname = _dotted(node.func) or "jit"
+        findings.append(Finding(
+            "JL008", ERROR, path, node.lineno,
+            f"{fname}(...) inside {where} builds a fresh wrapper (and "
+            f"recompiles) every pass, defeating bucket warmup and the AOT "
+            f"artifact store — hoist the jit to module or __init__ scope"))
+    for fn, dec in _jitted_functions(tree):
+        if _enclosing_loop(fn) is not None and fn.lineno not in seen_lines:
+            seen_lines.add(fn.lineno)
+            findings.append(Finding(
+                "JL008", ERROR, path, fn.lineno,
+                f"jit-decorated `{fn.name}` is defined inside a loop body "
+                f"— each iteration makes a new function object with a cold "
+                f"compile cache; define it once outside the loop"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -564,4 +652,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_pallas_tiling(tree, path, vmem_budget)
     findings += check_async_host_sync(tree, path)
     findings += check_bare_print(tree, path)
+    findings += check_jit_in_loop(tree, path)
     return findings

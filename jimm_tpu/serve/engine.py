@@ -122,20 +122,36 @@ class InferenceEngine:
         # Per-request phase decomposition (trace id -> phase seconds),
         # newest last; read by /healthz debugging and tests.
         self.recent_traces: deque[dict] = deque(maxlen=64)
+        # bucket -> {"seconds", "source"} filled by warmup_blocking;
+        # source is "compile" (plain forward) or the AOT outcome
+        # ("aot"/"miss"/"fallback") when the forward is store-backed.
+        self.warmup_report: dict = {}
 
     # -- lifecycle --------------------------------------------------------
 
     def warmup_blocking(self) -> dict:
         """Compile every bucket before traffic (call off the event loop).
         Returns {bucket: seconds}; after this, steady-state traffic hits
-        only warm executables."""
+        only warm executables.
+
+        Store-first forwards (jimm_tpu.aot.AotForward) are consulted via
+        their ``prepare_bucket(size)`` hook before the priming call: on an
+        AOT hit the forward installs a deserialized executable, so the
+        priming run below is a device warm-up, not a fresh trace+compile.
+        The per-bucket outcome lands in ``self.warmup_report``."""
+        prepare = getattr(self.forward, "prepare_bucket", None)
         times = {}
+        self.warmup_report = {}
         for size in self.buckets.sizes:
+            source = prepare(size) if prepare is not None else "compile"
             zeros = np.zeros((size,) + self.item_shape, self.dtype)
             t0 = time.monotonic()
-            with span("serve_warmup_compile"):
+            with span("serve_warmup_aot" if source == "aot"
+                      else "serve_warmup_compile"):
                 self._forward_blocking(zeros)
             times[size] = round(time.monotonic() - t0, 4)
+            self.warmup_report[size] = {"seconds": times[size],
+                                        "source": source}
         return times
 
     async def start(self) -> None:

@@ -344,10 +344,16 @@ class ServingServer:
 
     def healthz(self) -> dict:
         snap = self.metrics.snapshot()
-        return {"status": "ok",
-                "buckets": list(self.engine.buckets.sizes),
-                "queue_depth": snap["queue_depth"],
-                "batch_fill_ratio": snap["batch_fill_ratio"],
-                "latency_p50_ms": snap["latency_p50_ms"],
-                "latency_p99_ms": snap["latency_p99_ms"],
-                "uptime_s": snap["uptime_s"]}
+        out = {"status": "ok",
+               "buckets": list(self.engine.buckets.sizes),
+               "queue_depth": snap["queue_depth"],
+               "batch_fill_ratio": snap["batch_fill_ratio"],
+               "latency_p50_ms": snap["latency_p50_ms"],
+               "latency_p99_ms": snap["latency_p99_ms"],
+               "uptime_s": snap["uptime_s"]}
+        # per-bucket warm-start provenance (aot/miss/fallback/compile) —
+        # "did this process actually start warm?" is a health question
+        report = getattr(self.engine, "warmup_report", None)
+        if report:
+            out["warmup"] = {str(k): v for k, v in sorted(report.items())}
+        return out

@@ -86,6 +86,54 @@ def _from_file(p: Path) -> tuple[dict[str, np.ndarray], dict | None]:
     return weights, config
 
 
+# not-found family: a definitive "this file isn't in the repo" answer used
+# as control flow (sharded-vs-single probing) — retrying these would turn
+# every fallback probe into retries * backoff of dead waiting
+_NO_RETRY_ERRORS = ("EntryNotFoundError", "RepositoryNotFoundError",
+                    "RevisionNotFoundError", "GatedRepoError",
+                    "FileNotFoundError")
+
+
+def _retryable(exc: BaseException) -> bool:
+    return not any(cls.__name__ in _NO_RETRY_ERRORS
+                   for cls in type(exc).__mro__)
+
+
+def _hub_download_with_retry(hf_hub_download, repo_id: str, filename: str,
+                             *, retries: int | None = None,
+                             backoff_s: float | None = None,
+                             sleep=None) -> str:
+    """``hf_hub_download`` with bounded retry + local-cache last resort.
+
+    Transient failures (timeouts, 5xx, resets) get ``retries`` attempts
+    with exponential backoff; not-found errors propagate immediately (they
+    are sharded-vs-single control flow, not flakiness). When the network
+    never recovers, one final ``local_files_only=True`` attempt serves a
+    previously-cached copy — so a blipping link can't kill an `aot warmup`
+    or a train start whose weights are already on disk.
+    """
+    import time as _time
+    if retries is None:
+        retries = int(os.environ.get("JIMM_HUB_RETRIES", "3"))
+    if backoff_s is None:
+        backoff_s = float(os.environ.get("JIMM_HUB_BACKOFF_S", "0.5"))
+    sleep = sleep or _time.sleep
+    last: BaseException | None = None
+    for attempt in range(max(1, retries)):
+        try:
+            return hf_hub_download(repo_id, filename)
+        except Exception as e:
+            if not _retryable(e):
+                raise
+            last = e
+            if attempt + 1 < max(1, retries):
+                sleep(backoff_s * (2 ** attempt))
+    try:
+        return hf_hub_download(repo_id, filename, local_files_only=True)
+    except Exception:
+        raise last  # the transient error, not the unhelpful cache miss
+
+
 def _from_hub(repo_id: str, use_pytorch: bool = False
               ) -> tuple[dict[str, np.ndarray], dict | None]:
     try:
@@ -94,18 +142,22 @@ def _from_hub(repo_id: str, use_pytorch: bool = False
         raise FileNotFoundError(
             f"{repo_id!r} is not a local path and huggingface_hub is "
             "unavailable") from e
+
+    def download(filename: str) -> str:
+        return _hub_download_with_retry(hf_hub_download, repo_id, filename)
+
     def fetch(single: str, loader) -> dict[str, np.ndarray]:
         # sharded checkpoints first (large models), then the single file
         try:
-            index_path = hf_hub_download(repo_id, single + ".index.json")
+            index_path = download(single + ".index.json")
             with open(index_path) as f:
                 weight_map: dict[str, str] = json.load(f)["weight_map"]
             out: dict[str, np.ndarray] = {}
             for shard in sorted(set(weight_map.values())):
-                out.update(loader(hf_hub_download(repo_id, shard)))
+                out.update(loader(download(shard)))
             return out
         except Exception:
-            return loader(hf_hub_download(repo_id, single))
+            return loader(download(single))
 
     formats = [("model.safetensors", load_file),
                ("pytorch_model.bin", torch_pickle.load_file)]
@@ -121,7 +173,7 @@ def _from_hub(repo_id: str, use_pytorch: bool = False
             f"could not fetch {repo_id!r} from the HF hub "
             f"(offline, or repo has neither format?): {e}") from e
     try:
-        config_path = hf_hub_download(repo_id, "config.json")
+        config_path = download("config.json")
         config = _load_config(Path(config_path))
     except Exception:
         config = None

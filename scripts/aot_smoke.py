@@ -1,0 +1,113 @@
+"""CI tier-1 smoke for the AOT compile-artifact store.
+
+One process, two "lives" of a serve engine over a tiny CLIP:
+
+1. **Cold**: warmup a tmp store via ``jimm_tpu.aot.warmup_store`` (the
+   ``jimm-tpu aot warmup`` core) — every bucket exports and lands on disk.
+2. **Warm restart**: build a *fresh* store-backed forward + engine against
+   that store (new trace counter — exactly what a process restart gets)
+   and run bucket warmup. The acceptance invariant is asserted on the
+   shipped ``compile_count`` gauge: readiness with ZERO fresh jit
+   compilations, every bucket sourced ``"aot"``, one answered request
+   matching the direct model output, and ``jimm_aot_hit_total`` counted.
+
+Exits nonzero (with a JSON error line) on any violation.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m scripts.aot_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+
+
+def fail(msg: str) -> int:
+    print(json.dumps({"metric": "aot_smoke", "value": 0.0, "error": msg}),
+          flush=True)
+    return 1
+
+
+def main() -> int:
+    import asyncio
+
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import CLIP, obs, preset
+    from jimm_tpu.aot import ArtifactStore
+    from jimm_tpu.aot.warmup import AotForward, warmup_store
+    from jimm_tpu.cli import _tiny_override
+    from jimm_tpu.serve import BucketTable, InferenceEngine
+
+    buckets = (1, 2)
+    cfg = _tiny_override(preset("clip-vit-base-patch16"))
+    model = CLIP(cfg, rngs=nnx.Rngs(0))
+    size = cfg.vision.image_size
+
+    with tempfile.TemporaryDirectory(prefix="jimm-aot-smoke-") as root:
+        store = ArtifactStore(root)
+        report = warmup_store(model, method="encode_image", buckets=buckets,
+                              item_shape=(size, size, 3), store=store,
+                              label="aot_smoke")
+        if {b: r["action"] for b, r in report.items()} \
+                != {b: "compiled" for b in buckets}:
+            return fail(f"warmup did not compile every bucket: {report}")
+
+        # --- "restart": fresh forward, fresh counter, same store ----------
+        forward = AotForward(model, method="encode_image",
+                             item_shape=(size, size, 3), store=store,
+                             label="aot_smoke")
+        engine = InferenceEngine(forward, item_shape=(size, size, 3),
+                                 buckets=BucketTable(buckets),
+                                 max_delay_ms=2.0,
+                                 trace_count=forward.trace_count)
+        engine.warmup_blocking()
+
+        compile_count = engine.metrics.snapshot()["compile_count"]
+        if compile_count != 0:
+            return fail(f"warm restart paid {compile_count} fresh "
+                        f"compiles; store was not consulted")
+        sources = {b: r["source"] for b, r in engine.warmup_report.items()}
+        if sources != {b: "aot" for b in buckets}:
+            return fail(f"not every bucket loaded from the store: {sources}")
+
+        # --- one real request, numerically checked ------------------------
+        x = np.random.RandomState(0).randn(size, size, 3).astype(np.float32)
+
+        async def one_request():
+            await engine.start()
+            try:
+                return await engine.submit(x)
+            finally:
+                await engine.stop()
+
+        got = np.asarray(asyncio.run(one_request()))
+        want = np.asarray(model.encode_image(x[None]))[0]
+        if not np.allclose(got, want, rtol=1e-5, atol=1e-5):
+            return fail("AOT-loaded forward disagrees with the live model")
+        if forward.trace_count() != 0:
+            return fail(f"request path traced "
+                        f"{forward.trace_count()} fresh compiles")
+
+        snap = obs.get_registry("jimm_aot").snapshot()
+        if snap.get("hit_total", 0) < len(buckets):
+            return fail(f"jimm_aot_hit_total={snap.get('hit_total')} "
+                        f"< {len(buckets)} buckets")
+        if snap.get("fallback_total", 0):
+            return fail("unexpected jimm_aot_fallback_total on a clean "
+                        "store")
+
+        print(json.dumps({"metric": "aot_smoke", "value": 1.0,
+                          "buckets": list(buckets),
+                          "compile_count": compile_count,
+                          "hits": snap.get("hit_total"),
+                          "store_entries": len(store.entries())}),
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

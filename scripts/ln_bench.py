@@ -45,7 +45,8 @@ def main():
     nbytes = (R * F * 2) * 4  # read x + write y, fwd+bwd ballpark
     for name, f in (("xla", xla_ln),
                     ("fused", lambda x, s, b: layer_norm(x, s, b, eps))):
-        g = jax.jit(jax.grad(
+        # one compile per benchmarked variant, by design
+        g = jax.jit(jax.grad(  # jaxlint: disable=JL008
             lambda x, s, b: jnp.sum(f(x, s, b).astype(jnp.float32) ** 2),
             argnums=(0, 1, 2)))
         dt = timeit(g, x, s, b)

@@ -12,6 +12,12 @@ the repo ledger with the same ts/phase provenance the training benches use)
 and exits nonzero if any recompile happened after warmup — the serving
 shape-bucket discipline (docs/serving.md) made enforceable by the engine's
 compile-count instrumentation.
+
+``--aot DIR`` switches to the cold-start benchmark instead: time-to-first-
+response of a fresh engine is measured twice — compiling everything from
+scratch, then again restarted against the AOT artifact store DIR populated
+in between (docs/aot.md) — and the paired result lands in the same ledger
+format, so the warm-start win shows up in the bench trajectory.
 """
 
 from __future__ import annotations
@@ -105,6 +111,92 @@ def drive_http(server, item, clients: int, per_client: int, latency) -> int:
         return sum(pool.map(one_client, range(clients)))
 
 
+def bench_cold_start(args) -> dict:
+    """Time-to-first-response of a fresh engine, without vs. with a
+    populated AOT store. Each life uses a brand-new forward wrapper (what
+    a process restart gets); the store population between them is not part
+    of either measurement."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import preset
+    from jimm_tpu.aot import ArtifactStore
+    from jimm_tpu.aot.warmup import AotForward, warmup_store
+    from jimm_tpu.cli import _family, _model_cls, _tiny_override
+    from jimm_tpu.serve import (BucketTable, InferenceEngine,
+                                counting_forward, default_buckets)
+
+    on_tpu = jax.default_backend() == "tpu"
+    name = args.preset or ("clip-vit-base-patch32" if on_tpu
+                           else "clip-vit-base-patch16")
+    fam = _family(name)
+    cfg = preset(name)
+    if args.tiny or not on_tpu:
+        cfg = _tiny_override(cfg)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    model = _model_cls(fam)(cfg, rngs=nnx.Rngs(0), dtype=dtype,
+                            param_dtype=dtype)
+    method = "encode_image" if fam in ("clip", "siglip") else "__call__"
+    buckets = (BucketTable(tuple(int(s) for s in args.buckets.split(",")))
+               if args.buckets else default_buckets())
+    size = cfg.vision.image_size
+    item = np.random.RandomState(0).rand(size, size, 3).astype(np.float32)
+
+    def first_response(forward, traces) -> tuple[float, int, dict]:
+        engine = InferenceEngine(forward, item_shape=(size, size, 3),
+                                 buckets=buckets, max_delay_ms=2.0,
+                                 trace_count=traces)
+        t0 = time.monotonic()
+        engine.warmup_blocking()
+
+        async def one():
+            await engine.start()
+            try:
+                await engine.submit(item)
+            finally:
+                await engine.stop()
+
+        asyncio.run(one())
+        return (time.monotonic() - t0, traces(),
+                {str(k): v.get("source") for k, v in
+                 sorted(engine.warmup_report.items())})
+
+    # life 1: nothing cached — every bucket traces and compiles
+    fwd_cold, traces_cold = counting_forward(model, method)
+    cold_s, cold_compiles, _ = first_response(fwd_cold, traces_cold)
+
+    # populate the store (the `jimm-tpu aot warmup` step, off the clock)
+    store = ArtifactStore(args.aot)
+    warmup_store(model, method=method, buckets=buckets,
+                 item_shape=(size, size, 3), store=store,
+                 label=f"serve_bench:{name}")
+
+    # life 2: restart against the populated store
+    fwd_warm = AotForward(model, method=method, item_shape=(size, size, 3),
+                          store=store, label=f"serve_bench:{name}")
+    warm_s, warm_compiles, sources = first_response(fwd_warm,
+                                                    fwd_warm.trace_count)
+
+    return {
+        "metric": ("serve_cold_start" if on_tpu
+                   else "serve_cold_start (cpu smoke)"),
+        "value": round(cold_s / warm_s, 2) if warm_s else 0.0,
+        "unit": "x speedup (ttfr cold/aot)",
+        "model": name + (":tiny" if (args.tiny or not on_tpu) else ""),
+        "buckets": list(buckets.sizes),
+        "ttfr_cold_s": round(cold_s, 3),
+        "ttfr_aot_s": round(warm_s, 3),
+        "compiles_cold": cold_compiles,
+        "compiles_aot": warm_compiles,
+        "aot_sources": sources,
+        "store_entries": len(store.entries()),
+    }
+
+
 def main() -> int:
     import jimm_tpu.utils.env
     jimm_tpu.utils.env.configure_platform()
@@ -127,7 +219,27 @@ def main() -> int:
                         "the in-process engine")
     p.add_argument("--record", action="store_true",
                    help="append the result line to MEASUREMENTS.jsonl")
+    p.add_argument("--aot", default=None, metavar="STORE_DIR",
+                   help="benchmark cold-start time-to-first-response "
+                        "without vs. with a populated AOT artifact store "
+                        "at this path (skips the load loop)")
     args = p.parse_args()
+
+    if args.aot:
+        rec = bench_cold_start(args)
+        print(json.dumps(rec), flush=True)
+        if args.record:
+            from scripts._measurements import MEASUREMENTS
+            full = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "phase": "serve_bench", **rec}
+            with open(MEASUREMENTS, "a") as f:
+                f.write(json.dumps(full) + "\n")
+        if rec["compiles_aot"]:
+            print(json.dumps({"error": f"{rec['compiles_aot']} fresh "
+                                       f"compile(s) on the AOT-warm "
+                                       f"restart"}), flush=True)
+            return 1
+        return 0
 
     import numpy as np
 

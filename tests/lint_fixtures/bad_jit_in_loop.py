@@ -1,0 +1,31 @@
+"""JL008 fixtures: jit built inside a loop body (per-pass recompile)."""
+
+import jax
+from flax import nnx
+
+
+def recompile_every_step(batches, model):
+    outs = []
+    for batch in batches:
+        step = jax.jit(lambda m, b: m(b))     # line 10: JL008 jit in loop
+        outs.append(step(model, batch))
+    while outs:
+        fwd = nnx.jit(model.encode_image)     # line 13: JL008 nnx.jit in loop
+        outs.pop()
+
+        @jax.jit                              # line 16: JL008 def in loop
+        def inner(x):
+            return fwd(x)
+    return outs
+
+
+def hoisted_ok(batches, model):
+    step = jax.jit(lambda m, b: m(b))  # fine: built once, reused
+    return [step(model, b) for b in batches]
+
+
+def deliberate(batches):
+    for b in batches:
+        # per-shape specialization, measured and intentional:
+        f = jax.jit(lambda x: x * 2)  # jaxlint: disable=JL008
+        f(b)

@@ -1,0 +1,98 @@
+"""weights.resolve hub robustness: bounded retry, backoff, cache fallback.
+
+A transient network error during an ``aot warmup`` or a train start must
+not kill the run: transient failures retry with exponential backoff, the
+hub's not-found family (sharded-vs-single control flow) never retries,
+and when the network stays down a locally-cached copy is served.
+"""
+
+import pytest
+
+from jimm_tpu.weights.resolve import _hub_download_with_retry, _retryable
+
+
+class EntryNotFoundError(Exception):
+    """Name-matched stand-in for huggingface_hub's (same-name) class."""
+
+
+class FlakyHub:
+    """hf_hub_download double: raises ``fail_times`` transient errors
+    (or a scripted exception) before succeeding; records every call."""
+
+    def __init__(self, fail_times=0, exc=None):
+        self.fail_times = fail_times
+        self.exc = exc
+        self.calls = []
+
+    def __call__(self, repo_id, filename, local_files_only=False):
+        self.calls.append({"filename": filename,
+                           "local_files_only": local_files_only})
+        if local_files_only:
+            raise FileNotFoundError("nothing cached")
+        if self.exc is not None:
+            raise self.exc
+        if len([c for c in self.calls if not c["local_files_only"]]) \
+                <= self.fail_times:
+            raise ConnectionError("reset by peer")
+        return f"/cache/{filename}"
+
+
+class TestHubRetry:
+    def test_transient_error_retries_with_backoff(self):
+        hub = FlakyHub(fail_times=2)
+        slept = []
+        out = _hub_download_with_retry(hub, "org/repo", "model.safetensors",
+                                       retries=3, backoff_s=0.5,
+                                       sleep=slept.append)
+        assert out == "/cache/model.safetensors"
+        assert len(hub.calls) == 3
+        assert slept == [0.5, 1.0]  # exponential: backoff * 2**attempt
+
+    def test_not_found_family_never_retries(self):
+        # EntryNotFoundError is sharded-vs-single control flow — retrying
+        # it would turn every single-file repo probe into dead waiting
+        hub = FlakyHub(exc=EntryNotFoundError("no such file"))
+        slept = []
+        with pytest.raises(EntryNotFoundError):
+            _hub_download_with_retry(hub, "org/repo",
+                                     "model.safetensors.index.json",
+                                     retries=5, backoff_s=1.0,
+                                     sleep=slept.append)
+        assert len(hub.calls) == 1
+        assert slept == []
+        assert not _retryable(EntryNotFoundError("x"))
+        assert _retryable(ConnectionError("x"))
+        assert _retryable(TimeoutError("x"))
+
+    def test_offline_falls_back_to_local_cache(self):
+        class CachedHub(FlakyHub):
+            def __call__(self, repo_id, filename, local_files_only=False):
+                self.calls.append({"local_files_only": local_files_only})
+                if local_files_only:
+                    return f"/cache/{filename}"  # previously downloaded
+                raise ConnectionError("network down")
+
+        hub = CachedHub()
+        out = _hub_download_with_retry(hub, "org/repo", "model.safetensors",
+                                       retries=2, backoff_s=0.0,
+                                       sleep=lambda s: None)
+        assert out == "/cache/model.safetensors"
+        assert [c["local_files_only"] for c in hub.calls] \
+            == [False, False, True]
+
+    def test_offline_and_uncached_raises_the_transient_error(self):
+        hub = FlakyHub(exc=ConnectionError("network down"))
+        with pytest.raises(ConnectionError):  # not the cache-miss error
+            _hub_download_with_retry(hub, "org/repo", "f.bin",
+                                     retries=2, backoff_s=0.0,
+                                     sleep=lambda s: None)
+        assert hub.calls[-1]["local_files_only"] is True
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("JIMM_HUB_RETRIES", "1")
+        hub = FlakyHub(fail_times=1)
+        with pytest.raises(ConnectionError):
+            _hub_download_with_retry(hub, "org/repo", "f.bin",
+                                     backoff_s=0.0, sleep=lambda s: None)
+        # one attempt (env) + the local-cache last resort
+        assert len(hub.calls) == 2

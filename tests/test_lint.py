@@ -116,6 +116,41 @@ class TestRuleFixtures:
         assert check_bare_print(tree, "jimm_tpu/train/metrics.py") != []
         assert check_bare_print(tree, "jimm_tpu/serve/engine.py") != []
 
+    def test_jl008_jit_in_loop(self):
+        findings = findings_for("bad_jit_in_loop.py")
+        assert rules_and_lines(findings) == {
+            ("JL008", 10),  # jax.jit call in for body
+            ("JL008", 13),  # nnx.jit call in while body
+            ("JL008", 17),  # jit-decorated def in loop body
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert any("AOT" in f.message for f in findings)
+        # hoisted_ok (jit once, reuse) and the suppressed site stay clean
+
+    def test_jl008_handler_and_test_scoping(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_jit_in_loop
+        from jimm_tpu.lint.rules_ast import _annotate_parents
+        src = (
+            "import jax\n"
+            "class H:\n"
+            "    def do_GET(self):\n"
+            "        f = jax.jit(lambda x: x)\n"
+            "async def handle(req):\n"
+            "    g = jax.jit(lambda x: x)\n"
+        )
+        tree = ast.parse(src)
+        _annotate_parents(tree)
+        # do_GET fires anywhere; the async def only in serving code
+        lib = check_jit_in_loop(tree, "jimm_tpu/train/loop.py")
+        assert {(f.rule, f.line) for f in lib} == {("JL008", 4)}
+        serve = check_jit_in_loop(tree, "jimm_tpu/serve/server.py")
+        assert {(f.rule, f.line) for f in serve} == {("JL008", 4),
+                                                    ("JL008", 6)}
+        # tests construct jits per-case on purpose
+        assert check_jit_in_loop(tree, "tests/test_serve.py") == []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings
