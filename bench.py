@@ -79,6 +79,10 @@ def parse_args(argv=None, validate: bool = True) -> argparse.Namespace:
     p.add_argument("--moment-dtype", choices=["f32", "bf16"], default=None,
                    help="Adam first-moment dtype (bf16 halves that buffer's "
                         "HBM traffic)")
+    p.add_argument("--tune-cache", default=None,
+                   help="resolve Pallas kernel block sizes from this tuned-"
+                        "config cache (populate with `jimm-tpu tune`); "
+                        "lookup only — misses fall back to safe defaults")
     p.add_argument("--timeout", type=int, default=0,
                    help="per-attempt watchdog for the child (seconds); "
                         "0 = auto: min(420, BENCH_TIMEOUT_S) when the env "
@@ -377,6 +381,12 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
     from jimm_tpu.train.metrics import compiled_flops, train_step_flops
 
     from jimm_tpu.configs import parse_remat
+
+    if args.tune_cache:
+        # before any trace: fused ops resolve block sizes through
+        # tune.best_config at trace time (lookup only, never a measurement)
+        from jimm_tpu.tune import configure as tune_configure
+        tune_configure(args.tune_cache)
 
     on_tpu = jax.default_backend() == "tpu"
     adopted_defaults = resolve_adopted_defaults(args, on_tpu)

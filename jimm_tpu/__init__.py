@@ -5,7 +5,46 @@ with scanned layer stacks, logical-axis sharding policies over `jax.sharding`
 meshes, pure-safetensors HuggingFace checkpoint loading (zero torch), Pallas
 flash attention, and distributed contrastive training with a ring sigmoid
 loss.
+
+The package namespace is lazy (PEP 562): importing ``jimm_tpu`` (or a pure
+host subpackage like ``jimm_tpu.aot``/``jimm_tpu.tune``/``jimm_tpu.obs``)
+does NOT import jax. The model/config names below resolve on first access,
+which is when the version floor is checked and the flax compat backfills
+(`jimm_tpu.utils.compat`) load — so ``jimm-tpu tune ls``/``aot ls``/``obs``
+stay usable on a box with no accelerator stack.
 """
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+__version__ = "0.1.0"
+
+#: lazily resolved public names -> defining module
+_LAZY = {
+    "CLIP": "jimm_tpu.models",
+    "SigLIP": "jimm_tpu.models",
+    "VisionTransformer": "jimm_tpu.models",
+    "CLIPConfig": "jimm_tpu.configs",
+    "SigLIPConfig": "jimm_tpu.configs",
+    "ViTConfig": "jimm_tpu.configs",
+    "VisionConfig": "jimm_tpu.configs",
+    "TextConfig": "jimm_tpu.configs",
+    "TransformerConfig": "jimm_tpu.configs",
+    "PRESETS": "jimm_tpu.configs",
+    "preset": "jimm_tpu.configs",
+    "RUNTIME_FIELDS": "jimm_tpu.configs",
+    "with_runtime": "jimm_tpu.configs",
+}
+
+__all__ = [
+    "CLIP", "SigLIP", "VisionTransformer",
+    "CLIPConfig", "SigLIPConfig", "ViTConfig", "VisionConfig", "TextConfig",
+    "TransformerConfig", "PRESETS", "preset",
+    "RUNTIME_FIELDS", "with_runtime",
+]
+
 
 def _check_versions() -> None:
     """Fail fast with a clear message on JAX/flax older than the tested
@@ -33,23 +72,32 @@ def _check_versions() -> None:
                 f"(TPU: `pip install -U 'jax[tpu]'`).")
 
 
-_check_versions()
+_ready = False
 
-# imported for its side effects too: backfills nnx module/class attributes
-# (to_flat_state, Variable.set_value, ...) that flax 0.10 lacks, before any
-# model/weights code touches them
-import jimm_tpu.utils.compat  # noqa: E402,F401  isort: skip
 
-from jimm_tpu.configs import (CLIPConfig, SigLIPConfig, TextConfig,
-                              TransformerConfig, ViTConfig, VisionConfig,
-                              PRESETS, RUNTIME_FIELDS, preset, with_runtime)
-from jimm_tpu.models import CLIP, SigLIP, VisionTransformer
+def _prepare() -> None:
+    """Version floor + compat backfills, once, before any model/config
+    attribute resolves. `jimm_tpu.utils.compat` is imported for its side
+    effects: it backfills nnx module/class attributes (to_flat_state,
+    Variable.set_value, ...) that flax 0.10 lacks. Modules that use those
+    backfills also import it directly, so reaching them through a plain
+    submodule import (bypassing this hook) stays safe."""
+    global _ready
+    if not _ready:
+        _check_versions()
+        importlib.import_module("jimm_tpu.utils.compat")
+        _ready = True
 
-__version__ = "0.1.0"
 
-__all__ = [
-    "CLIP", "SigLIP", "VisionTransformer",
-    "CLIPConfig", "SigLIPConfig", "ViTConfig", "VisionConfig", "TextConfig",
-    "TransformerConfig", "PRESETS", "preset",
-    "RUNTIME_FIELDS", "with_runtime",
-]
+def __getattr__(name: str) -> Any:
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(f"module 'jimm_tpu' has no attribute {name!r}")
+    _prepare()
+    value = getattr(importlib.import_module(module), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__() -> list[str]:
+    return sorted(set(globals()) | set(_LAZY))

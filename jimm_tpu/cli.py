@@ -1271,6 +1271,13 @@ def cmd_serve(args: argparse.Namespace) -> int:
                                 ServingServer, ZeroShotService,
                                 counting_forward, default_buckets)
 
+    if args.tune_cache:
+        # point kernel block resolution at an offline-tuned cache BEFORE any
+        # trace: ops consult tune.best_config at trace time (lookup only —
+        # serving never measures; populate with `jimm-tpu tune`)
+        from jimm_tpu.tune import configure as tune_configure
+        tune_configure(args.tune_cache)
+
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
     if args.ckpt:
         fam = args.model or (_family(args.preset) if args.preset else None)
@@ -1633,6 +1640,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="consult this AOT artifact store before any "
                          "fresh compile (populate with `jimm-tpu aot "
                          "warmup`); misses are written through")
+    sp.add_argument("--tune-cache", default=None,
+                    help="resolve Pallas kernel block sizes from this "
+                         "tuned-config cache (populate with `jimm-tpu "
+                         "tune`); lookup only — misses fall back to safe "
+                         "defaults, serving never measures")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_serve)
 
@@ -1652,6 +1664,10 @@ def build_parser() -> argparse.ArgumentParser:
     # jimm-tpu aot {warmup,ls,gc,verify} — AOT compile-artifact store
     from jimm_tpu.aot.cli import add_aot_parser
     add_aot_parser(sub)
+
+    # jimm-tpu tune {run,ls} — persistent Pallas kernel autotuner
+    from jimm_tpu.tune.cli import add_tune_parser
+    add_tune_parser(sub)
 
     return p
 

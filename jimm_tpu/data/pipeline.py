@@ -34,7 +34,10 @@ class PrefetchIterator:
         elif mesh is not None:
             self._place = lambda b: shard_batch(b, mesh, rules)
         else:
-            self._place = lambda b: jax.tree.map(jax.device_put, b)
+            # one device_put over the whole pytree batches the H2D copies
+            # into a single transfer program (per-leaf tree.map issued one
+            # dispatch per array and serialized the copies)
+            self._place = jax.device_put
         self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
         self._stop = threading.Event()
         self._done = False

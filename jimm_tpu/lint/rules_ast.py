@@ -28,6 +28,13 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   fresh jit wrapper with an empty compile cache, so the work recompiles
   per iteration/request and defeats both bucket warmup and the AOT
   artifact store. Hoist the jit to module/init scope; tests are exempt.
+- **JL009** hardcoded Pallas block-size literal (``block_q=128`` /
+  ``block_k=...`` / ``block_rows=...``) at a call site outside
+  ``jimm_tpu/ops/`` and ``jimm_tpu/tune/`` — a pinned int overrides the
+  persistent autotuner (``jimm_tpu.tune.best_config``) for every shape and
+  backend; leave the kwarg off (or pass ``None``) so tuned configs apply,
+  or tune offline with ``jimm-tpu tune``. Tests are exempt; deliberate
+  pins carry a ``# jaxlint: disable=JL009`` justification.
 """
 
 from __future__ import annotations
@@ -640,6 +647,57 @@ def check_jit_in_loop(tree: ast.AST, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL009 — hardcoded block-size literal bypasses the autotuner
+# ---------------------------------------------------------------------------
+
+#: kernel block kwargs the tune cache owns (``jimm_tpu.tune.api.KERNELS``)
+TUNABLE_BLOCK_KWARGS = frozenset({"block_q", "block_k", "block_rows"})
+
+#: package directories where explicit int blocks are the mechanism itself:
+#: ops modules define the safe defaults, and the tuner's bench closures MUST
+#: pass explicit ints (that is the no-recursion contract with best_config)
+_BLOCK_LITERAL_EXEMPT_DIRS = frozenset({"ops", "tune"})
+
+
+def _path_is_block_exempt(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    if "jimm_tpu" in parts[:-1]:
+        rel = parts[parts.index("jimm_tpu") + 1:-1]
+        if _BLOCK_LITERAL_EXEMPT_DIRS & set(rel):
+            return True
+    return _path_is_test(path)
+
+
+def check_block_size_literal(tree: ast.AST, path: str) -> list[Finding]:
+    """JL009: a literal ``block_q=128``-style kwarg at a call site pins one
+    block size for every shape, dtype, and TPU generation, silently masking
+    whatever ``jimm_tpu.tune`` has measured as best. Call sites should omit
+    the kwarg (ops resolve it through ``tune.best_config`` with a safe
+    default); genuinely deliberate pins take a
+    ``# jaxlint: disable=JL009`` with a reason."""
+    if _path_is_block_exempt(path):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg not in TUNABLE_BLOCK_KWARGS:
+                continue
+            if isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, int) \
+                    and not isinstance(kw.value.value, bool):
+                findings.append(Finding(
+                    "JL009", ERROR, path, kw.value.lineno,
+                    f"hardcoded {kw.arg}={kw.value.value} bypasses the "
+                    f"persistent autotuner for every shape/backend — omit "
+                    f"the kwarg so jimm_tpu.tune.best_config resolves it "
+                    f"(tune offline with `jimm-tpu tune`), or justify the "
+                    f"pin with # jaxlint: disable=JL009"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -653,4 +711,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_async_host_sync(tree, path)
     findings += check_bare_print(tree, path)
     findings += check_jit_in_loop(tree, path)
+    findings += check_block_size_literal(tree, path)
     return findings

@@ -29,6 +29,8 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from flax import nnx
+
+import jimm_tpu.utils.compat  # noqa: F401  (nnx backfills: to_flat_state, set_value)
 from jax.ad_checkpoint import checkpoint_name
 
 from jimm_tpu.configs import TransformerConfig

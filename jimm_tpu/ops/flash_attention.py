@@ -464,7 +464,10 @@ def _pick_block(seq: int, requested: int) -> int:
     """Largest block (<= requested) that minimizes padded-sequence length:
     dead-tile work grows with ceil_to(seq, block)^2, so e.g. seq 577 takes
     block 128 (pad to 640) over 512 (pad to 1024), while exact multiples
-    keep the biggest tile."""
+    keep the biggest tile. Always a multiple of 128: the (hb, 1, block)
+    lse/delta blocks put the block extent in the LANE dimension, where
+    Mosaic requires a 128 multiple — a sub-128 request would lower on some
+    toolchains only by luck of the block==array escape hatch."""
     best = None
     for b in (512, 256, 128):
         if b > requested:
@@ -472,13 +475,31 @@ def _pick_block(seq: int, requested: int) -> int:
         padded = _ceil_to(seq, b)
         if best is None or padded < best[0]:
             best = (padded, b)
-    return best[1] if best else min(requested, _ceil_to(seq, 128))
+    return best[1] if best else _LANES
+
+
+def _resolve_blocks(q, k, v, block_q, block_k):
+    """Trace-time (host-side) block resolution through the tune cache:
+    ``None`` means "tuned value if the persistent cache has one for these
+    shapes/dtypes, else the shipped default" — lookup only, never a
+    measurement (docs/tuning.md). Explicit ints win, so the tuner's own
+    bench closures cannot recurse."""
+    if block_q is not None and block_k is not None:
+        return int(block_q), int(block_k)
+    from jimm_tpu.tune import best_config
+    cfg = best_config("flash_attention", (q.shape, k.shape, v.shape),
+                      (q.dtype, k.dtype, v.dtype),
+                      default={"block_q": DEFAULT_BLOCK_Q,
+                               "block_k": DEFAULT_BLOCK_K})
+    return (int(block_q if block_q is not None else cfg["block_q"]),
+            int(block_k if block_k is not None else cfg["block_k"]))
 
 
 def _prologue(q, k, v, block_q, block_k):
     """Shared head-flattening + scale/block selection for both entry points."""
     d = q.shape[-1]
     sm_scale = 1.0 / (d ** 0.5)
+    block_q, block_k = _resolve_blocks(q, k, v, block_q, block_k)
     block_q = min(_pick_block(q.shape[1], block_q),
                   _ceil_to(q.shape[1], 128))
     block_k = min(_pick_block(k.shape[1], block_k),
@@ -489,11 +510,12 @@ def _prologue(q, k, v, block_q, block_k):
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     is_causal: bool = False,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+                    block_q: int | None = None,
+                    block_k: int | None = None) -> jax.Array:
     """Flash attention over ``(B, S, N, D)`` q/k/v. Scale is 1/sqrt(D) like
     `jax.nn.dot_product_attention`. Runs the Pallas interpreter off-TPU so
-    CPU tests exercise the same code path."""
+    CPU tests exercise the same code path. Block sizes default to the tune
+    cache's answer for these shapes (falling back to ``DEFAULT_BLOCK_*``)."""
     b, _, n, _ = q.shape
     q3, k3, v3, sm_scale, block_q, block_k = _prologue(q, k, v, block_q,
                                                        block_k)
@@ -530,8 +552,8 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         is_causal: bool = False,
-                        block_q: int = DEFAULT_BLOCK_Q,
-                        block_k: int = DEFAULT_BLOCK_K
+                        block_q: int | None = None,
+                        block_k: int | None = None
                         ) -> tuple[jax.Array, jax.Array]:
     """Like `flash_attention` but also returns the per-row logsumexp
     ``(B, N, S)`` so partial results over kv chunks can be merged exactly

@@ -1,0 +1,36 @@
+"""jimm_tpu.tune — persistent Pallas kernel autotuner.
+
+Block sizes for the fused kernels (`ops/flash_attention.py`,
+`ops/layer_norm.py`) are shape- and hardware-dependent: FlashAttention
+(arXiv:2205.14135) reports large margins between tuned and fixed tiles.
+This package measures candidate configs **offline** (``jimm-tpu tune``)
+and persists the winner in a fingerprint-keyed store built on the AOT
+machinery, so the hot path only ever does a lookup::
+
+    from jimm_tpu import tune
+
+    cfg = tune.best_config("flash_attention", shapes, dtypes,
+                           default={"block_q": 512, "block_k": 512})
+
+`best_config` NEVER measures unless ``JIMM_TUNE=1`` is set: a miss falls
+back to the kernel's safe default and counts
+``jimm_tune_{miss,fallback}_total``. Tuning cost is paid once per
+(kernel, shapes, dtypes, backend, jax version) and amortized across train
+restarts and serve replicas, exactly like the AOT compile-artifact store.
+
+The package imports jax lazily: ``jimm-tpu tune ls`` and the feasibility
+pruning in `space.py` run on a box with no accelerator.
+"""
+
+from jimm_tpu.tune.api import (KERNELS, best_config, configure, get_cache,
+                               tune_kernel)
+from jimm_tpu.tune.cache import (TUNE_FORMAT_VERSION, TuneCache, TuneKey,
+                                 tune_key)
+from jimm_tpu.tune.measure import measure, trimmed_median
+from jimm_tpu.tune.space import kernel_space
+
+__all__ = [
+    "KERNELS", "TUNE_FORMAT_VERSION", "TuneCache", "TuneKey", "best_config",
+    "configure", "get_cache", "kernel_space", "measure", "trimmed_median",
+    "tune_key", "tune_kernel",
+]

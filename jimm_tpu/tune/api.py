@@ -1,0 +1,198 @@
+"""`best_config` lookup + the offline tuning driver.
+
+The contract the ops hot path relies on:
+
+- **Never tune in the hot path.** `best_config` is called at trace time
+  from `ops/flash_attention.py` / `ops/layer_norm.py`; it does a memo/store
+  lookup and otherwise returns the kernel's safe default. Measurement only
+  happens when the operator opted in — ``JIMM_TUNE=1`` in the environment,
+  or an explicit offline ``jimm-tpu tune run`` / `tune_kernel` call.
+- **Every outcome is counted**: ``jimm_tune_hit_total`` /
+  ``jimm_tune_miss_total`` / ``jimm_tune_fallback_total`` (observability.md
+  lists the series), so a fleet silently running on fallback defaults shows
+  up on the first metrics dump.
+
+The process-wide cache defaults to ``JIMM_TUNE_CACHE`` or
+``~/.cache/jimm_tpu/tune``; ``serve --tune-cache`` / ``bench.py
+--tune-cache`` repoint it via `configure`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Mapping, Sequence
+
+from jimm_tpu import obs
+from jimm_tpu.tune.cache import TuneCache, TuneKey, tune_key
+from jimm_tpu.tune.measure import measure
+from jimm_tpu.tune.space import flash_space, ln_space
+
+__all__ = ["KERNELS", "KernelSpec", "best_config", "configure", "get_cache",
+           "tune_kernel"]
+
+Shapes = Sequence[Sequence[int]]
+Dtypes = Sequence[Any]
+
+
+# ---------------------------------------------------------------------------
+# kernel registry
+# ---------------------------------------------------------------------------
+
+def _flash_default(shapes: Shapes, dtypes: Dtypes) -> dict:
+    from jimm_tpu.ops.flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
+    return {"block_q": DEFAULT_BLOCK_Q, "block_k": DEFAULT_BLOCK_K}
+
+
+def _flash_bench(shapes: Shapes, dtypes: Dtypes,
+                 config: Mapping[str, int]) -> Callable[[], Any]:
+    """Timed closure: flash fwd+bwd at the candidate blocks (training is the
+    sweep's consumer; a fwd-only winner that loses the backward would be a
+    false economy). Explicit block kwargs bypass the tuner — no recursion."""
+    import jax
+    import jax.numpy as jnp
+
+    from jimm_tpu.ops.flash_attention import flash_attention
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    dt = jnp.dtype(dtypes[0]) if dtypes else jnp.float32
+    q = jax.random.normal(kq, tuple(shapes[0]), dt)
+    k = jax.random.normal(kk, tuple(shapes[1]), dt)
+    v = jax.random.normal(kv, tuple(shapes[2]), dt)
+    bq, bk = int(config["block_q"]), int(config["block_k"])
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, block_q=bq, block_k=bk)
+        return jnp.sum(o.astype(jnp.float32))
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return lambda: step(q, k, v)
+
+
+def _ln_default(shapes: Shapes, dtypes: Dtypes) -> dict:
+    from jimm_tpu.ops.layer_norm import DEFAULT_BLOCK_ROWS
+    return {"block_rows": DEFAULT_BLOCK_ROWS}
+
+
+def _ln_bench(shapes: Shapes, dtypes: Dtypes,
+              config: Mapping[str, int]) -> Callable[[], Any]:
+    """Timed closure: fused LN fwd+bwd (the backward is the kernel's whole
+    reason to exist — see docs/performance.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    from jimm_tpu.ops.layer_norm import layer_norm
+    rows, feat = (int(d) for d in shapes[0][-2:])
+    dt = jnp.dtype(dtypes[0]) if dtypes else jnp.float32
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, feat), dt)
+    scale = jnp.ones((feat,), jnp.float32)
+    bias = jnp.zeros((feat,), jnp.float32)
+    br = int(config["block_rows"])
+
+    def loss(x, scale, bias):
+        o = layer_norm(x, scale, bias, 1e-6, br)
+        return jnp.sum(o.astype(jnp.float32))
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return lambda: step(x, scale, bias)
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSpec:
+    """One tunable kernel: identity, search space, fallback, and bench."""
+
+    version: int  # bump with the kernel implementation — stale configs miss
+    space: Callable[[Shapes, Dtypes], list[dict]]
+    default: Callable[[Shapes, Dtypes], dict]
+    bench: Callable[[Shapes, Dtypes, Mapping[str, int]], Callable[[], Any]]
+
+
+KERNELS: dict[str, KernelSpec] = {
+    "flash_attention": KernelSpec(version=1, space=flash_space,
+                                  default=_flash_default,
+                                  bench=_flash_bench),
+    "layer_norm": KernelSpec(version=1, space=ln_space,
+                             default=_ln_default, bench=_ln_bench),
+}
+
+
+# ---------------------------------------------------------------------------
+# process-wide cache
+# ---------------------------------------------------------------------------
+
+_cache: TuneCache | None = None
+
+
+def get_cache() -> TuneCache:
+    global _cache
+    if _cache is None:
+        _cache = TuneCache()
+    return _cache
+
+
+def configure(root: str | os.PathLike | None) -> TuneCache:
+    """Point the process-wide tune cache at ``root`` (``serve --tune-cache``
+    and ``bench.py --tune-cache`` call this before any kernel traces)."""
+    global _cache
+    _cache = TuneCache(root)
+    return _cache
+
+
+# ---------------------------------------------------------------------------
+# lookup (hot path) and tuning (offline)
+# ---------------------------------------------------------------------------
+
+def _key_for(kernel: str, shapes: Shapes, dtypes: Dtypes) -> TuneKey:
+    spec = KERNELS[kernel]
+    return tune_key(kernel, shapes=shapes, dtypes=dtypes,
+                    kernel_version=spec.version)
+
+
+def best_config(kernel: str, shapes: Shapes, dtypes: Dtypes, *,
+                default: Mapping[str, int] | None = None,
+                cache: TuneCache | None = None) -> dict:
+    """The tuned config for ``kernel`` at these shapes, else a safe default.
+
+    Lookup-only unless ``JIMM_TUNE=1``: called host-side at trace time, so
+    a cold cache costs one file probe per newly traced shape and a warm one
+    costs a dict probe.
+    """
+    spec = KERNELS[kernel]
+    key = _key_for(kernel, shapes, dtypes)
+    cache = cache or get_cache()
+    registry = obs.get_registry("jimm_tune")
+    record = cache.get(key)
+    if record is not None:
+        registry.counter("hit_total").inc()
+        return dict(record["config"])
+    registry.counter("miss_total").inc()
+    if os.environ.get("JIMM_TUNE") == "1":
+        return dict(tune_kernel(kernel, shapes, dtypes,
+                                cache=cache)["config"])
+    registry.counter("fallback_total").inc()
+    return dict(default) if default is not None else spec.default(shapes,
+                                                                  dtypes)
+
+
+def tune_kernel(kernel: str, shapes: Shapes, dtypes: Dtypes, *,
+                cache: TuneCache | None = None, reps: int | None = None,
+                candidates: Sequence[Mapping[str, int]] | None = None
+                ) -> dict:
+    """Measure every feasible candidate, persist the winner, return
+    ``{"config", "time_s", "candidates", "fingerprint", "trials"}``."""
+    spec = KERNELS[kernel]
+    key = _key_for(kernel, shapes, dtypes)
+    cache = cache or get_cache()
+    cands = list(candidates) if candidates is not None \
+        else spec.space(shapes, dtypes)
+    trials = []
+    for config in cands:
+        fn = spec.bench(shapes, dtypes, config)
+        trials.append({"config": dict(config),
+                       "time_s": measure(fn, reps=reps)})
+    best = min(trials, key=lambda t: t["time_s"])
+    fingerprint = cache.put(key, best["config"],
+                            metrics={"time_s": best["time_s"],
+                                     "trials": trials})
+    return {"config": dict(best["config"]), "time_s": best["time_s"],
+            "candidates": len(trials), "fingerprint": fingerprint,
+            "trials": trials}

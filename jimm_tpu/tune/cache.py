@@ -1,0 +1,164 @@
+"""Persistent, fingerprint-keyed store of autotuning results.
+
+A tuned block config is reusable only when everything that shaped the
+measurement matches: the kernel (name + implementation version), the
+operand shapes and dtypes, the backend the timing ran on, and the jax
+version that lowered the kernel. All of it folds into one SHA-256
+fingerprint over canonical JSON — the same byte-stable discipline as
+`jimm_tpu/aot/keys.py`, whose `canonical_json` this module reuses — and
+the record lands in a `jimm_tpu.aot.store.ArtifactStore` (atomic writes,
+per-read integrity hash, quarantine on corruption, LRU gc) holding a small
+JSON payload instead of a serialized executable.
+
+No jax import at module level: ``jimm-tpu tune ls`` stays a pure host
+tool, and `tune_key` only touches jax to *default* the backend/version
+fields when they are not passed explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Any, Mapping, Sequence
+
+from jimm_tpu.aot.keys import canonical_json
+from jimm_tpu.aot.store import ArtifactStore
+
+__all__ = ["TUNE_FORMAT_VERSION", "TuneCache", "TuneKey", "default_root",
+           "tune_key"]
+
+#: bump when the record payload layout changes — old entries then read as
+#: misses (different fingerprint) instead of deserializing garbage
+TUNE_FORMAT_VERSION = 1
+
+#: override with JIMM_TUNE_CACHE, `tune.configure(root)`, or the CLI --store
+DEFAULT_CACHE_ROOT = "~/.cache/jimm_tpu/tune"
+
+
+def default_root() -> str:
+    return os.environ.get("JIMM_TUNE_CACHE", DEFAULT_CACHE_ROOT)
+
+
+def _dtype_name(d: Any) -> str:
+    """Canonical dtype string without importing jax: accepts 'bfloat16',
+    np.float32, jnp.bfloat16, or any dtype-like with a ``.name``."""
+    if isinstance(d, str):
+        return d
+    name = getattr(d, "name", None)
+    if isinstance(name, str):
+        return name
+    import numpy as np
+    return str(np.dtype(d).name)
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneKey:
+    """Every field that must match for a tuned config to be reusable."""
+
+    kernel: str
+    kernel_version: int
+    shapes: tuple[tuple[int, ...], ...]
+    dtypes: tuple[str, ...]
+    backend: str
+    jax_version: str
+    format_version: int = TUNE_FORMAT_VERSION
+
+    def fingerprint(self) -> str:
+        """Hex SHA-256 over the canonical JSON of all fields — byte-stable
+        across processes (`tests/test_tune.py` pins cross-process
+        stability the same way the AOT keys are golden-tested)."""
+        return hashlib.sha256(
+            canonical_json(dataclasses.asdict(self)).encode()).hexdigest()
+
+    def describe(self) -> dict:
+        """Human-facing metadata recorded in the store entry."""
+        return {"kernel": self.kernel,
+                "kernel_version": self.kernel_version,
+                "shapes": [list(s) for s in self.shapes],
+                "dtypes": list(self.dtypes),
+                "backend": self.backend,
+                "jax": self.jax_version}
+
+
+def tune_key(kernel: str, *, shapes: Sequence[Sequence[int]],
+             dtypes: Sequence[Any], kernel_version: int,
+             backend: str | None = None,
+             jax_version: str | None = None) -> TuneKey:
+    """Build the key for one (kernel, shapes, dtypes) tuning point.
+
+    Backend/version default from the running jax, but accept explicit
+    values so keys can be computed (and tested) without a backend.
+    """
+    if backend is None or jax_version is None:
+        import jax
+        backend = backend or jax.default_backend()
+        jax_version = jax_version or jax.__version__
+    return TuneKey(
+        kernel=str(kernel),
+        kernel_version=int(kernel_version),
+        shapes=tuple(tuple(int(d) for d in s) for s in shapes),
+        dtypes=tuple(_dtype_name(d) for d in dtypes),
+        backend=str(backend),
+        jax_version=str(jax_version),
+    )
+
+
+class TuneCache:
+    """Tuned-config records on top of an `ArtifactStore`.
+
+    Hits are memoized in-process so the trace-time `best_config` lookup in
+    the ops hot path costs one dict probe after the first resolution of a
+    shape. Misses are NOT memoized: an offline ``jimm-tpu tune`` run (or
+    another replica) may populate the store between traces, and the next
+    lookup should see it.
+    """
+
+    def __init__(self, root: str | os.PathLike | None = None,
+                 max_bytes: int | None = None):
+        self.store = ArtifactStore(Path(root or default_root()).expanduser(),
+                                   max_bytes=max_bytes)
+        self._memo: dict[str, dict] = {}
+
+    @property
+    def root(self) -> Path:
+        return self.store.root
+
+    def get(self, key: TuneKey) -> dict | None:
+        """The stored record ``{"config": ..., "metrics": ...}`` or None."""
+        fp = key.fingerprint()
+        rec = self._memo.get(fp)
+        if rec is not None:
+            return rec
+        payload = self.store.get(fp)
+        if payload is None:
+            return None
+        try:
+            rec = json.loads(payload.decode())
+        except (UnicodeDecodeError, ValueError):
+            self.store.quarantine(fp, "undecodable tune record")
+            return None
+        if not isinstance(rec, dict) or not isinstance(rec.get("config"),
+                                                       dict):
+            self.store.quarantine(fp, "tune record missing config mapping")
+            return None
+        self._memo[fp] = rec
+        return rec
+
+    def put(self, key: TuneKey, config: Mapping[str, Any],
+            metrics: Mapping[str, Any] | None = None) -> str:
+        """Persist the winning ``config`` (plus measurement provenance);
+        returns the fingerprint."""
+        fp = key.fingerprint()
+        rec = {"config": dict(config), "metrics": dict(metrics or {}),
+               "key": key.describe()}
+        self.store.put(fp, canonical_json(rec).encode(),
+                       meta={"label": f"tune:{key.kernel}",
+                             **key.describe()})
+        self._memo[fp] = rec
+        return fp
+
+    def entries(self):
+        return self.store.entries()

@@ -1,0 +1,161 @@
+"""``jimm-tpu tune`` — sweep kernel block configs offline, inspect results.
+
+Two verbs:
+
+- ``run`` — measure every feasible candidate for a kernel at given shapes
+  (explicit ``--kernel``/``--shapes``, or derived from a ``--preset`` +
+  ``--batch-size``) and persist the winners; the next train/serve/bench
+  process gets pure cache hits.
+- ``ls``  — list tuned entries (kernel, shapes, config, timing) without
+  importing jax (pure host tool, same rule as ``jimm-tpu aot ls``).
+
+Wired as a subparser under the main ``jimm-tpu`` CLI (see jimm_tpu/cli.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from jimm_tpu.tune.cache import TuneCache, default_root
+
+__all__ = ["add_tune_parser", "cmd_tune"]
+
+
+def _parse_shapes(text: str) -> list[tuple[int, ...]]:
+    """``"8x256x12x64,8x256x12x64"`` -> [(8, 256, 12, 64), (8, 256, 12, 64)]."""
+    shapes = []
+    for part in text.split(","):
+        dims = tuple(int(d) for d in part.strip().split("x"))
+        if not dims:
+            raise ValueError(f"empty shape in {text!r}")
+        shapes.append(dims)
+    return shapes
+
+
+def _preset_points(preset_name: str, batch_size: int,
+                   dtype: str) -> list[dict]:
+    """The (kernel, shapes, dtypes) tuning points one preset's vision tower
+    exercises: flash attention at (B, S, N, D) and LN at (B*S, width)."""
+    from jimm_tpu import preset
+    cfg = preset(preset_name)
+    v = cfg.vision
+    s, n, w = v.seq_len, v.num_heads, v.width
+    d = w // n
+    qkv = (batch_size, s, n, d)
+    # one dtype PER OPERAND — the ops hot path keys on
+    # (q.dtype, k.dtype, v.dtype), so a single-entry list would fingerprint
+    # to a key best_config never looks up
+    return [
+        {"kernel": "flash_attention", "shapes": [qkv, qkv, qkv],
+         "dtypes": [dtype] * 3},
+        {"kernel": "layer_norm", "shapes": [(batch_size * s, w)],
+         "dtypes": [dtype]},
+    ]
+
+
+def _cmd_run(args) -> int:
+    from jimm_tpu.tune.api import tune_kernel
+    if args.preset:
+        points = _preset_points(args.preset, args.batch_size, args.dtype)
+        if args.kernel:
+            points = [p for p in points if p["kernel"] == args.kernel]
+    else:
+        if not (args.kernel and args.shapes):
+            raise SystemExit("tune run needs --preset or "
+                             "--kernel + --shapes")
+        shapes = _parse_shapes(args.shapes)
+        points = [{"kernel": args.kernel, "shapes": shapes,
+                   "dtypes": [args.dtype] * len(shapes)}]
+    cache = TuneCache(args.store)
+    report = []
+    for point in points:
+        result = tune_kernel(point["kernel"], point["shapes"],
+                             point["dtypes"], cache=cache, reps=args.reps)
+        report.append({"kernel": point["kernel"],
+                       "shapes": point["shapes"],
+                       "dtypes": point["dtypes"],
+                       "config": result["config"],
+                       "time_s": result["time_s"],
+                       "candidates": result["candidates"],
+                       "fingerprint": result["fingerprint"][:16]})
+    print(json.dumps({"store": str(cache.root), "tuned": report}, indent=2))
+    return 0
+
+
+def _cmd_ls(args) -> int:
+    cache = TuneCache(args.store)
+    rows = []
+    for e in cache.entries():
+        rows.append({"fingerprint": e.fingerprint,
+                     "kernel": e.meta.get("kernel"),
+                     "shapes": e.meta.get("shapes"),
+                     "dtypes": e.meta.get("dtypes"),
+                     "backend": e.meta.get("backend"),
+                     "jax": e.meta.get("jax"),
+                     "last_used": e.last_used})
+    if args.json:
+        print(json.dumps(rows, indent=2, sort_keys=True))
+        return 0
+    if not rows:
+        print(f"(empty tune cache: {cache.root})")
+        return 0
+    for r in sorted(rows, key=lambda r: r["last_used"], reverse=True):
+        shapes = ",".join("x".join(str(d) for d in s)
+                          for s in (r["shapes"] or []))
+        print(f"{r['fingerprint'][:16]}  {r['kernel'] or '?':<16}  "
+              f"{shapes:<28}  {','.join(r['dtypes'] or [])}  "
+              f"backend={r['backend'] or '?'}")
+    print(f"total: {len(rows)} entries")
+    return 0
+
+
+def add_tune_parser(subparsers) -> None:
+    """Attach the ``tune`` subcommand tree to the main CLI's subparsers."""
+    p = subparsers.add_parser(
+        "tune", help="autotune Pallas kernel block sizes into a "
+                     "persistent cache")
+    p.set_defaults(fn=cmd_tune)
+    sub = p.add_subparsers(dest="tune_cmd", required=True)
+
+    pr = sub.add_parser("run", help="sweep candidates and persist winners")
+    pr.add_argument("--store", default=default_root(),
+                    help="tune cache root (default: JIMM_TUNE_CACHE or "
+                         "~/.cache/jimm_tpu/tune)")
+    pr.add_argument("--preset", default=None,
+                    help="derive tuning points from a preset's vision tower")
+    pr.add_argument("--batch-size", type=int, default=8)
+    pr.add_argument("--kernel", default=None,
+                    choices=["flash_attention", "layer_norm"],
+                    help="restrict to one kernel (with --preset) or name "
+                         "the kernel for explicit --shapes")
+    pr.add_argument("--shapes", default=None,
+                    help="comma-separated operand shapes, dims joined with "
+                         "'x', e.g. 8x256x12x64,8x256x12x64,8x256x12x64")
+    pr.add_argument("--dtype", default="float32",
+                    help="operand dtype (default float32)")
+    pr.add_argument("--reps", type=int, default=None,
+                    help="timed reps per candidate (default: 7 on TPU, "
+                         "1 off-TPU)")
+    pr.set_defaults(tune_func=_cmd_run)
+
+    pl = sub.add_parser("ls", help="list tuned entries (no jax import)")
+    pl.add_argument("--store", default=default_root())
+    pl.add_argument("--json", action="store_true")
+    pl.set_defaults(tune_func=_cmd_ls)
+
+
+def cmd_tune(args) -> int:
+    return args.tune_func(args)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="jimm-tpu-tune")
+    sub = parser.add_subparsers(dest="command", required=True)
+    add_tune_parser(sub)
+    args = parser.parse_args(argv)
+    return cmd_tune(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

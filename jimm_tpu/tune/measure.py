@@ -1,0 +1,70 @@
+"""Candidate timing that measures the kernel, not the compiler or the
+dispatch queue.
+
+Three classic autotuning mistakes are designed out:
+
+- **Compile time in the sample**: the first (warmup) call traces, lowers,
+  and compiles; it is waited on and discarded.
+- **Async dispatch**: jax returns before the device finishes, so every
+  timed rep wraps the call in ``jax.block_until_ready``.
+- **Scheduling noise**: the reported figure is the trimmed median of k
+  reps (min/max dropped once there are enough samples), not a single
+  best-of run.
+
+Off-TPU the kernels run in the Pallas interpreter, where timings are
+meaningless but the *path* is identical — so reps short-circuit to 1 and
+tier-1 CPU tests (and `scripts/tune_smoke.py`) exercise the full
+measure → persist → lookup cycle.
+
+Every measurement increments ``jimm_tune_measure_total`` and runs under a
+``tune_measure`` span: the CI smoke asserts a warm cache re-run keeps the
+counter at zero.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Sequence
+
+from jimm_tpu import obs
+
+__all__ = ["measure", "trimmed_median"]
+
+
+def trimmed_median(samples: Sequence[float]) -> float:
+    """Median after dropping the min and max (when >= 5 samples)."""
+    xs = sorted(samples)
+    if not xs:
+        raise ValueError("no samples")
+    if len(xs) >= 5:
+        xs = xs[1:-1]
+    mid = len(xs) // 2
+    if len(xs) % 2:
+        return xs[mid]
+    return 0.5 * (xs[mid - 1] + xs[mid])
+
+
+def measure(fn: Callable[[], object], *, reps: int | None = None,
+            warmup: int = 1) -> float:
+    """Trimmed-median wall-clock seconds of ``fn()`` (see module docstring).
+
+    ``fn`` should return the computation's output (a jax array or pytree)
+    so ``block_until_ready`` has something to wait on.
+    """
+    import jax
+
+    if reps is None:
+        # interpret-mode short-circuit: off-TPU the number is not a kernel
+        # timing, one rep keeps the full path testable without the cost
+        reps = 7 if jax.default_backend() == "tpu" else 1
+    registry = obs.get_registry("jimm_tune")
+    with obs.span("tune_measure"):
+        for _ in range(max(1, warmup)):
+            jax.block_until_ready(fn())
+        samples = []
+        for _ in range(max(1, reps)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            samples.append(time.perf_counter() - t0)
+    registry.counter("measure_total").inc()
+    return trimmed_median(samples)

@@ -1,0 +1,101 @@
+"""Per-kernel block-size search spaces with static feasibility pruning.
+
+Candidates that cannot lower (tile-alignment) or cannot fit (VMEM) are
+pruned *before* anything is measured, so a sweep never wastes reps on a
+config Mosaic would reject. The VMEM model for flash attention mirrors
+``ops.flash_attention._per_head_vmem_bytes`` — duplicated here (like the
+linter's mesh-axis table) so this module never imports jax; a sync test in
+`tests/test_tune.py` keeps the two formulas from drifting.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+__all__ = ["FLASH_BLOCKS", "LN_BLOCK_ROWS", "VMEM_BUDGET", "flash_space",
+           "flash_vmem_bytes", "kernel_space", "ln_space", "ln_vmem_bytes"]
+
+_LANES = 128
+_SUBLANES = 8
+
+#: mirrors ops.flash_attention._VMEM_BUDGET (sync-tested)
+VMEM_BUDGET = 8 * 1024 * 1024
+
+#: the flash grid tiles Mosaic handles well: lane-aligned powers of two.
+#: `_pick_block` in the kernel clamps to the padded sequence, so candidates
+#: larger than the (128-padded) sequence are redundant and pruned here.
+FLASH_BLOCKS = (128, 256, 512)
+
+#: LN row-block candidates — sublane-aligned, from minimum tile to the
+#: point where the (block_rows, features) fp32 working set dominates VMEM
+LN_BLOCK_ROWS = (8, 16, 32, 64, 128, 256, 512)
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def flash_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
+    """jax-free mirror of ``_per_head_vmem_bytes`` (see module docstring)."""
+    return (
+        3 * block_k * d * 2
+        + 2 * block_q * d * 2
+        + 2 * block_q * _LANES * 4
+        + 2 * block_q * d * 4
+        + block_q * block_k * 6)
+
+
+def flash_space(shapes: Sequence[Sequence[int]],
+                dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Feasible ``{"block_q", "block_k"}`` candidates for q/k/v shapes
+    ``(B, S, N, D)`` (or head-flattened ``(BN, S, D)``)."""
+    q, k = shapes[0], shapes[1]
+    sq, sk, d = int(q[-3]), int(k[-3]), int(q[-1])
+    out = []
+    for bq in FLASH_BLOCKS:
+        if bq > _ceil_to(sq, _LANES):
+            continue
+        for bk in FLASH_BLOCKS:
+            if bk > _ceil_to(sk, _LANES):
+                continue
+            if flash_vmem_bytes(bq, bk, d) > VMEM_BUDGET:
+                continue
+            out.append({"block_q": bq, "block_k": bk})
+    return out or [{"block_q": FLASH_BLOCKS[0], "block_k": FLASH_BLOCKS[0]}]
+
+
+def ln_vmem_bytes(block_rows: int, features: int) -> int:
+    """Coarse upper bound on one LN grid cell's resident fp32 working set:
+    x/do/dx tiles plus temporaries at the 128-padded feature width, and the
+    two (8, features) partial blocks."""
+    fp = _ceil_to(features, _LANES)
+    return 6 * block_rows * fp * 4 + 2 * _SUBLANES * fp * 4
+
+
+def ln_space(shapes: Sequence[Sequence[int]],
+             dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Feasible ``{"block_rows"}`` candidates for an ``(rows, features)``
+    LayerNorm input."""
+    rows, features = int(shapes[0][-2]), int(shapes[0][-1])
+    out = []
+    for br in LN_BLOCK_ROWS:
+        if br > _ceil_to(rows, _SUBLANES):
+            continue
+        if ln_vmem_bytes(br, features) > VMEM_BUDGET:
+            continue
+        out.append({"block_rows": br})
+    return out or [{"block_rows": LN_BLOCK_ROWS[0]}]
+
+
+_SPACES = {"flash_attention": flash_space, "layer_norm": ln_space}
+
+
+def kernel_space(kernel: str, shapes: Sequence[Sequence[int]],
+                 dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Pruned candidate list for ``kernel`` at the given shapes."""
+    try:
+        fn = _SPACES[kernel]
+    except KeyError:
+        raise KeyError(f"no search space for kernel {kernel!r}; "
+                       f"known: {sorted(_SPACES)}") from None
+    return fn(shapes, dtypes)

@@ -1,0 +1,117 @@
+"""CI tier-1 smoke for the persistent kernel autotuner.
+
+Three invariants, asserted end to end on CPU (interpret-mode Pallas):
+
+1. **Cold tune**: ``jimm-tpu tune run`` core (`tune_kernel`) measures the
+   layer_norm candidate space at a small shape and persists the winner in
+   a tmp cache — at least one measurement, a config on disk.
+2. **Warm process**: a SECOND subprocess resolves the same (kernel, shape,
+   dtype) through ``best_config`` against that cache and must report a pure
+   hit — ``jimm_tune_hit_total == 1`` and ``jimm_tune_measure_total == 0``
+   (zero re-measurements; the cross-process key-stability contract).
+3. **Host-only CLI**: ``jimm-tpu tune ls`` lists the cache without
+   importing jax (asserted via ``sys.modules`` in the subprocess).
+
+Exits nonzero (with a JSON error line) on any violation.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m scripts.tune_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+SHAPES = ((32, 128),)
+DTYPES = ("float32",)
+
+
+def fail(msg: str) -> int:
+    print(json.dumps({"metric": "tune_smoke", "value": 0.0, "error": msg}),
+          flush=True)
+    return 1
+
+
+def run(code: str, root: str) -> dict:
+    env = dict(os.environ, JIMM_TUNE_CACHE=root, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"subprocess failed: {proc.stderr[-1500:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+COLD = """
+import json
+from jimm_tpu import obs
+from jimm_tpu.tune import tune_kernel
+report = tune_kernel("layer_norm", %r, %r)
+snap = obs.get_registry("jimm_tune").snapshot()
+print(json.dumps({"config": report["config"],
+                  "candidates": report["candidates"],
+                  "fingerprint": report["fingerprint"],
+                  "measures": snap.get("measure_total", 0)}))
+""" % (SHAPES, DTYPES)
+
+WARM = """
+import json
+from jimm_tpu import obs
+from jimm_tpu.tune import best_config
+cfg = best_config("layer_norm", %r, %r)
+snap = obs.get_registry("jimm_tune").snapshot()
+print(json.dumps({"config": cfg,
+                  "hits": snap.get("hit_total", 0),
+                  "misses": snap.get("miss_total", 0),
+                  "measures": snap.get("measure_total", 0)}))
+""" % (SHAPES, DTYPES)
+
+LS = """
+import json, sys
+from jimm_tpu.tune.cli import main
+rc = main(["tune", "ls"])
+print(json.dumps({"rc": rc, "jax_imported": "jax" in sys.modules}))
+"""
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="jimm-tune-smoke-") as root:
+        # --- cold: measure + persist --------------------------------------
+        cold = run(COLD, root)
+        if cold["measures"] < 1 or cold["candidates"] < 1:
+            return fail(f"cold tune measured nothing: {cold}")
+        if "block_rows" not in cold["config"]:
+            return fail(f"cold tune returned no block_rows: {cold}")
+
+        # --- warm: fresh process, pure cache hit, zero measurements -------
+        warm = run(WARM, root)
+        if warm["config"] != cold["config"]:
+            return fail(f"warm lookup config {warm['config']} != tuned "
+                        f"{cold['config']} (key instability across "
+                        f"processes?)")
+        if warm["hits"] != 1 or warm["misses"] != 0:
+            return fail(f"warm lookup was not a pure hit: {warm}")
+        if warm["measures"] != 0:
+            return fail(f"warm lookup re-measured {warm['measures']} "
+                        f"times; the hot path must be lookup-only")
+
+        # --- tune ls stays jax-free ---------------------------------------
+        ls = run(LS, root)
+        if ls["rc"] != 0:
+            return fail(f"`tune ls` exited {ls['rc']}")
+        if ls["jax_imported"]:
+            return fail("`tune ls` imported jax on the host-only path")
+
+        print(json.dumps({"metric": "tune_smoke", "value": 1.0,
+                          "config": cold["config"],
+                          "candidates": cold["candidates"],
+                          "cold_measures": cold["measures"],
+                          "warm_measures": warm["measures"]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

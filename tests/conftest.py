@@ -23,6 +23,29 @@ except AttributeError:
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# the package namespace is lazy (PEP 562) and only loads the flax compat
+# backfills when a model/config attribute resolves; tests use nnx directly
+# (Variable.set_value, to_flat_state, ...) so load them up front
+import jimm_tpu.utils.compat  # noqa: E402,F401
+
+
+@pytest.fixture(autouse=True)
+def _tune_cache_in_tmp(tmp_path, monkeypatch):
+    """Point the kernel-tune cache at a per-test tmp dir: ops resolve block
+    sizes through jimm_tpu.tune.best_config, which would otherwise mkdir
+    (and persist configs under) ~/.cache/jimm_tpu/tune during the suite.
+    Also reset the process-wide cache handle so the env var is re-read."""
+    monkeypatch.setenv("JIMM_TUNE_CACHE", str(tmp_path / "tune-cache"))
+    monkeypatch.delenv("JIMM_TUNE", raising=False)
+    import sys
+    api = sys.modules.get("jimm_tpu.tune.api")
+    if api is not None:
+        api._cache = None
+    yield
+    api = sys.modules.get("jimm_tpu.tune.api")
+    if api is not None:
+        api._cache = None
+
 
 @pytest.fixture(scope="session")
 def rng() -> np.random.RandomState:

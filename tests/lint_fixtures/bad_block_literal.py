@@ -1,0 +1,24 @@
+"""JL009 fixture: hardcoded block-size kwargs at call sites (lines 8, 12),
+a suppressed deliberate pin (line 16), and non-literal kwargs that are fine
+(lines 20, 24)."""
+
+from jimm_tpu.ops import flash_attention, layer_norm
+
+
+out = flash_attention(q, k, v, block_q=128,  # line 8: JL009
+                      block_k=256)  # line 9: JL009
+
+
+y = layer_norm(x, g, b, block_rows=64)  # line 12: JL009
+
+
+# a justified pin survives: probing this exact config is the point
+z = layer_norm(x, g, b, block_rows=64)  # jaxlint: disable=JL009
+
+
+BLOCK = 128
+tuned = flash_attention(q, k, v, block_q=BLOCK)  # named constant: no finding
+
+
+def wrapper(block_rows=256):  # def-site default: no finding
+    return layer_norm(x, g, b, block_rows=None)  # None: no finding

@@ -40,6 +40,77 @@ def test_layer_norm_matches_flax(rng, rows, feat):
                                    rtol=1e-4, err_msg=name)
 
 
+#: the shapes that used to be un-lowerable: feature dims not divisible by
+#: the 128-lane tile and row counts not divisible by the 8-sublane tile
+#: (the recorded ln=fused sweep failure was (B*S, 768)-style activations on
+#: a Mosaic that rejects the block==array escape the old kernel relied on)
+ODD_SHAPES = [(1, 3), (5, 100), (257, 769), (100, 768), (2048, 3),
+              (16384, 768)]
+
+
+@pytest.mark.parametrize("rows,feat", ODD_SHAPES)
+def test_layer_norm_odd_shapes_fwd_bwd(rng, rows, feat):
+    if rows * feat > 1 << 20:
+        pytest.skip("interpret-mode too slow at this size; covered by the "
+                    "TPU lowering check below")
+    x = jnp.asarray(rng.randn(rows, feat).astype(np.float32))
+    scale = jnp.asarray(rng.randn(feat).astype(np.float32))
+    bias = jnp.asarray(rng.randn(feat).astype(np.float32))
+    eps = 1e-6
+
+    got = layer_norm(x, scale, bias, eps)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+    want = (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def loss_fused(x, s, b):
+        return jnp.sum(layer_norm(x, s, b, eps) ** 2)
+
+    def loss_ref(x, s, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+        y = (x - mu) * jax.lax.rsqrt(var + eps) * s + b
+        return jnp.sum(y ** 2)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, scale, bias)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b_, name in zip(gf, gr, ("dx", "dscale", "dbias")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-3, rtol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("rows,feat", [(5, 100), (257, 769), (100, 768)])
+def test_layer_norm_odd_shapes_bf16(rng, rows, feat):
+    x = jnp.asarray(rng.randn(rows, feat), jnp.bfloat16)
+    scale = jnp.ones((feat,), jnp.bfloat16)
+    bias = jnp.zeros((feat,), jnp.bfloat16)
+    got = layer_norm(x, scale, bias, 1e-6)
+    assert got.dtype == jnp.bfloat16 and got.shape == (rows, feat)
+    xf = np.asarray(x, np.float32)
+    mu = xf.mean(-1, keepdims=True)
+    ref = (xf - mu) / np.sqrt(((xf - mu) ** 2).mean(-1, keepdims=True)
+                              + 1e-6)
+    np.testing.assert_allclose(np.asarray(got, np.float32), ref, atol=3e-2)
+
+
+@pytest.mark.parametrize("rows,feat", ODD_SHAPES)
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_layer_norm_odd_shapes_lower_for_tpu(rows, feat, dtype):
+    """The acceptance criterion for the old Mosaic rejection: fwd AND bwd
+    lower for TPU (AOT cross-lowering runs the Mosaic checks on CPU) with
+    every block dim a real tile multiple — no block==array escape."""
+    dt = jnp.dtype(dtype)
+    x = jax.ShapeDtypeStruct((rows, feat), dt)
+    sb = jax.ShapeDtypeStruct((feat,), dt)
+
+    def loss(x, s, b):
+        return jnp.sum(layer_norm(x, s, b, 1e-6).astype(jnp.float32))
+
+    fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    fn.trace(x, sb, sb).lower(lowering_platforms=("tpu",))  # must not raise
+
+
 def test_layer_norm_bf16(rng):
     x = jnp.asarray(rng.randn(256, 128), jnp.bfloat16)
     scale = jnp.ones((128,), jnp.bfloat16)

@@ -151,6 +151,32 @@ class TestRuleFixtures:
         # tests construct jits per-case on purpose
         assert check_jit_in_loop(tree, "tests/test_serve.py") == []
 
+    def test_jl009_block_size_literal(self):
+        findings = findings_for("bad_block_literal.py")
+        assert rules_and_lines(findings) == {
+            ("JL009", 8),   # block_q=128
+            ("JL009", 9),   # block_k=256
+            ("JL009", 12),  # block_rows=64
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert any("best_config" in f.message for f in findings)
+        # the suppressed pin, the named-constant kwarg, the def-site
+        # default, and block_rows=None all stay clean
+
+    def test_jl009_ops_tune_and_test_paths_exempt(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_block_size_literal
+        src = "flash_attention(q, k, v, block_q=128)\n"
+        tree = ast.parse(src)
+        assert check_block_size_literal(tree, "jimm_tpu/serve/engine.py")
+        # ops defaults and the tuner's bench closures are the mechanism
+        assert check_block_size_literal(
+            tree, "jimm_tpu/ops/flash_attention.py") == []
+        assert check_block_size_literal(tree, "jimm_tpu/tune/api.py") == []
+        # tests pin blocks to exercise specific configs on purpose
+        assert check_block_size_literal(tree, "tests/test_ops.py") == []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings

@@ -1,0 +1,300 @@
+"""jimm_tpu.tune: key stability, cache hit/miss/fallback, space pruning,
+measurement discipline, and the ops integration (block sizes resolved from
+the persistent cache at trace time)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from jimm_tpu import obs
+from jimm_tpu.tune import (KERNELS, TuneCache, best_config, kernel_space,
+                           trimmed_median, tune_kernel, tune_key)
+
+FLASH_SHAPES = ((2, 128, 4, 64), (2, 128, 4, 64), (2, 128, 4, 64))
+LN_SHAPES = ((64, 256),)
+
+
+def flash_key(**over):
+    kw = dict(kernel="flash_attention", shapes=FLASH_SHAPES,
+              dtypes=("float32",) * 3,
+              kernel_version=KERNELS["flash_attention"].version,
+              backend="cpu", jax_version="0.4.37")
+    kw.update(over)
+    kernel = kw.pop("kernel")
+    return tune_key(kernel, **kw)
+
+
+def counters():
+    return obs.get_registry("jimm_tune").snapshot()
+
+
+def delta(before, after, name):
+    return after.get(name, 0) - before.get(name, 0)
+
+
+class TestKeys:
+    def test_fingerprint_deterministic(self):
+        assert flash_key().fingerprint() == flash_key().fingerprint()
+
+    def test_fingerprint_sensitivity(self):
+        base = flash_key().fingerprint()
+        assert flash_key(shapes=((2, 256, 4, 64),) * 3).fingerprint() != base
+        assert flash_key(dtypes=("bfloat16",) * 3).fingerprint() != base
+        assert flash_key(kernel_version=99).fingerprint() != base
+        assert flash_key(backend="tpu").fingerprint() != base
+        assert flash_key(jax_version="0.5.0").fingerprint() != base
+
+    def test_dtype_spellings_canonicalize(self):
+        # np dtype objects, type objects, and names all mean the same key
+        a = flash_key(dtypes=(np.float32, np.dtype("float32"), "float32"))
+        assert a.fingerprint() == flash_key().fingerprint()
+
+    def test_fingerprint_stable_across_processes(self):
+        # the persistence contract: a fresh interpreter maps the same
+        # logical key to the same fingerprint (no per-process hash seeds,
+        # dict ordering, or repr details leak in)
+        code = (
+            "from jimm_tpu.tune import tune_key\n"
+            "k = tune_key('flash_attention',"
+            " shapes=((2, 128, 4, 64),) * 3, dtypes=('float32',) * 3,"
+            " kernel_version=%d, backend='cpu', jax_version='0.4.37')\n"
+            "print(k.fingerprint())\n" % KERNELS["flash_attention"].version)
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, check=True)
+        assert out.stdout.strip() == flash_key().fingerprint()
+
+    def test_cli_preset_points_key_like_the_ops_hot_path(self):
+        # the CLI writes one dtype PER OPERAND because ops key on
+        # (q.dtype, k.dtype, v.dtype); a drift here makes offline tuning
+        # silently useless (configs that best_config never finds)
+        from jimm_tpu.tune.cli import _preset_points
+        pts = {p["kernel"]: p for p in
+               _preset_points("clip-vit-base-patch16", 2, "float32")}
+        flash = pts["flash_attention"]
+        assert len(flash["dtypes"]) == len(flash["shapes"]) == 3
+        cli_key = tune_key("flash_attention", shapes=flash["shapes"],
+                           dtypes=flash["dtypes"], kernel_version=1,
+                           backend="cpu", jax_version="x")
+        ops_key = tune_key(
+            "flash_attention",
+            shapes=tuple(tuple(s) for s in flash["shapes"]),
+            dtypes=tuple(np.dtype("float32") for _ in range(3)),
+            kernel_version=1, backend="cpu", jax_version="x")
+        assert cli_key.fingerprint() == ops_key.fingerprint()
+        assert len(pts["layer_norm"]["dtypes"]) == 1
+
+    def test_describe_is_json_round_trippable(self):
+        d = flash_key().describe()
+        assert json.loads(json.dumps(d)) == d
+        assert d["kernel"] == "flash_attention"
+
+
+class TestJaxFreeImport:
+    @pytest.mark.parametrize("module", [
+        "jimm_tpu.tune", "jimm_tpu.tune.cache", "jimm_tpu.tune.space",
+        "jimm_tpu.tune.cli"])
+    def test_import_does_not_pull_jax(self, module):
+        code = (f"import {module}, sys; "
+                f"assert 'jax' not in sys.modules, 'jax leaked'")
+        subprocess.run([sys.executable, "-c", code], check=True,
+                       capture_output=True)
+
+
+class TestCache:
+    def test_put_get_round_trip(self, tmp_path):
+        cache = TuneCache(tmp_path / "c")
+        key = flash_key()
+        fp = cache.put(key, {"block_q": 128, "block_k": 256},
+                       metrics={"time_s": 0.5})
+        assert fp == key.fingerprint()
+        rec = cache.get(key)
+        assert rec["config"] == {"block_q": 128, "block_k": 256}
+        assert rec["metrics"]["time_s"] == 0.5
+
+    def test_second_instance_sees_persisted_config(self, tmp_path):
+        TuneCache(tmp_path / "c").put(flash_key(), {"block_q": 512,
+                                                    "block_k": 128})
+        rec = TuneCache(tmp_path / "c").get(flash_key())
+        assert rec["config"]["block_q"] == 512
+
+    def test_miss_returns_none_and_is_not_memoized(self, tmp_path):
+        cache = TuneCache(tmp_path / "c")
+        assert cache.get(flash_key()) is None
+        # an offline tune between lookups must become visible
+        cache.put(flash_key(), {"block_q": 256, "block_k": 256})
+        assert cache.get(flash_key())["config"]["block_q"] == 256
+
+    def test_corrupt_record_quarantined_as_miss(self, tmp_path):
+        cache = TuneCache(tmp_path / "c")
+        key = flash_key()
+        cache.put(key, {"block_q": 128, "block_k": 128})
+        (cache.entries()[0].path / "artifact.bin").write_bytes(b"not json")
+        fresh = TuneCache(tmp_path / "c")  # bypass the in-process memo
+        assert fresh.get(key) is None
+
+    def test_entries_meta_labels(self, tmp_path):
+        cache = TuneCache(tmp_path / "c")
+        cache.put(flash_key(), {"block_q": 128, "block_k": 128})
+        (entry,) = cache.entries()
+        assert entry.meta["label"] == "tune:flash_attention"
+        assert entry.meta["kernel"] == "flash_attention"
+
+
+class TestBestConfig:
+    def test_hit_path(self, tmp_path):
+        cache = TuneCache(tmp_path / "c")
+        cache.put(tune_key("layer_norm", shapes=LN_SHAPES,
+                           dtypes=("float32",),
+                           kernel_version=KERNELS["layer_norm"].version),
+                  {"block_rows": 32})
+        before = counters()
+        cfg = best_config("layer_norm", LN_SHAPES, ("float32",), cache=cache)
+        after = counters()
+        assert cfg == {"block_rows": 32}
+        assert delta(before, after, "hit_total") == 1
+        assert delta(before, after, "measure_total") == 0
+
+    def test_fallback_path_uses_default_and_never_measures(self, tmp_path):
+        cache = TuneCache(tmp_path / "c")
+        before = counters()
+        cfg = best_config("layer_norm", ((999, 333),), ("float32",),
+                          default={"block_rows": 64}, cache=cache)
+        after = counters()
+        assert cfg == {"block_rows": 64}
+        assert delta(before, after, "miss_total") == 1
+        assert delta(before, after, "fallback_total") == 1
+        assert delta(before, after, "measure_total") == 0
+
+    def test_fallback_without_explicit_default_uses_kernel_default(
+            self, tmp_path):
+        from jimm_tpu.ops.layer_norm import DEFAULT_BLOCK_ROWS
+        cfg = best_config("layer_norm", ((7, 48),), ("float32",),
+                          cache=TuneCache(tmp_path / "c"))
+        assert cfg == {"block_rows": DEFAULT_BLOCK_ROWS}
+
+    def test_jimm_tune_env_tunes_on_miss(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("JIMM_TUNE", "1")
+        cache = TuneCache(tmp_path / "c")
+        before = counters()
+        cfg = best_config("layer_norm", ((16, 128),), ("float32",),
+                          cache=cache)
+        after = counters()
+        assert "block_rows" in cfg
+        assert delta(before, after, "measure_total") >= 1
+        # and the result persisted: the next lookup is a pure hit
+        assert cache.get(tune_key(
+            "layer_norm", shapes=((16, 128),), dtypes=("float32",),
+            kernel_version=KERNELS["layer_norm"].version)) is not None
+
+
+class TestTuneKernel:
+    def test_persists_winner_and_second_lookup_is_pure_hit(self, tmp_path):
+        cache = TuneCache(tmp_path / "c")
+        report = tune_kernel("layer_norm", ((32, 128),), ("float32",),
+                             cache=cache)
+        assert report["candidates"] == len(report["trials"]) >= 1
+        assert report["config"] in [t["config"] for t in report["trials"]]
+        before = counters()
+        cfg = best_config("layer_norm", ((32, 128),), ("float32",),
+                          cache=TuneCache(tmp_path / "c"))
+        after = counters()
+        assert cfg == report["config"]
+        assert delta(before, after, "hit_total") == 1
+        assert delta(before, after, "measure_total") == 0
+
+    def test_explicit_candidates_override_space(self, tmp_path):
+        report = tune_kernel("layer_norm", ((16, 128),), ("float32",),
+                             cache=TuneCache(tmp_path / "c"),
+                             candidates=[{"block_rows": 8}])
+        assert report["config"] == {"block_rows": 8}
+        assert report["candidates"] == 1
+
+
+class TestSpace:
+    def test_flash_space_prunes_oversized_blocks(self):
+        cands = kernel_space("flash_attention", FLASH_SHAPES,
+                             ("float32",) * 3)
+        assert cands
+        for c in cands:
+            # seq len 128 -> no point in blocks beyond its 128-multiple
+            assert c["block_q"] <= 128 and c["block_k"] <= 128
+
+    def test_flash_space_vmem_formula_matches_ops(self):
+        # the pruner's VMEM model must BE the ops guard's model — if the
+        # kernel's working-set formula changes, this fails and space.py
+        # follows
+        from jimm_tpu.ops import flash_attention as fa
+        from jimm_tpu.tune.space import VMEM_BUDGET, flash_vmem_bytes
+        assert VMEM_BUDGET == fa._VMEM_BUDGET
+        for bq in (128, 256, 512):
+            for bk in (128, 256, 512):
+                for d in (64, 128):
+                    assert flash_vmem_bytes(bq, bk, d) == \
+                        fa._per_head_vmem_bytes(bq, bk, d)
+
+    def test_ln_space_clamps_to_row_count(self):
+        cands = kernel_space("layer_norm", ((16, 128),), ("float32",))
+        assert cands
+        assert all(c["block_rows"] <= 16 for c in cands)
+
+    def test_spaces_never_empty(self):
+        # even absurd shapes yield the safe-default singleton
+        assert kernel_space("layer_norm", ((1, 100000),), ("float32",))
+        assert kernel_space("flash_attention",
+                            ((1, 8, 1, 4096),) * 3, ("float32",) * 3)
+
+
+class TestMeasure:
+    def test_trimmed_median_drops_extremes(self):
+        assert trimmed_median([100.0, 1.0, 2.0, 3.0, 0.1]) == 2.0
+
+    def test_trimmed_median_small_samples(self):
+        assert trimmed_median([3.0]) == 3.0
+        assert trimmed_median([1.0, 3.0]) == 2.0
+
+    def test_measure_counts_and_returns_positive(self):
+        from jimm_tpu.tune.measure import measure
+        before = counters()
+        t = measure(lambda: sum(range(100)), reps=3, warmup=1)
+        after = counters()
+        assert t > 0
+        assert delta(before, after, "measure_total") == 1
+
+
+class TestOpsIntegration:
+    def test_layer_norm_resolves_tuned_block(self, tmp_path):
+        import jax.numpy as jnp
+
+        from jimm_tpu.ops.layer_norm import layer_norm
+        from jimm_tpu.tune import api as tune_api
+        cache = tune_api.configure(tmp_path / "c")
+        cache.put(tune_key("layer_norm", shapes=((24, 128),),
+                           dtypes=("float32",),
+                           kernel_version=KERNELS["layer_norm"].version),
+                  {"block_rows": 8})
+        x = jnp.arange(24 * 128, dtype=jnp.float32).reshape(24, 128) / 100
+        before = counters()
+        out = layer_norm(x, jnp.ones((128,)), jnp.zeros((128,)))
+        after = counters()
+        assert delta(before, after, "hit_total") >= 1
+        assert delta(before, after, "measure_total") == 0
+        ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+            x.var(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_flash_explicit_blocks_skip_cache(self):
+        import jax
+        import jax.numpy as jnp
+
+        from jimm_tpu.ops.flash_attention import flash_attention
+        k = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, kk, v = (jax.random.normal(ki, (1, 128, 2, 64)) for ki in k)
+        before = counters()
+        flash_attention(q, kk, v, block_q=128, block_k=128)
+        after = counters()
+        for name in ("hit_total", "miss_total", "fallback_total"):
+            assert delta(before, after, name) == 0
