@@ -197,6 +197,9 @@ def emit_error(model: str, msg: str, detail: str = "") -> None:
         "vs_baseline": 0.0,
         "error": msg,
         "detail": detail[-2000:],
+        "n_devices": 1,
+        "replicas": 1,
+        "model_parallel": 1,
     }), flush=True)
 
 
@@ -547,6 +550,12 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
         "donate": not args.no_donate,
         "adopted_defaults": adopted_defaults,
         "device": jax.devices()[0].device_kind,
+        # serving-ledger topology triple (docs/serving.md): the train bench
+        # is single-device single-program, so the triple is fixed — recorded
+        # anyway so every ledger row carries the same schema
+        "n_devices": 1,
+        "replicas": 1,
+        "model_parallel": 1,
     }
     # Emit the measured datapoint IMMEDIATELY — the crosscheck below can
     # touch the tunnel (lower+compile round-trip) whose failure mode is a

@@ -49,7 +49,8 @@ def _partition_state(model):
     arg_idx = [i for i, leaf in enumerate(leaves) if _plain(leaf)]
     consts = {i: leaf for i, leaf in enumerate(leaves) if not _plain(leaf)}
     arg_leaves = [leaves[i] for i in arg_idx]
-    arg_specs = [jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+    arg_specs = [jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                      sharding=_named_sharding(leaf))
                  for leaf in arg_leaves]
 
     def rebuild(current_arg_leaves):
@@ -61,13 +62,33 @@ def _partition_state(model):
     return rebuild, arg_leaves, arg_specs
 
 
+def _named_sharding(leaf):
+    """The leaf's ``NamedSharding``, or None for single-device placements.
+
+    Sharded-model exports must record the parameter layout: the StableHLO
+    then carries logical HloShardings, so a program exported from one
+    replica's submesh deserializes onto any same-shape submesh (the outer
+    jit recompiles XLA for the actual devices; only the mesh *shape* is
+    pinned, which the AOT key already fingerprints). Single-device leaves
+    export unsharded, byte-identical to the pre-topology artifacts."""
+    from jax.sharding import NamedSharding
+    sharding = getattr(leaf, "sharding", None)
+    return sharding if isinstance(sharding, NamedSharding) else None
+
+
 def serialize_serve_forward(model, method: str, batch: int,
                             item_shape: tuple[int, ...],
-                            in_dtype: Any) -> bytes:
+                            in_dtype: Any,
+                            x_sharding: Any = None) -> bytes:
     """Trace + export ``model.<method>`` at one padded-bucket shape and
     return the serialized artifact bytes. This is the expensive call the
     store exists to amortize — it runs once per (architecture, bucket) in
-    ``aot warmup`` or on a write-through miss, never on the request path."""
+    ``aot warmup`` or on a write-through miss, never on the request path.
+
+    Parameter shardings are read off the live model's leaves (a sharded
+    replica model exports a sharded program); ``x_sharding`` optionally
+    pins the batch input's ``NamedSharding`` to match the engine's single
+    sharded ``device_put`` per micro-batch."""
     import jax
     from jax import export as jax_export
 
@@ -76,7 +97,8 @@ def serialize_serve_forward(model, method: str, batch: int,
     def fwd(param_leaves, x):
         return getattr(rebuild(param_leaves), method)(x)
 
-    x_spec = jax.ShapeDtypeStruct((int(batch), *item_shape), in_dtype)
+    x_spec = jax.ShapeDtypeStruct((int(batch), *item_shape), in_dtype,
+                                  sharding=x_sharding)
     exported = jax_export.export(jax.jit(fwd))(arg_specs, x_spec)
     return exported.serialize()
 

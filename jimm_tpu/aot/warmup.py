@@ -54,14 +54,19 @@ class AotForward:
         store: artifact store consulted before any fresh jit.
         label: human-facing tag recorded in store metadata
             (e.g. ``clip:clip-vit-base-patch16:f32``).
-        mesh: optional mesh folded into the cache key.
+        mesh: optional mesh folded into the cache key (a sharded replica
+            forward and the single-device forward of the same model must
+            never share an artifact).
+        in_sharding: optional ``NamedSharding`` the engine places each
+            padded batch with; recorded in write-through exports so the
+            sharded program's input layout matches serving exactly.
         write_through: put freshly compiled buckets back into the store
             (default True) so the next process starts warm.
     """
 
     def __init__(self, model, *, method: str, item_shape: tuple[int, ...],
                  in_dtype: Any = np.float32, store: ArtifactStore,
-                 label: str = "", mesh: Any = None,
+                 label: str = "", mesh: Any = None, in_sharding: Any = None,
                  write_through: bool = True):
         from jimm_tpu.serve.engine import counting_forward
         self.model = model
@@ -71,6 +76,7 @@ class AotForward:
         self.store = store
         self.label = label
         self.mesh = mesh
+        self.in_sharding = in_sharding
         self.write_through = write_through
         self._loaded: dict[int, Callable] = {}
         #: bucket -> "aot" | "miss" | "fallback" (how it was warmed)
@@ -144,7 +150,7 @@ class AotForward:
             from jimm_tpu.aot.export import serialize_serve_forward
             payload = serialize_serve_forward(
                 self.model, self.method, bucket, self.item_shape,
-                self.in_dtype)
+                self.in_dtype, x_sharding=self.in_sharding)
             self.store.put(fp, payload,
                            meta={"label": self.label, **key.describe(),
                                  "format_version": AOT_FORMAT_VERSION})
@@ -180,7 +186,7 @@ class AotForward:
 
 def warmup_store(model, *, method: str, buckets, item_shape,
                  in_dtype: Any = np.float32, store: ArtifactStore,
-                 label: str = "", mesh: Any = None,
+                 label: str = "", mesh: Any = None, in_sharding: Any = None,
                  force: bool = False) -> dict:
     """Precompile every bucket of a table into the store (the ``jimm-tpu
     aot warmup`` core). Existing valid entries are kept unless ``force``.
@@ -204,7 +210,8 @@ def warmup_store(model, *, method: str, buckets, item_shape,
                               "action": "kept"}
             continue
         payload = serialize_serve_forward(model, method, bucket,
-                                          item_shape, in_dtype)
+                                          item_shape, in_dtype,
+                                          x_sharding=in_sharding)
         store.put(fp, payload, meta={"label": label, **key.describe(),
                                      "format_version": AOT_FORMAT_VERSION})
         report[bucket] = {"fingerprint": fp,

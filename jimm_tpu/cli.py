@@ -1300,16 +1300,27 @@ def cmd_serve(args: argparse.Namespace) -> int:
 
     method = "encode_image" if fam in ("clip", "siglip") else "__call__"
     size = model.config.vision.image_size
+    store = None
     if args.aot_store:
         # store-first warm start: buckets precompiled by `jimm-tpu aot
         # warmup` deserialize instead of compiling; anything else compiles
         # fresh and is written through for the next restart
         from jimm_tpu.aot import ArtifactStore
+        store = ArtifactStore(args.aot_store)
+    from jimm_tpu.serve.topology import build_replica_forwards, plan_topology
+    plan = plan_topology(args.replicas, args.model_parallel)
+    if not plan.is_trivial:
+        # multi-chip serving: N replica groups of (data=1, model=k)
+        # submeshes, each with its own sharded param copy + warm forward,
+        # load-balanced behind the one admission queue
+        forward, trace_count = build_replica_forwards(
+            model, plan, method=method, item_shape=(size, size, 3),
+            store=store, label=model_key)
+    elif store is not None:
         from jimm_tpu.aot.warmup import AotForward
         forward = AotForward(model, method=method,
                              item_shape=(size, size, 3),
-                             store=ArtifactStore(args.aot_store),
-                             label=model_key)
+                             store=store, label=model_key)
         trace_count = forward.trace_count
     else:
         forward, trace_count = counting_forward(model, method)
@@ -1339,6 +1350,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
              "buckets": list(buckets.sizes),
              "warmup_s": round(time.monotonic() - t0, 3),
              "compile_count": trace_count()}
+    if not plan.is_trivial:
+        ready["topology"] = plan.describe()
     if args.aot_store:
         ready["aot"] = {str(k): v["source"]
                         for k, v in sorted(engine.warmup_report.items())}
@@ -1620,6 +1633,15 @@ def build_parser() -> argparse.ArgumentParser:
                          'e.g. "1,4,16,64" (default: platform table)')
     sp.add_argument("--max-delay-ms", type=float, default=5.0,
                     help="micro-batch coalescing window")
+    sp.add_argument("--replicas", type=int, default=1,
+                    help="independent serving replicas to partition the "
+                         "visible devices into; micro-batches are load-"
+                         "balanced across them (1 = classic single-device "
+                         "serve)")
+    sp.add_argument("--model-parallel", type=int, default=1,
+                    help="devices per replica: each forward's params are "
+                         "tensor-parallel over a (data=1, model=k) submesh "
+                         "(big towers that don't fit one chip)")
     sp.add_argument("--queue-size", type=int, default=256,
                     help="admission bound; requests past it get a 503 "
                          "queue_full")

@@ -35,6 +35,14 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   backend; leave the kwarg off (or pass ``None``) so tuned configs apply,
   or tune offline with ``jimm-tpu tune``. Tests are exempt; deliberate
   pins carry a ``# jaxlint: disable=JL009`` justification.
+- **JL010** ``jax.device_put`` without an explicit placement (no second
+  positional argument and no ``device=``/``sharding=`` kwarg) in
+  ``serve/`` or ``parallel/`` code — an unplaced put lands the array
+  replicated on the default device, silently undoing the submesh layout
+  every replica forward depends on (mismatched-layout retrace or a wrong-
+  device transfer per call). Pass the target ``NamedSharding`` (or
+  device); deliberate default placements carry a
+  ``# jaxlint: disable=JL010`` justification.
 """
 
 from __future__ import annotations
@@ -698,6 +706,46 @@ def check_block_size_literal(tree: ast.AST, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL010 — unplaced device_put in sharding-sensitive code
+# ---------------------------------------------------------------------------
+
+def _path_is_parallel(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "parallel" in parts or parts[-1] == "parallel.py"
+
+
+def check_device_put_placement(tree: ast.AST, path: str) -> list[Finding]:
+    """JL010: in ``serve/`` and ``parallel/`` code every ``jax.device_put``
+    must say *where* — a second positional argument or a ``device=``/
+    ``sharding=`` kwarg. A bare put places the array on the default device,
+    which in a multi-replica topology is some other replica's submesh: the
+    sharded executable then either retraces for the mismatched layout or
+    pays a cross-device transfer on every batch. Deliberate default
+    placements carry a ``# jaxlint: disable=JL010`` justification."""
+    if not (_path_is_serve(path) or _path_is_parallel(path)):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        if fname is None or fname.rsplit(".", 1)[-1] != "device_put":
+            continue
+        if len(node.args) >= 2:
+            continue  # positional device/sharding
+        if any(kw.arg in ("device", "sharding") for kw in node.keywords):
+            continue
+        findings.append(Finding(
+            "JL010", ERROR, path, node.lineno,
+            f"{fname}(...) without a device/sharding places the array on "
+            f"the default device — in sharded serving that is the wrong "
+            f"submesh (layout retrace or per-batch cross-device copy); "
+            f"pass the replica's NamedSharding, or justify the default "
+            f"placement with # jaxlint: disable=JL010"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -712,4 +760,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_bare_print(tree, path)
     findings += check_jit_in_loop(tree, path)
     findings += check_block_size_literal(tree, path)
+    findings += check_device_put_placement(tree, path)
     return findings

@@ -12,12 +12,13 @@ from jimm_tpu.parallel.sharding import (DATA_PARALLEL, FSDP, FSDP_SP,
                                         SEQUENCE_PARALLEL, TENSOR_PARALLEL,
                                         ShardingRules, create_sharded,
                                         logical, logical_constraint,
-                                        shard_batch, shard_model, use_sharding)
+                                        shard_batch, shard_model,
+                                        sharded_copy, use_sharding)
 
 __all__ = [
     "make_mesh", "make_hybrid_mesh", "make_topology", "TOPOLOGIES",
     "initialize_distributed", "ShardingRules", "use_sharding",
-    "create_sharded", "shard_model", "shard_batch", "logical",
+    "create_sharded", "shard_model", "shard_batch", "sharded_copy", "logical",
     "logical_constraint", "pipeline_forward", "ring_attention", "ulysses_attention",
     "zigzag_order", "zigzag_shard", "zigzag_unshard",
     "REPLICATED", "DATA_PARALLEL", "TENSOR_PARALLEL",

@@ -286,6 +286,33 @@ def shard_model(model: nnx.Module, mesh: Mesh,
     return model
 
 
+def sharded_copy(model: nnx.Module, mesh: Mesh,
+                 rules: ShardingRules | str = REPLICATED) -> nnx.Module:
+    """A *new* model whose parameters are ``device_put`` onto ``mesh`` per
+    ``rules``, leaving ``model`` untouched. This is the replica primitive of
+    multi-chip serving (``serve/topology.py``): one host-resident model fans
+    out into N independent copies, each pinned to its own submesh, so the
+    replicas can compute concurrently without sharing buffers."""
+    if isinstance(rules, str):
+        rules = PRESET_RULES[rules]
+    graphdef, state = nnx.split(model)
+    with use_sharding(mesh, rules):
+        specs = nnx.get_partition_spec(state)
+
+        def put(leaf, spec):
+            val = leaf.get_value() if isinstance(leaf, nnx.Variable) else leaf
+            s = spec.get_value() if isinstance(spec, nnx.Variable) else spec
+            if not isinstance(s, P):
+                s = P()
+            s = prune_spec(resolve_logical_spec(s, rules), np.shape(val),
+                           mesh)
+            return jax.device_put(val, NamedSharding(mesh, s))
+
+        new_state = jax.tree.map(put, state, specs,
+                                 is_leaf=lambda x: isinstance(x, nnx.Variable))
+    return nnx.merge(graphdef, new_state)
+
+
 def create_sharded(ctor: Callable[[], nnx.Module], mesh: Mesh,
                    rules: ShardingRules | str = REPLICATED) -> nnx.Module:
     """Initialize a model with parameters *born sharded* (init runs under jit

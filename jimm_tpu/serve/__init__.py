@@ -22,13 +22,16 @@ from jimm_tpu.serve.client import (ServeClient, ServeClientError,
 from jimm_tpu.serve.engine import InferenceEngine, counting_forward
 from jimm_tpu.serve.server import (ServingServer, ZeroShotService,
                                    decode_image_payload)
+from jimm_tpu.serve.topology import (ReplicaForward, TopologyPlan,
+                                     build_replica_forwards, plan_topology)
 
 __all__ = [
     "AdmissionController", "AdmissionPolicy", "BucketTable",
     "DEFAULT_BATCH_BUCKETS", "DeadlineExceededError", "EmbeddingCache",
-    "EngineClosedError", "InferenceEngine", "QueueFullError", "RequestError",
-    "ServeClient", "ServeClientError", "ServeError", "ServeMetrics",
-    "ServingServer", "TPU_BATCH_BUCKETS", "ZeroShotService",
-    "class_embedding_cache", "counting_forward", "decode_image_payload",
-    "default_buckets", "encode_image_payload", "pad_batch", "prompt_set_key",
+    "EngineClosedError", "InferenceEngine", "QueueFullError", "ReplicaForward",
+    "RequestError", "ServeClient", "ServeClientError", "ServeError",
+    "ServeMetrics", "ServingServer", "TPU_BATCH_BUCKETS", "TopologyPlan",
+    "ZeroShotService", "build_replica_forwards", "class_embedding_cache",
+    "counting_forward", "decode_image_payload", "default_buckets",
+    "encode_image_payload", "pad_batch", "plan_topology", "prompt_set_key",
 ]

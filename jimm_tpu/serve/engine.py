@@ -13,17 +13,23 @@ pre-compiled jitted forward. The coalescing policy:
    degradation: shed latency, not requests);
 4. stop early the moment the largest bucket fills.
 
-Device compute runs on a single-thread executor so the event loop keeps
-accepting and coalescing while a batch is in flight (continuous batching:
-batch N+1 forms while batch N computes). Host syncs (``np.asarray`` on the
-result) happen only inside that executor — the ``*_blocking`` functions —
-never on the loop; the JL006 lint rule enforces exactly this split for every
-``async def`` in this package.
+Device compute runs on per-replica single-thread executors so the event loop
+keeps accepting and coalescing while batches are in flight (continuous
+batching: batch N+1 forms while batch N computes). With one replica that is
+exactly the classic single-device engine; with several (``forward`` given as
+a list, normally built by :func:`~jimm_tpu.serve.topology
+.build_replica_forwards`) a capacity semaphore lets up to one batch per
+replica run concurrently and each coalesced micro-batch is dispatched to the
+least-loaded replica (queue-depth balancing, round-robin on ties). Host
+syncs (``np.asarray`` on the result) happen only inside those executors —
+the ``*_blocking`` functions — never on the loop; the JL006 lint rule
+enforces exactly this split for every ``async def`` in this package.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import functools
 import time
 from collections import deque
@@ -74,13 +80,36 @@ class _Request:
         self.rid = rid
 
 
+class _Replica:
+    """One compute lane: a forward, its single-thread executor, and its
+    load counters. ``inflight`` is the replica's queue depth (batches
+    assigned but not finished) — the quantity dispatch balances on."""
+
+    __slots__ = ("index", "forward", "pool", "inflight", "dispatched",
+                 "device_s")
+
+    def __init__(self, index: int, forward: Callable, name: str):
+        self.index = index
+        self.forward = forward
+        self.pool = ThreadPoolExecutor(max_workers=1,
+                                       thread_name_prefix=name)
+        self.inflight = 0
+        self.dispatched = 0
+        self.device_s = 0.0
+
+
 class InferenceEngine:
     """Coalesces single-item requests into bucketed micro-batches.
 
     Args:
         forward: callable over a ``(B, *item_shape)`` array returning an
             array-like whose row ``i`` answers input row ``i`` (e.g. the
-            pair from :func:`counting_forward`).
+            pair from :func:`counting_forward`) — or a *list* of such
+            callables, one per serving replica (see
+            :func:`~jimm_tpu.serve.topology.build_replica_forwards`).
+            Replicas compute concurrently on their own executor threads;
+            every coalesced micro-batch goes to the least-loaded one. A
+            bare callable is exactly the single-replica engine.
         item_shape: per-request input shape (no batch axis); submissions
             with any other shape are rejected with a typed
             :class:`~jimm_tpu.serve.admission.RequestError`.
@@ -95,13 +124,25 @@ class InferenceEngine:
             ``compile_count`` gauge.
     """
 
-    def __init__(self, forward: Callable, *, item_shape: tuple[int, ...],
+    def __init__(self, forward, *, item_shape: tuple[int, ...],
                  dtype=np.float32, buckets: BucketTable | None = None,
                  max_delay_ms: float = 5.0,
                  policy: AdmissionPolicy | None = None,
                  metrics: ServeMetrics | None = None,
                  trace_count: Callable[[], int] | None = None):
-        self.forward = forward
+        # A list of forwards means explicit replicas (topology-planned
+        # serving); a bare callable is the classic single-replica engine.
+        # The per-replica jimm_serve_replica_* series exist only in the
+        # explicit case so single-device metric output stays unchanged.
+        self._multi = isinstance(forward, (list, tuple))
+        forwards = list(forward) if self._multi else [forward]
+        if not forwards:
+            raise ValueError("forward list must name at least one replica")
+        self._replicas = [
+            _Replica(i, f, name=(f"jimm-serve-fwd-r{i}" if self._multi
+                                 else "jimm-serve-fwd"))
+            for i, f in enumerate(forwards)]
+        self.forward = forwards[0]
         self.item_shape = tuple(item_shape)
         self.dtype = np.dtype(dtype)
         self.buckets = buckets if buckets is not None else default_buckets()
@@ -114,10 +155,18 @@ class InferenceEngine:
         self.metrics.bind_gauge("queue_depth_now",
                                 lambda: float(self._queue.qsize())
                                 if self._queue is not None else 0.0)
+        if self._multi:
+            # "n_replicas", not "replica_count": the obs exporter renders
+            # *_count names as histogram counters
+            self.metrics.bind_gauge("n_replicas",
+                                    lambda: float(len(self._replicas)))
+            for replica in self._replicas:
+                self._bind_replica_metrics(replica)
         self._queue: asyncio.Queue | None = None
         self._task: asyncio.Task | None = None
-        self._pool = ThreadPoolExecutor(max_workers=1,
-                                        thread_name_prefix="jimm-serve-fwd")
+        self._capacity: asyncio.Semaphore | None = None
+        self._dispatch_tasks: set[asyncio.Task] = set()
+        self._rr = 0
         self._running = False
         # Per-request phase decomposition (trace id -> phase seconds),
         # newest last; read by /healthz debugging and tests.
@@ -125,7 +174,47 @@ class InferenceEngine:
         # bucket -> {"seconds", "source"} filled by warmup_blocking;
         # source is "compile" (plain forward) or the AOT outcome
         # ("aot"/"miss"/"fallback") when the forward is store-backed.
+        # Multi-replica engines add a per-replica breakdown under
+        # "replicas" and report "mixed" when the sources disagree.
         self.warmup_report: dict = {}
+
+    # -- replicas ---------------------------------------------------------
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self._replicas)
+
+    def _bind_replica_metrics(self, replica: _Replica) -> None:
+        """Register this replica's jimm_serve_replica_* series: queue depth
+        (inflight batches), dispatch count, and accumulated device seconds.
+        The counter is pre-created at zero so a replica that never wins a
+        dispatch still shows up in scrapes."""
+        i = replica.index
+        self.metrics.inc(f"replica_{i}_dispatched_total", 0)
+        self.metrics.bind_gauge(f"replica_{i}_inflight",
+                                lambda r=replica: float(r.inflight))
+        self.metrics.bind_gauge(f"replica_{i}_device_seconds",
+                                lambda r=replica: round(r.device_s, 6))
+
+    def replica_stats(self) -> list[dict]:
+        """Per-replica load snapshot (healthz payload and the sharded serve
+        smoke's balance check)."""
+        return [{"replica": r.index, "dispatched": r.dispatched,
+                 "inflight": r.inflight,
+                 "device_seconds": round(r.device_s, 6)}
+                for r in self._replicas]
+
+    def _pick_replica(self) -> _Replica:
+        """Least-loaded replica by inflight batch count; ties break
+        round-robin from the cursor so equal-depth replicas alternate."""
+        n = len(self._replicas)
+        best = None
+        for off in range(n):
+            r = self._replicas[(self._rr + off) % n]
+            if best is None or r.inflight < best.inflight:
+                best = r
+        self._rr = (best.index + 1) % n
+        return best
 
     # -- lifecycle --------------------------------------------------------
 
@@ -139,25 +228,41 @@ class InferenceEngine:
         AOT hit the forward installs a deserialized executable, so the
         priming run below is a device warm-up, not a fresh trace+compile.
         The per-bucket outcome lands in ``self.warmup_report``."""
-        prepare = getattr(self.forward, "prepare_bucket", None)
         times = {}
         self.warmup_report = {}
         for size in self.buckets.sizes:
-            source = prepare(size) if prepare is not None else "compile"
             zeros = np.zeros((size,) + self.item_shape, self.dtype)
-            t0 = time.monotonic()
-            with span("serve_warmup_aot" if source == "aot"
-                      else "serve_warmup_compile"):
-                self._forward_blocking(zeros)
-            times[size] = round(time.monotonic() - t0, 4)
-            self.warmup_report[size] = {"seconds": times[size],
-                                        "source": source}
+            per_replica = []
+            for replica in self._replicas:
+                prepare = getattr(replica.forward, "prepare_bucket", None)
+                source = prepare(size) if prepare is not None else "compile"
+                t0 = time.monotonic()
+                with span("serve_warmup_aot" if source == "aot"
+                          else "serve_warmup_compile"):
+                    self._forward_blocking(zeros, replica)
+                per_replica.append(
+                    {"seconds": round(time.monotonic() - t0, 4),
+                     "source": source})
+            times[size] = round(sum(e["seconds"] for e in per_replica), 4)
+            sources = {e["source"] for e in per_replica}
+            report = {"seconds": times[size],
+                      "source": (per_replica[0]["source"]
+                                 if len(sources) == 1 else "mixed")}
+            if self._multi:
+                report["replicas"] = per_replica
+            self.warmup_report[size] = report
         return times
 
     async def start(self) -> None:
         if self._running:
             return
         self._queue = asyncio.Queue()
+        # one permit per replica: the batcher only forms the next batch
+        # when some replica can take it, so admission backpressure still
+        # sees every queued request (nothing hides in formed-but-unrunnable
+        # batches) and a single-replica engine serializes exactly as before
+        self._capacity = asyncio.Semaphore(len(self._replicas))
+        self._dispatch_tasks = set()
         self._running = True
         self._task = asyncio.get_running_loop().create_task(
             self._batcher(), name="jimm-serve-batcher")
@@ -171,7 +276,11 @@ class InferenceEngine:
         if self._task is not None:
             await self._task
             self._task = None
-        self._pool.shutdown(wait=True)
+        if self._dispatch_tasks:
+            await asyncio.gather(*tuple(self._dispatch_tasks),
+                                 return_exceptions=True)
+        for replica in self._replicas:
+            replica.pool.shutdown(wait=True)
 
     # -- submission -------------------------------------------------------
 
@@ -215,11 +324,18 @@ class InferenceEngine:
     # -- batching loop ----------------------------------------------------
 
     async def _batcher(self) -> None:
-        assert self._queue is not None
+        assert self._queue is not None and self._capacity is not None
         queue = self._queue
+        loop = asyncio.get_running_loop()
         while True:
+            # wait for compute capacity BEFORE taking work: requests keep
+            # accumulating in the bounded admission queue while every
+            # replica is busy, so queue-full rejection fires at the same
+            # depth it did in the single-executor engine
+            await self._capacity.acquire()
             first = await queue.get()
             if first is _STOP:
+                self._capacity.release()
                 break
             batch = [first]
             window_end = time.monotonic() + self.max_delay_s
@@ -256,11 +372,30 @@ class InferenceEngine:
                     break
                 batch.append(nxt)
             self.metrics.set_queue_depth(queue.qsize())
-            await self._dispatch(batch, shed=shed)
+            replica = self._pick_replica()
+            replica.inflight += 1
+            task = loop.create_task(
+                self._dispatch_tracked(replica, batch, shed),
+                name=f"jimm-serve-dispatch-r{replica.index}")
+            self._dispatch_tasks.add(task)
+            task.add_done_callback(self._dispatch_tasks.discard)
             if stop:
                 break
 
+    async def _dispatch_tracked(self, replica: _Replica,
+                                batch: list[_Request], shed: bool) -> None:
+        """Run one batch on one replica, then return its capacity permit.
+        Runs as a task so replicas compute concurrently while the batcher
+        keeps coalescing."""
+        try:
+            await self._dispatch(batch, replica=replica, shed=shed)
+        finally:
+            replica.inflight -= 1
+            if self._capacity is not None:
+                self._capacity.release()
+
     async def _dispatch(self, batch: list[_Request], *,
+                        replica: _Replica | None = None,
                         shed: bool = False) -> None:
         now = time.monotonic()
         live = []
@@ -287,16 +422,21 @@ class InferenceEngine:
             padded = pad_batch([req.item for req in live], bucket)
         pad_s = time.perf_counter() - t_pad
         self.metrics.observe_phase("pad", pad_s)
+        replica = replica if replica is not None else self._replicas[0]
         loop = asyncio.get_running_loop()
         try:
             out, device_s, readback_s = await loop.run_in_executor(
-                self._pool, self._forward_blocking_timed, padded)
+                replica.pool, self._forward_blocking_timed, padded, replica)
         except Exception as e:  # noqa: BLE001 — surface to every waiter
             self.metrics.inc("errors_total")
             for req in live:
                 if not req.future.done():
                     req.future.set_exception(e)
             return
+        replica.dispatched += 1
+        replica.device_s += device_s
+        if self._multi:
+            self.metrics.inc(f"replica_{replica.index}_dispatched_total")
         self.metrics.observe_phase("device", device_s)
         self.metrics.observe_phase("readback", readback_s)
         self.metrics.observe_batch(n, bucket, shed=shed)
@@ -318,19 +458,27 @@ class InferenceEngine:
 
     # -- device side (executor thread, never the event loop) --------------
 
-    def _forward_blocking(self, padded: np.ndarray) -> np.ndarray:
+    def _forward_blocking(self, padded: np.ndarray,
+                          replica: _Replica | None = None) -> np.ndarray:
         """Runs the warm forward and materializes the result on host. The
         only place in the engine that blocks on the device."""
-        return self._forward_blocking_timed(padded)[0]
+        return self._forward_blocking_timed(padded, replica)[0]
 
     def _forward_blocking_timed(
-            self, padded: np.ndarray) -> tuple[np.ndarray, float, float]:
+            self, padded: np.ndarray, replica: _Replica | None = None
+    ) -> tuple[np.ndarray, float, float]:
         """`_forward_blocking` plus the device/readback split: seconds the
         device spent computing (dispatch + ``block_until_ready``) vs.
-        copying the result back to host memory (``np.asarray``)."""
+        copying the result back to host memory (``np.asarray``). Multi-
+        replica engines nest a replica-tagged span inside the aggregate
+        ``serve_device`` one so per-replica device time shows up as its own
+        lane in the span dump and any profiler capture."""
+        replica = replica if replica is not None else self._replicas[0]
+        tagged = (span(f"serve_device_r{replica.index}") if self._multi
+                  else contextlib.nullcontext())
         t0 = time.perf_counter()
-        with span("serve_device"):
-            out = self.forward(padded)
+        with span("serve_device"), tagged:
+            out = replica.forward(padded)
             if hasattr(out, "block_until_ready"):
                 out.block_until_ready()
         t1 = time.perf_counter()

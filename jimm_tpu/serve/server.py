@@ -356,4 +356,8 @@ class ServingServer:
         report = getattr(self.engine, "warmup_report", None)
         if report:
             out["warmup"] = {str(k): v for k, v in sorted(report.items())}
+        # topology-planned engines expose per-replica load so "is one
+        # replica cold/stuck?" is answerable from a health probe
+        if getattr(self.engine, "_multi", False):
+            out["replicas"] = self.engine.replica_stats()
         return out

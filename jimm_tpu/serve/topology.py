@@ -1,0 +1,195 @@
+"""Multi-chip serving topology: replica groups of (data=1, model=k) submeshes.
+
+One host holds N visible devices; the serving engine wants R independent
+*replicas* (inter-request parallelism — each replica computes a whole
+micro-batch) that are each k-way *model-parallel* (intra-request parallelism
+— one forward's matmuls sharded Megatron-style over k chips). The planner
+here partitions the device list into R contiguous groups of k and builds one
+``Mesh`` with axes ``("data", "model")`` = ``(1, k)`` per group; the forwards
+built from the plan carry ``NamedSharding`` annotations from
+:mod:`jimm_tpu.parallel.sharding` on both parameters (``sharded_copy`` with
+the ``tp`` rules) and batches (a single sharded ``device_put`` per
+micro-batch — never per-leaf transfers).
+
+The degenerate ``replicas=1, model_parallel=1`` plan is *trivial*: callers
+must take today's single-device path (plain jitted forward, no mesh, no
+device_put) so single-chip serving stays byte-identical. ``plan_topology``
+rejects infeasible splits (``R * k > n_devices``) with an error that names
+the fix.
+
+FastUSP (PAPERS.md) motivates exactly this two-level split — replication for
+throughput, tensor parallelism for per-request latency on towers too big for
+one chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+__all__ = ["ReplicaForward", "TopologyPlan", "build_replica_forwards",
+           "plan_topology"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyPlan:
+    """The outcome of partitioning ``n_devices`` into replica groups.
+
+    ``device_groups`` holds the concrete device objects, one tuple of
+    ``model_parallel`` devices per replica, in ``jax.devices()`` order
+    (contiguous groups — on TPU, neighbouring devices share ICI links, so
+    the model-axis collectives stay on-slice). Devices beyond
+    ``replicas * model_parallel`` are left unused (reported, not silently
+    dropped).
+    """
+
+    replicas: int
+    model_parallel: int
+    n_devices: int
+    device_groups: tuple[tuple, ...]
+
+    @property
+    def is_trivial(self) -> bool:
+        """True for the 1x1 plan: callers must use the single-device serve
+        path (no mesh, no sharded transfers) — byte-compatible with a serve
+        stack that never imported this module."""
+        return self.replicas == 1 and self.model_parallel == 1
+
+    @property
+    def devices_used(self) -> int:
+        return self.replicas * self.model_parallel
+
+    def meshes(self) -> list:
+        """One ``(data=1, model=k)`` mesh per replica group."""
+        from jimm_tpu.parallel.mesh import make_mesh
+        return [make_mesh({"data": 1, "model": self.model_parallel},
+                          devices=list(group))
+                for group in self.device_groups]
+
+    def describe(self) -> dict:
+        """Flat JSON-able summary for ready lines, healthz, and the
+        MEASUREMENTS.jsonl topology fields."""
+        return {"n_devices": self.n_devices, "replicas": self.replicas,
+                "model_parallel": self.model_parallel,
+                "devices_used": self.devices_used,
+                "devices_unused": self.n_devices - self.devices_used}
+
+
+def plan_topology(replicas: int | None = None,
+                  model_parallel: int | None = None,
+                  devices: Sequence | None = None) -> TopologyPlan:
+    """Partition the visible devices into ``replicas`` groups of
+    ``model_parallel``.
+
+    Defaults are conservative: ``replicas=1, model_parallel=1`` (the trivial
+    single-device plan) — scaling out is an explicit operator choice via
+    ``--replicas``/``--model-parallel``. Raises ``ValueError`` when the
+    split does not fit the device count, naming both sides of the
+    inequality so the error is actionable from a launch log.
+    """
+    if devices is None:
+        import jax
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    replicas = 1 if replicas is None else int(replicas)
+    model_parallel = 1 if model_parallel is None else int(model_parallel)
+    if replicas < 1 or model_parallel < 1:
+        raise ValueError(
+            f"replicas ({replicas}) and model_parallel ({model_parallel}) "
+            f"must both be >= 1")
+    need = replicas * model_parallel
+    if need > n:
+        raise ValueError(
+            f"topology needs replicas * model_parallel = {replicas} * "
+            f"{model_parallel} = {need} devices but only {n} are visible; "
+            f"lower --replicas/--model-parallel or raise the device count "
+            f"(e.g. XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{need} on CPU)")
+    groups = tuple(tuple(devices[i * model_parallel:(i + 1) * model_parallel])
+                   for i in range(replicas))
+    return TopologyPlan(replicas=replicas, model_parallel=model_parallel,
+                        n_devices=n, device_groups=groups)
+
+
+class ReplicaForward:
+    """One replica's warm forward: a single sharded ``device_put`` of the
+    padded batch onto the replica's mesh, then the replica-local compiled
+    forward (plain counting jit or a store-backed
+    :class:`~jimm_tpu.aot.warmup.AotForward`).
+
+    The batch transfer is ONE ``jax.device_put`` of the whole padded array
+    with a ``NamedSharding`` — the input lands committed to the replica's
+    devices, so the compiled program never sees a host fallback transfer
+    and never migrates buffers between replicas.
+    """
+
+    def __init__(self, inner: Callable, mesh, batch_sharding):
+        self._inner = inner
+        self.mesh = mesh
+        self.batch_sharding = batch_sharding
+
+    def prepare_bucket(self, bucket: int) -> str:
+        """Delegate AOT warm-start to the wrapped forward (engine warmup
+        calls this per bucket); plain jitted inners report "compile"."""
+        prepare = getattr(self._inner, "prepare_bucket", None)
+        return prepare(bucket) if prepare is not None else "compile"
+
+    @property
+    def trace_count(self) -> Callable[[], int] | None:
+        return getattr(self._inner, "trace_count", None)
+
+    def __call__(self, padded):
+        import jax
+        x = jax.device_put(np.asarray(padded), self.batch_sharding)
+        return self._inner(x)
+
+
+def build_replica_forwards(model, plan: TopologyPlan, *, method: str,
+                           item_shape: tuple[int, ...],
+                           in_dtype: Any = np.float32, store=None,
+                           label: str = ""
+                           ) -> tuple[list[ReplicaForward],
+                                      Callable[[], int]]:
+    """Materialize the plan: one sharded model copy + warm forward per
+    replica group.
+
+    Each replica gets an independent parameter copy placed on its submesh
+    via :func:`~jimm_tpu.parallel.sharding.sharded_copy` with the ``tp``
+    (Megatron tensor-parallel) rules — on a ``model=1`` submesh that
+    degenerates to whole-params-on-one-chip, which is exactly replicated
+    serving. With ``store`` set, every replica forward is an
+    :class:`~jimm_tpu.aot.warmup.AotForward` keyed on the replica mesh (all
+    replicas share one fingerprint — same shapes, same mesh shape — so one
+    write-through warms every replica and the next restart).
+
+    Returns ``(forwards, trace_count)`` where ``trace_count`` sums fresh
+    traces across replicas: the number the engine exports as
+    ``compile_count`` and the zero-recompiles-after-warmup checks read.
+    """
+    from jax.sharding import NamedSharding
+
+    from jimm_tpu.parallel.sharding import TENSOR_PARALLEL, sharded_copy
+
+    batch_spec = TENSOR_PARALLEL.spec(
+        "batch", *([None] * len(tuple(item_shape))))
+    forwards: list[ReplicaForward] = []
+    counters: list[Callable[[], int]] = []
+    for mesh in plan.meshes():
+        replica_model = sharded_copy(model, mesh, TENSOR_PARALLEL)
+        batch_sharding = NamedSharding(mesh, batch_spec)
+        if store is not None:
+            from jimm_tpu.aot.warmup import AotForward
+            inner = AotForward(replica_model, method=method,
+                               item_shape=item_shape, in_dtype=in_dtype,
+                               store=store, label=label, mesh=mesh,
+                               in_sharding=batch_sharding)
+            counters.append(inner.trace_count)
+        else:
+            from jimm_tpu.serve.engine import counting_forward
+            inner, traces = counting_forward(replica_model, method)
+            counters.append(traces)
+        forwards.append(ReplicaForward(inner, mesh, batch_sharding))
+    return forwards, lambda: sum(c() for c in counters)

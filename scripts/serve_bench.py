@@ -13,6 +13,12 @@ and exits nonzero if any recompile happened after warmup — the serving
 shape-bucket discipline (docs/serving.md) made enforceable by the engine's
 compile-count instrumentation.
 
+``--replicas``/``--model-parallel`` run the same closed loop against a
+sharded multi-replica engine (docs/serving.md, multi-chip serving); the
+topology (n_devices / replicas / model_parallel) is recorded in every
+result row either way, so single- and multi-chip numbers stay comparable
+in the ledger.
+
 ``--aot DIR`` switches to the cold-start benchmark instead: time-to-first-
 response of a fresh engine is measured twice — compiling everything from
 scratch, then again restarted against the AOT artifact store DIR populated
@@ -37,6 +43,8 @@ def build_engine(args):
     from jimm_tpu.serve import (AdmissionPolicy, BucketTable, InferenceEngine,
                                 counting_forward, default_buckets)
 
+    from jimm_tpu.serve import build_replica_forwards, plan_topology
+
     on_tpu = jax.default_backend() == "tpu"
     name = args.preset or ("clip-vit-base-patch32" if on_tpu
                            else "clip-vit-base-patch16")
@@ -48,17 +56,23 @@ def build_engine(args):
     model = _model_cls(fam)(cfg, rngs=nnx.Rngs(0), dtype=dtype,
                             param_dtype=dtype)
     method = "encode_image" if fam in ("clip", "siglip") else "__call__"
-    forward, traces = counting_forward(model, method)
+    size = cfg.vision.image_size
+    plan = plan_topology(getattr(args, "replicas", None),
+                         getattr(args, "model_parallel", None))
+    if plan.is_trivial:
+        forward, traces = counting_forward(model, method)
+    else:
+        forward, traces = build_replica_forwards(
+            model, plan, method=method, item_shape=(size, size, 3))
     buckets = (BucketTable(tuple(int(s) for s in args.buckets.split(",")))
                if args.buckets else default_buckets())
-    size = cfg.vision.image_size
     engine = InferenceEngine(
         forward, item_shape=(size, size, 3), buckets=buckets,
         max_delay_ms=args.max_delay_ms,
         policy=AdmissionPolicy(max_queue=max(4 * args.clients, 64),
                                default_timeout_s=120.0),
         trace_count=traces)
-    return engine, traces, size, on_tpu, name
+    return engine, traces, size, on_tpu, name, plan
 
 
 def drive_engine(engine, item, clients: int, per_client: int,
@@ -194,6 +208,9 @@ def bench_cold_start(args) -> dict:
         "compiles_aot": warm_compiles,
         "aot_sources": sources,
         "store_entries": len(store.entries()),
+        "n_devices": jax.device_count(),
+        "replicas": 1,
+        "model_parallel": 1,
     }
 
 
@@ -214,6 +231,11 @@ def main() -> int:
     p.add_argument("--requests", type=int, default=0,
                    help="total requests (0 = 16 per client)")
     p.add_argument("--max-delay-ms", type=float, default=5.0)
+    p.add_argument("--replicas", type=int, default=1,
+                   help="data-parallel replica groups (each gets its own "
+                        "submesh and executor thread)")
+    p.add_argument("--model-parallel", type=int, default=1,
+                   help="devices per replica the model is sharded over")
     p.add_argument("--http", action="store_true",
                    help="measure through the full HTTP stack instead of "
                         "the in-process engine")
@@ -243,7 +265,7 @@ def main() -> int:
 
     import numpy as np
 
-    engine, traces, size, on_tpu, name = build_engine(args)
+    engine, traces, size, on_tpu, name, plan = build_engine(args)
     per_client = max(1, (args.requests or 16 * args.clients) // args.clients)
     total = per_client * args.clients
     item = np.random.RandomState(0).rand(size, size, 3).astype(np.float32)
@@ -297,7 +319,13 @@ def main() -> int:
         "buckets": list(engine.buckets.sizes),
         "warmup_s": round(warmup_s, 3),
         "compile_count_delta": compile_delta,
+        "n_devices": plan.n_devices,
+        "replicas": plan.replicas,
+        "model_parallel": plan.model_parallel,
     }
+    if getattr(engine, "_multi", False):
+        rec["replica_dispatch"] = [r["dispatched"]
+                                   for r in engine.replica_stats()]
     print(json.dumps(rec), flush=True)
     if args.record:
         from scripts._measurements import MEASUREMENTS

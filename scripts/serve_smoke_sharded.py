@@ -1,0 +1,176 @@
+"""CI tier-1 smoke for sharded multi-chip serving (docs/serving.md).
+
+Forces 8 virtual CPU devices, plans a 2-replica x 2-model-parallel
+topology, and proves the whole multi-replica path end to end in one
+process:
+
+1. **Plan + shard**: ``plan_topology(2, 2)`` over the 8 devices;
+   ``build_replica_forwards`` gives each replica its own submesh-sharded
+   model copy backed by a tmp AOT store (write-through on). Life 1's
+   warmup populates the store (replica 0 compiles + writes through,
+   replica 1 already loads replica 0's artifact — same fingerprint).
+2. **Warm restart**: a second engine against the populated store reaches
+   readiness with ZERO fresh traces — every bucket of every replica
+   sourced ``"aot"`` — proving sharded artifacts round-trip across
+   replica device sets and process lives.
+3. **Load**: a 64-client closed loop through the warm engine — zero fresh
+   compiles after warmup, every request answered, and each replica's
+   ``jimm_serve_replica_{i}_dispatched_total`` counter (parsed from the
+   rendered Prometheus text, the same bytes ``/metrics`` serves) holding
+   at least 30% of the dispatches, so the load balancer provably spreads.
+   (The load runs on the *warm* engine deliberately: its replicas are
+   symmetric — both AOT-loaded — so the >=30% check tests the balancer,
+   not the fresh-jit vs. AOT call-overhead gap of a half-warm life.)
+4. **Numerics**: one served embedding matches the unsharded model.
+
+Exits nonzero (with a JSON error line) on any violation.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m scripts.serve_smoke_sharded
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import tempfile
+
+CLIENTS = 64
+PER_CLIENT = 4
+REPLICAS = 2
+MODEL_PARALLEL = 2
+MIN_SHARE = 0.30
+
+
+def fail(msg: str) -> int:
+    print(json.dumps({"metric": "serve_smoke_sharded", "value": 0.0,
+                      "error": msg}), flush=True)
+    return 1
+
+
+def main() -> int:
+    # must land before jax initializes its backends
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+
+    import asyncio
+
+    import jax
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import CLIP, preset
+    from jimm_tpu.aot import ArtifactStore
+    from jimm_tpu.cli import _tiny_override
+    from jimm_tpu.serve import (BucketTable, InferenceEngine,
+                                build_replica_forwards, plan_topology)
+
+    if jax.device_count() < REPLICAS * MODEL_PARALLEL:
+        return fail(f"need {REPLICAS * MODEL_PARALLEL} devices, have "
+                    f"{jax.device_count()} — was XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count=8 set before "
+                    f"another jax import?")
+
+    # small buckets on purpose: 64 clients x 4 requests coalesce into ~64
+    # batches, enough dispatches for the >=30% per-replica share check to be
+    # a property of the balancer rather than of scheduler noise
+    buckets = (1, 4)
+    cfg = _tiny_override(preset("clip-vit-base-patch16"))
+    model = CLIP(cfg, rngs=nnx.Rngs(0))
+    size = cfg.vision.image_size
+    plan = plan_topology(REPLICAS, MODEL_PARALLEL)
+
+    def make_engine(store):
+        forwards, traces = build_replica_forwards(
+            model, plan, method="encode_image", item_shape=(size, size, 3),
+            store=store, label="serve_smoke_sharded")
+        return InferenceEngine(forwards, item_shape=(size, size, 3),
+                               buckets=BucketTable(buckets),
+                               max_delay_ms=2.0, trace_count=traces), traces
+
+    with tempfile.TemporaryDirectory(prefix="jimm-serve-sharded-") as root:
+        store = ArtifactStore(root)
+        # life 1: populate the store through write-through warmup
+        engine1, traces1 = make_engine(store)
+        engine1.warmup_blocking()
+        if not store.entries():
+            return fail("life-1 warmup wrote nothing to the store")
+
+        # --- warm restart: sharded AOT round-trip -------------------------
+        engine, traces = make_engine(store)
+        engine.warmup_blocking()
+        if traces():
+            return fail(f"warm restart paid {traces()} fresh traces; "
+                        f"sharded artifacts did not round-trip")
+        bad = {b: r for b, r in engine.warmup_report.items()
+               if r.get("source") != "aot"
+               or any(p.get("source") != "aot"
+                      for p in r.get("replicas", []))}
+        if bad:
+            return fail(f"warm restart buckets not fully AOT-sourced: {bad}")
+        compiles_before = traces()
+
+        # --- 64-client closed loop ----------------------------------------
+        x = np.random.RandomState(0).rand(size, size, 3).astype(np.float32)
+
+        async def one_client():
+            outs = []
+            for _ in range(PER_CLIENT):
+                outs.append(await engine.submit(x))
+            return outs
+
+        async def drive():
+            await engine.start()
+            try:
+                return await asyncio.gather(
+                    *[one_client() for _ in range(CLIENTS)])
+            finally:
+                await engine.stop()
+
+        results = asyncio.run(drive())
+        answered = sum(len(r) for r in results)
+        if answered != CLIENTS * PER_CLIENT:
+            return fail(f"only {answered}/{CLIENTS * PER_CLIENT} requests "
+                        f"answered")
+        compile_delta = traces() - compiles_before
+        if compile_delta:
+            return fail(f"{compile_delta} fresh compile(s) after warmup")
+
+        # --- balance, read off the rendered Prometheus text ---------------
+        text = engine.metrics.render_prometheus()
+        counts = {int(i): float(v) for i, v in re.findall(
+            r"^jimm_serve_replica_(\d+)_dispatched_total (\S+)$",
+            text, re.MULTILINE)}
+        if sorted(counts) != list(range(REPLICAS)):
+            return fail(f"expected jimm_serve_replica_*_dispatched_total "
+                        f"for replicas 0..{REPLICAS - 1}, got {counts}")
+        total = sum(counts.values())
+        if not total:
+            return fail("no dispatches counted")
+        shares = {i: v / total for i, v in counts.items()}
+        if any(s < MIN_SHARE for s in shares.values()):
+            return fail(f"replica dispatch share below {MIN_SHARE:.0%}: "
+                        f"{ {i: round(s, 3) for i, s in shares.items()} }")
+
+        # --- numerics vs the unsharded model ------------------------------
+        got = np.asarray(results[0][0])
+        want = np.asarray(model.encode_image(x[None]))[0]
+        if not np.allclose(got, want, rtol=1e-4, atol=1e-4):
+            return fail("sharded serving output disagrees with the "
+                        "unsharded model")
+
+        print(json.dumps({
+            "metric": "serve_smoke_sharded", "value": 1.0,
+            "topology": plan.describe(),
+            "requests": answered,
+            "compile_count_delta": compile_delta,
+            "replica_dispatch": {i: int(v) for i, v in counts.items()},
+            "store_entries": len(store.entries()),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+"""JL010 fixture: unplaced device_put in sharding-sensitive serve code."""
+import jax
+import numpy as np
+
+
+def forward_batch(padded, batch_sharding):
+    x = jax.device_put(np.asarray(padded))    # JL010: lands on device 0
+    y = jax.device_put(padded)                # JL010: same, bare alias form
+    # ok: explicit placements, positional and keyword
+    a = jax.device_put(padded, batch_sharding)
+    b = jax.device_put(padded, sharding=batch_sharding)
+    c = jax.device_put(padded, device=jax.devices()[0])
+    # ok: a justified default placement
+    d = jax.device_put(padded)  # jaxlint: disable=JL010
+    return x, y, a, b, c, d

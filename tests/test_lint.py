@@ -177,6 +177,33 @@ class TestRuleFixtures:
         # tests pin blocks to exercise specific configs on purpose
         assert check_block_size_literal(tree, "tests/test_ops.py") == []
 
+    def test_jl010_unplaced_device_put(self):
+        findings = findings_for("serve/bad_device_put.py")
+        assert rules_and_lines(findings) == {
+            ("JL010", 7),   # jax.device_put(np.asarray(...)) — no placement
+            ("JL010", 8),   # jax.device_put(padded) — no placement
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert any("NamedSharding" in f.message for f in findings)
+        # explicit positional/keyword placements and the suppressed put
+        # (lines 10-14) stay clean
+
+    def test_jl010_scoped_to_serve_and_parallel_paths(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_device_put_placement
+        src = "import jax\nx = jax.device_put(batch)\n"
+        tree = ast.parse(src)
+        assert check_device_put_placement(
+            tree, "jimm_tpu/serve/topology.py") != []
+        assert check_device_put_placement(
+            tree, "jimm_tpu/parallel/sharding.py") != []
+        # elsewhere the default device IS the contract (single-device code)
+        assert check_device_put_placement(
+            tree, "jimm_tpu/data/pipeline.py") == []
+        assert check_device_put_placement(
+            tree, "jimm_tpu/weights/loader.py") == []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings

@@ -1,0 +1,224 @@
+"""Tests for the multi-chip serving topology planner and the engine's
+replica-aware dispatch (jimm_tpu.serve.topology + engine multi-forward).
+
+Planning is pure partition arithmetic over an explicit device list, so the
+split matrix runs on subsets of the 8 virtual CPU devices the suite forces
+(tests/conftest.py). Engine-level balance tests use plain fake forwards —
+replica dispatch is a scheduling property, not a numerics one; the sharded
+numerics path gets one real (tiny) model test at the end.
+"""
+
+import asyncio
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from jimm_tpu.serve import (BucketTable, InferenceEngine, TopologyPlan,
+                            build_replica_forwards, plan_topology)
+
+
+def _devices(n):
+    import jax
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices, have {len(devs)}")
+    return devs[:n]
+
+
+class TestPlanTopology:
+    @pytest.mark.parametrize("n,replicas,model_parallel", [
+        (1, 1, 1),
+        (2, 1, 1), (2, 2, 1), (2, 1, 2),
+        (4, 2, 2), (4, 4, 1), (4, 1, 4), (4, 2, 1),
+        (8, 2, 4), (8, 4, 2), (8, 8, 1), (8, 1, 8), (8, 3, 2),
+    ])
+    def test_split_matrix(self, n, replicas, model_parallel):
+        devs = _devices(n)
+        plan = plan_topology(replicas, model_parallel, devices=devs)
+        assert plan.n_devices == n
+        assert plan.replicas == replicas
+        assert plan.model_parallel == model_parallel
+        assert len(plan.device_groups) == replicas
+        assert all(len(g) == model_parallel for g in plan.device_groups)
+        # groups are disjoint, contiguous, and in jax.devices() order
+        flat = [d for g in plan.device_groups for d in g]
+        assert flat == devs[:replicas * model_parallel]
+        assert plan.devices_used == replicas * model_parallel
+        d = plan.describe()
+        assert d["devices_unused"] == n - replicas * model_parallel
+
+    def test_defaults_are_trivial(self):
+        plan = plan_topology()
+        assert plan.is_trivial
+        assert plan.replicas == 1 and plan.model_parallel == 1
+
+    def test_single_device_collapses_to_trivial(self):
+        plan = plan_topology(1, 1, devices=_devices(1))
+        assert plan.is_trivial
+        assert plan.device_groups == ((plan.device_groups[0][0],),)
+        assert plan.describe()["devices_unused"] == 0
+
+    def test_non_trivial_plans(self):
+        assert not plan_topology(2, 1, devices=_devices(2)).is_trivial
+        assert not plan_topology(1, 2, devices=_devices(2)).is_trivial
+
+    @pytest.mark.parametrize("n,replicas,model_parallel", [
+        (1, 2, 1), (1, 1, 2), (2, 2, 2), (4, 8, 1), (8, 3, 3),
+    ])
+    def test_infeasible_split_rejected(self, n, replicas, model_parallel):
+        devs = _devices(n)
+        with pytest.raises(ValueError) as e:
+            plan_topology(replicas, model_parallel, devices=devs)
+        msg = str(e.value)
+        # actionable: names both sides of the inequality and the CPU fix
+        assert str(replicas * model_parallel) in msg
+        assert str(n) in msg
+        assert "xla_force_host_platform_device_count" in msg
+
+    @pytest.mark.parametrize("replicas,model_parallel", [
+        (0, 1), (1, 0), (-1, 1), (1, -2),
+    ])
+    def test_nonpositive_split_rejected(self, replicas, model_parallel):
+        with pytest.raises(ValueError, match=">= 1"):
+            plan_topology(replicas, model_parallel, devices=_devices(1))
+
+    def test_meshes_land_on_their_groups(self):
+        devs = _devices(4)
+        plan = plan_topology(2, 2, devices=devs)
+        meshes = plan.meshes()
+        assert len(meshes) == 2
+        for mesh, group in zip(meshes, plan.device_groups):
+            assert mesh.shape == {"data": 1, "model": 2}
+            assert set(mesh.devices.flat) == set(group)
+
+
+def _fake_replicas(n, delay_s=0.0):
+    """n plain forwards (identity + per-replica call log) — enough for the
+    engine's dispatch layer, no JAX involved."""
+    calls = [[] for _ in range(n)]
+    lock = threading.Lock()
+
+    def make(i):
+        def fwd(padded):
+            if delay_s:
+                import time
+                time.sleep(delay_s)
+            with lock:
+                calls[i].append(np.shape(padded)[0])
+            return np.asarray(padded)
+        return fwd
+
+    return [make(i) for i in range(n)], calls
+
+
+def _run_load(engine, item, clients, per_client):
+    async def client():
+        for _ in range(per_client):
+            await engine.submit(item)
+
+    async def go():
+        await engine.start()
+        try:
+            await asyncio.gather(*[client() for _ in range(clients)])
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+class TestMultiReplicaEngine:
+    def test_dispatch_spreads_across_replicas(self):
+        forwards, calls = _fake_replicas(2, delay_s=0.002)
+        engine = InferenceEngine(forwards, item_shape=(4,),
+                                 buckets=BucketTable((1, 4)),
+                                 max_delay_ms=1.0)
+        engine.warmup_blocking()
+        warm = [len(c) for c in calls]  # warmup primes land in the log too
+        item = np.zeros((4,), np.float32)
+        _run_load(engine, item, clients=16, per_client=4)
+        per_replica = [len(c) - w for c, w in zip(calls, warm)]
+        total = sum(per_replica)
+        assert total >= 16  # coalescing decides the exact batch count
+        assert min(per_replica) / total >= 0.3, per_replica
+        stats = engine.replica_stats()
+        assert [s["dispatched"] for s in stats] == per_replica
+        assert all(s["inflight"] == 0 for s in stats)
+
+    def test_replica_metrics_rendered(self):
+        forwards, _calls = _fake_replicas(2)
+        engine = InferenceEngine(forwards, item_shape=(4,),
+                                 buckets=BucketTable((1, 4)),
+                                 max_delay_ms=1.0)
+        engine.warmup_blocking()
+        _run_load(engine, np.zeros((4,), np.float32), clients=8,
+                  per_client=2)
+        text = engine.metrics.render_prometheus()
+        names = set(re.findall(r"^(jimm_serve_replica_\S+) ", text,
+                               re.MULTILINE))
+        for i in (0, 1):
+            assert f"jimm_serve_replica_{i}_dispatched_total" in names
+            assert f"jimm_serve_replica_{i}_inflight" in names
+        assert "jimm_serve_n_replicas" in engine.metrics.render_prometheus()
+
+    def test_warmup_report_carries_per_replica_entries(self):
+        forwards, calls = _fake_replicas(3)
+        engine = InferenceEngine(forwards, item_shape=(4,),
+                                 buckets=BucketTable((1, 2)),
+                                 max_delay_ms=1.0)
+        engine.warmup_blocking()
+        for size, rep in engine.warmup_report.items():
+            assert len(rep["replicas"]) == 3
+            assert all("seconds" in p and "source" in p
+                       for p in rep["replicas"])
+        # warmup primed every bucket on every replica
+        assert [sorted(c) for c in calls] == [[1, 2]] * 3
+
+    def test_empty_forward_list_rejected(self):
+        with pytest.raises(ValueError):
+            InferenceEngine([], item_shape=(4,))
+
+    def test_bare_callable_is_single_replica(self):
+        # the byte-compat contract: a plain callable never grows replica
+        # metrics or per-replica report entries
+        engine = InferenceEngine(lambda padded: np.asarray(padded),
+                                 item_shape=(4,),
+                                 buckets=BucketTable((1,)),
+                                 max_delay_ms=1.0)
+        engine.warmup_blocking()
+        assert not engine._multi
+        assert "replicas" not in next(iter(engine.warmup_report.values()))
+        assert "replica_0_dispatched_total" not in \
+            engine.metrics.render_prometheus()
+
+
+class TestShardedForwards:
+    def test_replica_forwards_match_unsharded_model(self):
+        from flax import nnx
+
+        from jimm_tpu import CLIP, preset
+        from jimm_tpu.cli import _tiny_override
+        _devices(4)
+        cfg = _tiny_override(preset("clip-vit-base-patch16"))
+        model = CLIP(cfg, rngs=nnx.Rngs(0))
+        size = cfg.vision.image_size
+        plan = plan_topology(2, 2, devices=_devices(4))
+        forwards, traces = build_replica_forwards(
+            model, plan, method="encode_image",
+            item_shape=(size, size, 3))
+        assert len(forwards) == 2
+        x = np.random.RandomState(0).rand(1, size, size, 3) \
+            .astype(np.float32)
+        want = np.asarray(model.encode_image(x))
+        for fwd in forwards:
+            got = np.asarray(fwd(x))
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        assert traces() == 2  # one trace per replica, none shared
+
+    def test_plan_requires_devices_it_can_use(self):
+        # the planner itself guards build_replica_forwards' device math
+        plan = plan_topology(2, 2, devices=_devices(4))
+        assert isinstance(plan, TopologyPlan)
+        groups = plan.device_groups
+        assert set(groups[0]).isdisjoint(groups[1])
