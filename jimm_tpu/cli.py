@@ -1093,7 +1093,15 @@ def cmd_classify(args: argparse.Namespace) -> int:
         owner = list(range(len(labels)))
     model_key = (f"{args.model}:{args.ckpt}:"
                  f"{'bf16' if args.bf16 else 'f32'}")
-    weights = class_embedding_cache().get_or_build(
+    if args.index:
+        # persistent tier: the retrieval store's prompt cache survives
+        # process restarts, so repeat CLI invocations skip the text tower
+        # entirely (same get_or_build surface as the in-process cache)
+        from jimm_tpu.retrieval import VectorStore
+        cache = VectorStore(args.index).prompt_cache()
+    else:
+        cache = class_embedding_cache()
+    weights = cache.get_or_build(
         prompt_set_key(model_key, np.asarray(text)),
         lambda: np.asarray(
             weights_from_rows(model, text, owner, len(labels)), np.float32))
@@ -1335,12 +1343,27 @@ def cmd_serve(args: argparse.Namespace) -> int:
                              trace_count=trace_count)
     zero_shot = (ZeroShotService(model, model_key=model_key)
                  if fam in ("clip", "siglip") else None)
+    retrieval = None
+    if args.index:
+        if not args.index_store:
+            raise SystemExit("--index needs --index-store (the vector "
+                             "store root)")
+        # /v1/search: load the named index snapshot and build its warm
+        # searcher over the same topology (and AOT store) as the engine
+        from jimm_tpu.retrieval import RetrievalService, VectorStore
+        vstore = VectorStore(args.index_store)
+        retrieval = RetrievalService.from_store(
+            vstore, args.index, k=args.search_k, plan=plan,
+            aot_store=store)
+    elif args.index_store:
+        raise SystemExit("--index-store needs --index (the index name)")
     logger = None
     if args.metrics_file:
         from jimm_tpu.train.metrics import MetricsLogger
         logger = MetricsLogger(path=args.metrics_file,
                                print_every=10 ** 9)  # JSONL only, no console
-    server = ServingServer(engine, zero_shot=zero_shot, host=args.host,
+    server = ServingServer(engine, zero_shot=zero_shot,
+                           retrieval=retrieval, host=args.host,
                            port=args.port, metrics_logger=logger,
                            metrics_log_every_s=args.metrics_every_s)
     t0 = time.monotonic()
@@ -1355,6 +1378,16 @@ def cmd_serve(args: argparse.Namespace) -> int:
     if args.aot_store:
         ready["aot"] = {str(k): v["source"]
                         for k, v in sorted(engine.warmup_report.items())}
+    if retrieval is not None:
+        info = retrieval.describe()
+        ready["retrieval"] = {"index": info["index"], "rows": info["rows"],
+                              "dim": info["dim"], "k": info["k"],
+                              "block_n": info["block_n"],
+                              "partitions": info["partitions"]}
+        if args.aot_store:
+            ready["retrieval"]["aot"] = {
+                str(b): s for b, s in sorted(
+                    retrieval.searcher.warmup_report.items())}
     print(json.dumps(ready), flush=True)
     if args.max_seconds:
         time.sleep(args.max_seconds)
@@ -1541,6 +1574,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="SigLIP2 NaFlex path: keep the image's aspect "
                          "ratio (variable-resolution patches + mask) "
                          "instead of squashing to the square")
+    sp.add_argument("--index", default=None, metavar="STORE",
+                    help="retrieval vector-store root to persist class "
+                         "embeddings in: repeat invocations (across "
+                         "processes) skip the text tower")
     sp.add_argument("--bf16", action="store_true")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_classify)
@@ -1667,6 +1704,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "tuned-config cache (populate with `jimm-tpu "
                          "tune`); lookup only — misses fall back to safe "
                          "defaults, serving never measures")
+    sp.add_argument("--index-store", default=None,
+                    help="vector store root holding retrieval indexes "
+                         "(populate with `jimm-tpu index build/add`); "
+                         "enables /v1/search")
+    sp.add_argument("--index", default=None,
+                    help="index name inside --index-store to serve")
+    sp.add_argument("--search-k", type=int, default=10,
+                    help="compiled top-k carry width; /v1/search requests "
+                         "may ask for any k up to this")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_serve)
 
@@ -1690,6 +1736,10 @@ def build_parser() -> argparse.ArgumentParser:
     # jimm-tpu tune {run,ls} — persistent Pallas kernel autotuner
     from jimm_tpu.tune.cli import add_tune_parser
     add_tune_parser(sub)
+
+    # jimm-tpu index {build,add,ls,verify,compact} — retrieval stores (no jax)
+    from jimm_tpu.retrieval.cli import add_index_parser
+    add_index_parser(sub)
 
     return p
 

@@ -43,6 +43,15 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   device transfer per call). Pass the target ``NamedSharding`` (or
   device); deliberate default placements carry a
   ``# jaxlint: disable=JL010`` justification.
+- **JL011** host-side full sort over array data (``np.argsort`` /
+  ``np.sort`` / ``jnp.sort`` variants, or ``sorted()`` over a value that
+  came off a device) in ``serve/`` or ``retrieval/`` hot paths — an O(N
+  log N) host sort over a corpus-sized array is the exact anti-pattern
+  the streaming top-k exists to avoid: score selection belongs on device
+  via ``jax.lax.top_k``; host-side *final merges* over bounded candidate
+  sets use ``np.lexsort`` (which is why lexsort is not banned).
+  Deliberate host sorts carry a ``# jaxlint: disable=JL011``
+  justification.
 """
 
 from __future__ import annotations
@@ -746,6 +755,99 @@ def check_device_put_placement(tree: ast.AST, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL011 — host-side full sort in serving/retrieval hot paths
+# ---------------------------------------------------------------------------
+
+#: dotted sort calls that rank an entire array on host — O(N log N) on the
+#: request path where the device's O(N) ``lax.top_k`` (plus a bounded-set
+#: ``np.lexsort`` merge, deliberately absent from this list) is the contract
+HOST_SORT_CALLS = frozenset({
+    "np.argsort", "np.sort", "numpy.argsort", "numpy.sort",
+    "jnp.argsort", "jnp.sort", "jax.numpy.argsort", "jax.numpy.sort",
+})
+
+#: calls whose results are host copies of (potentially corpus-sized) device
+#: or numpy array data — seeds the taint that makes ``sorted()`` suspicious
+ARRAY_SOURCE_CALLS = frozenset({"np.asarray", "np.array", "numpy.asarray",
+                                "numpy.array", "jnp.asarray", "jnp.array",
+                                "jax.device_get", "device_get"})
+
+
+def _path_is_retrieval(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "retrieval" in parts or parts[-1] == "retrieval.py"
+
+
+def _array_tainted_names(scope: ast.AST) -> set[str]:
+    """Names assigned (directly or transitively) from array-materializing
+    calls inside ``scope`` — one forward pass, JL002-style."""
+    tainted: set[str] = set()
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Assign):
+            continue
+        from_array = any(
+            isinstance(n, ast.Call) and _dotted(n.func) in ARRAY_SOURCE_CALLS
+            for n in ast.walk(node.value))
+        if from_array or _mentions_tainted(node.value, tainted):
+            for target in node.targets:
+                # plain names (incl. tuple unpacking) only: a subscript or
+                # attribute store does not make its container an array
+                if isinstance(target, (ast.Tuple, ast.List)):
+                    tainted.update(t.id for t in target.elts
+                                   if isinstance(t, ast.Name))
+                elif isinstance(target, ast.Name):
+                    tainted.add(target.id)
+    return tainted
+
+
+def check_host_sort(tree: ast.AST, path: str) -> list[Finding]:
+    """JL011: serving/retrieval hot paths must not full-sort on host. The
+    banned calls rank every element of their input; over a device array
+    that also forces the whole corpus through a transfer first. Selection
+    runs on device (``jax.lax.top_k`` per block + streaming merge); only
+    the bounded per-partition candidate merge belongs on host, and that is
+    ``np.lexsort``'s job. Tests are exempt (oracles *should* argsort)."""
+    if not (_path_is_serve(path) or _path_is_retrieval(path)) \
+            or _path_is_test(path):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        if fname in HOST_SORT_CALLS:
+            findings.append(Finding(
+                "JL011", ERROR, path, node.lineno,
+                f"{fname}() full-sorts on host in a serving/retrieval hot "
+                f"path — rank on device with jax.lax.top_k (streaming "
+                f"merge for big corpora); np.lexsort over a bounded "
+                f"candidate set is the sanctioned host-side final merge, "
+                f"or justify with # jaxlint: disable=JL011"))
+    seen: set[int] = set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tainted = _array_tainted_names(fn)
+        if not tainted:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "sorted" and node.args \
+                    and _mentions_tainted(node.args[0], tainted) \
+                    and node.lineno not in seen:
+                seen.add(node.lineno)
+                findings.append(Finding(
+                    "JL011", ERROR, path, node.lineno,
+                    f"sorted() over array-derived data in `{fn.name}` "
+                    f"full-sorts on host in a serving/retrieval hot path "
+                    f"— use jax.lax.top_k on device (np.lexsort for "
+                    f"bounded final merges), or justify with "
+                    f"# jaxlint: disable=JL011"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -761,4 +863,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_jit_in_loop(tree, path)
     findings += check_block_size_literal(tree, path)
     findings += check_device_put_placement(tree, path)
+    findings += check_host_sort(tree, path)
     return findings

@@ -1,0 +1,30 @@
+"""On-TPU embedding & top-k retrieval platform.
+
+- :mod:`~jimm_tpu.retrieval.store` — persistent, incrementally-updatable
+  vector store (content-addressed segments + atomic manifests) with the
+  prompt-embedding LRU as its hot tier.
+- :mod:`~jimm_tpu.retrieval.topk` — exact streaming top-k scoring on
+  device (blocked matmul + running ``lax.top_k`` merge, corpus sharded
+  over the serving topology), AOT-warm and tune-resolved.
+- :mod:`~jimm_tpu.retrieval.api` — the service facade ``serve --index``
+  and ``/v1/search`` ride, plus the ``jimm_retrieval`` metric namespace.
+- :mod:`~jimm_tpu.retrieval.cli` — ``jimm-tpu index build|add|ls|verify``
+  (jax-free, like the aot/tune/obs CLIs).
+
+Importing this package never imports jax (the device program materializes
+inside function bodies), so the index CLI stays a pure-host tool.
+"""
+
+from jimm_tpu.retrieval.api import RetrievalService, retrieval_metrics
+from jimm_tpu.retrieval.store import (LoadedIndex, PersistentEmbeddingCache,
+                                      RetrievalStoreError, VectorStore,
+                                      normalize_rows)
+from jimm_tpu.retrieval.topk import (DEFAULT_BLOCK_N, IndexSearcher,
+                                     Searcher, merge_partials,
+                                     streaming_topk)
+
+__all__ = ["DEFAULT_BLOCK_N", "IndexSearcher", "LoadedIndex",
+           "PersistentEmbeddingCache", "RetrievalService",
+           "RetrievalStoreError", "Searcher", "VectorStore",
+           "merge_partials", "normalize_rows", "retrieval_metrics",
+           "streaming_topk"]

@@ -1,0 +1,142 @@
+"""Service facade gluing the vector store and the top-k searcher into the
+serving stack, plus the ``jimm_retrieval`` observability namespace.
+
+:class:`RetrievalService` is what ``serve --index`` constructs and
+:class:`~jimm_tpu.serve.server.ServingServer` consults for ``/v1/search``:
+it owns the loaded index, the warm :class:`~jimm_tpu.retrieval.topk
+.IndexSearcher`, and the metric series the obs docs list —
+
+- ``jimm_retrieval_search_total`` / ``jimm_retrieval_embed_total``
+  counters (embed counts rows, not requests: a bulk ``/v1/embed`` of 16
+  images is 16),
+- ``jimm_retrieval_index_size`` / ``jimm_retrieval_index_segments`` /
+  ``jimm_retrieval_index_staleness_seconds`` gauges (staleness = seconds
+  since the manifest last changed; a serving process holds the index
+  snapshot it loaded, so a growing staleness under active writers says
+  "restart or reload me"),
+- the ``retrieval_topk`` span around every scoring call (device scan +
+  host merge), which lands in ``jimm_spans_*`` like every other span.
+
+Everything here is callable from HTTP handler threads (blocking is fine;
+the engine's event loop is never entered) and from the CLI.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+from jimm_tpu.retrieval.store import LoadedIndex, VectorStore
+from jimm_tpu.retrieval.topk import IndexSearcher
+
+__all__ = ["RetrievalService", "retrieval_metrics"]
+
+
+def retrieval_metrics():
+    """The ``jimm_retrieval`` registry's (search_total, embed_total)
+    counters — shared by the service and the bulk-embed endpoint."""
+    from jimm_tpu import obs
+    reg = obs.get_registry("jimm_retrieval")
+    return reg.counter("search_total"), reg.counter("embed_total")
+
+
+class RetrievalService:
+    """One named index, searchable: loaded snapshot + warm searcher +
+    metrics. Built once at serve startup (``from_store``) or directly in
+    tests/benches with a pre-built searcher."""
+
+    def __init__(self, index: LoadedIndex, searcher: IndexSearcher, *,
+                 store: VectorStore | None = None):
+        from jimm_tpu import obs
+        self.index = index
+        self.searcher = searcher
+        self.store = store
+        self.search_counter, self.embed_counter = retrieval_metrics()
+        reg = obs.get_registry("jimm_retrieval")
+        reg.gauge("index_size", lambda: float(len(self.index)))
+        reg.gauge("index_segments", fn=self._segments_now)
+        reg.gauge("index_staleness_seconds", fn=self._staleness_now)
+
+    @classmethod
+    def from_store(cls, store: VectorStore, name: str, *, k: int = 10,
+                   buckets=(1,), block_n: int | None = None,
+                   plan: Any = None, aot_store: Any = None
+                   ) -> "RetrievalService":
+        index = store.load(name)
+        searcher = IndexSearcher(index, k=k, buckets=buckets,
+                                 block_n=block_n, plan=plan,
+                                 aot_store=aot_store)
+        return cls(index, searcher, store=store)
+
+    # -- gauges -----------------------------------------------------------
+
+    def _segments_now(self) -> float:
+        if self.store is None:
+            return 1.0
+        try:
+            return float(self.store.stats(self.index.name)["segments"])
+        except Exception:  # noqa: BLE001 — a gauge must never raise
+            return 0.0
+
+    def _staleness_now(self) -> float:
+        """Seconds since the *on-disk* manifest last changed — reads
+        through to the store so concurrent writers move this gauge even
+        though the serving snapshot is pinned."""
+        updated = self.index.updated
+        if self.store is not None:
+            try:
+                updated = float(
+                    self.store.manifest(self.index.name)["updated"])
+            except Exception:  # noqa: BLE001
+                pass
+        return max(0.0, round(time.time() - updated, 3))
+
+    # -- lifecycle --------------------------------------------------------
+
+    def warmup(self) -> dict[int, str]:
+        """Warm every (replica, bucket); the serve ready line and healthz
+        report the per-bucket sources."""
+        return self.searcher.warmup()
+
+    def trace_count(self) -> int:
+        return self.searcher.trace_count()
+
+    def describe(self) -> dict:
+        return {"index": self.index.name, "rows": len(self.index),
+                "dim": self.index.dim, "dtype": self.index.dtype,
+                "metric": self.index.metric, "k": self.searcher.k,
+                "block_n": self.searcher.block_n,
+                "buckets": list(self.searcher.buckets),
+                "partitions": len(self.searcher.searchers),
+                "staleness_s": self._staleness_now()}
+
+    # -- queries ----------------------------------------------------------
+
+    def search_blocking(self, queries: np.ndarray, k: int | None = None
+                        ) -> tuple[np.ndarray, list[list[str]]]:
+        """Top-k ids + scores for a ``(D,)`` or ``(B, D)`` query batch.
+        ``k`` may trim below the searcher's compiled k but never exceed it
+        (the device program's carry width is fixed at build time). Call
+        from a handler thread or the CLI — this blocks on the device."""
+        from jimm_tpu import obs
+        from jimm_tpu.serve.admission import RequestError
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if queries.ndim != 2 or queries.shape[1] != self.index.dim:
+            raise RequestError(
+                f"query must have dim {self.index.dim} (index "
+                f"{self.index.name!r}); got shape {tuple(queries.shape)}")
+        if not np.all(np.isfinite(queries)):
+            raise RequestError("query contains non-finite values")
+        k_eff = self.searcher.k if k is None else int(k)
+        if k_eff < 1 or k_eff > self.searcher.k:
+            raise RequestError(
+                f"k must be in [1, {self.searcher.k}] (the searcher's "
+                f"compiled carry width); got {k_eff}")
+        with obs.span("retrieval_topk"):
+            values, _indices, ids = self.searcher.search(queries)
+        self.search_counter.inc(queries.shape[0])
+        return values[:, :k_eff], [row[:k_eff] for row in ids]

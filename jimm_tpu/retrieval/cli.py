@@ -1,0 +1,215 @@
+"""``jimm-tpu index`` — manage retrieval vector stores from the shell.
+
+Pure-host tooling in the aot/tune/obs CLI mold: no jax import anywhere on
+these paths, so ``index build|add|ls|verify`` run on any machine that can
+see the store directory (an ops box, a CI runner) without an accelerator
+stack. Vectors come in as ``.npy`` matrices with ids from a text/JSON
+sidecar, or as seeded synthetic data (``--random``) for smoke tests and
+benches.
+
+    jimm-tpu index build  --store ./idx corpus --dim 512 --random 10000
+    jimm-tpu index add    --store ./idx corpus --from-npy embs.npy --ids ids.txt
+    jimm-tpu index ls     --store ./idx
+    jimm-tpu index verify --store ./idx
+    jimm-tpu index compact --store ./idx corpus
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from jimm_tpu.retrieval.store import (RetrievalStoreError, VectorStore,
+                                      normalize_rows)
+
+__all__ = ["add_index_parser", "main"]
+
+
+def _load_ids(path: str, n: int) -> list[str]:
+    """Ids sidecar: a JSON list, or one id per text line."""
+    text = Path(path).read_text()
+    try:
+        ids = json.loads(text)
+        if not isinstance(ids, list):
+            raise ValueError("ids JSON must be a list")
+    except ValueError:
+        ids = [line.strip() for line in text.splitlines() if line.strip()]
+    ids = [str(i) for i in ids]
+    if len(ids) != n:
+        raise SystemExit(f"{path} has {len(ids)} ids for {n} vectors")
+    return ids
+
+
+def _rows_from_args(args: argparse.Namespace, dim: int | None
+                    ) -> tuple[list[str], np.ndarray]:
+    if args.from_npy:
+        mat = np.load(args.from_npy)
+        if mat.ndim != 2:
+            raise SystemExit(f"{args.from_npy} must hold an (N, D) matrix; "
+                             f"got shape {mat.shape}")
+        ids = (_load_ids(args.ids, mat.shape[0]) if args.ids
+               else [f"{Path(args.from_npy).stem}:{i}"
+                     for i in range(mat.shape[0])])
+        return ids, mat
+    if args.random:
+        if dim is None:
+            raise SystemExit("--random needs --dim (or an existing index)")
+        rng = np.random.default_rng(args.seed)
+        mat = normalize_rows(rng.standard_normal((args.random, dim),
+                                                 dtype=np.float32))
+        return [f"rand:{args.seed}:{i}" for i in range(args.random)], mat
+    raise SystemExit("need --from-npy FILE (with optional --ids) or "
+                     "--random N")
+
+
+def _cmd_build(args: argparse.Namespace) -> int:
+    store = VectorStore(args.store)
+    dim = args.dim
+    if dim is None and args.from_npy:
+        dim = int(np.load(args.from_npy).shape[1])
+    if dim is None:
+        raise SystemExit("need --dim (or --from-npy to infer it)")
+    store.create(args.name, dim, dtype=args.dtype,
+                 exist_ok=args.exist_ok)
+    out = {"index": args.name, "dim": int(dim), "dtype": args.dtype}
+    if args.from_npy or args.random:
+        ids, mat = _rows_from_args(args, dim)
+        out["segment"] = store.add(args.name, ids, mat)[:12]
+        out["rows"] = len(ids)
+    print(json.dumps(out))
+    return 0
+
+
+def _cmd_add(args: argparse.Namespace) -> int:
+    store = VectorStore(args.store)
+    man = store.manifest(args.name)
+    ids, mat = _rows_from_args(args, int(man["dim"]))
+    fp = store.add(args.name, ids, mat)
+    print(json.dumps({"index": args.name, "segment": fp[:12],
+                      "rows": len(ids),
+                      "total_rows": store.stats(args.name)["rows"]}))
+    return 0
+
+
+def _cmd_ls(args: argparse.Namespace) -> int:
+    store = VectorStore(args.store)
+    rows = store.ls()
+    if args.json:
+        print(json.dumps(rows, indent=1))
+        return 0
+    if not rows:
+        print(f"no indexes under {args.store}")
+        return 0
+    print(f"{'name':24s} {'rows':>8s} {'dim':>6s} {'dtype':10s} "
+          f"{'segs':>5s} {'dead':>6s} {'bytes':>12s}")
+    for r in rows:
+        print(f"{r['name']:24s} {r['rows']:8d} {r['dim']:6d} "
+              f"{r['dtype']:10s} {r['segments']:5d} {r['dead_rows']:6d} "
+              f"{r['bytes']:12d}")
+    return 0
+
+
+def _cmd_verify(args: argparse.Namespace) -> int:
+    store = VectorStore(args.store)
+    problems = store.verify(args.name)
+    for p in problems:
+        print(json.dumps(p))
+    summary = {"indexes": len([args.name] if args.name else store.names()),
+               "problems": len(problems)}
+    print(json.dumps(summary))
+    return 1 if problems else 0
+
+
+def _cmd_compact(args: argparse.Namespace) -> int:
+    store = VectorStore(args.store)
+    report = store.compact(args.name)
+    print(json.dumps({"index": args.name, **report}))
+    return 0
+
+
+def cmd_index(args: argparse.Namespace) -> int:
+    try:
+        return args.index_func(args)
+    except RetrievalStoreError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+def add_index_parser(subparsers) -> None:
+    """Register ``jimm-tpu index ...`` on the main CLI."""
+    p = subparsers.add_parser(
+        "index", help="manage retrieval vector indexes (no jax needed)")
+    p.set_defaults(fn=cmd_index)
+    sub = p.add_subparsers(dest="index_cmd", required=True)
+
+    def _store_flag(sp):
+        sp.add_argument("--store", required=True,
+                        help="vector store root directory")
+
+    sp = sub.add_parser("build", help="create an index (optionally "
+                                      "seeding rows)")
+    _store_flag(sp)
+    sp.add_argument("name", help="index name")
+    sp.add_argument("--dim", type=int, default=None,
+                    help="embedding dimension (inferred from --from-npy)")
+    sp.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    sp.add_argument("--from-npy", default=None,
+                    help="seed rows from an (N, D) .npy matrix")
+    sp.add_argument("--ids", default=None,
+                    help="ids sidecar for --from-npy (JSON list or one id "
+                         "per line; default: derived from the file name)")
+    sp.add_argument("--random", type=int, default=None, metavar="N",
+                    help="seed N synthetic unit vectors (smoke/bench)")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--exist-ok", action="store_true",
+                    help="reuse an existing index instead of failing")
+    sp.set_defaults(index_func=_cmd_build)
+
+    sp = sub.add_parser("add", help="append rows to an index")
+    _store_flag(sp)
+    sp.add_argument("name")
+    sp.add_argument("--from-npy", default=None)
+    sp.add_argument("--ids", default=None)
+    sp.add_argument("--random", type=int, default=None, metavar="N")
+    sp.add_argument("--seed", type=int, default=1)
+    sp.set_defaults(index_func=_cmd_add)
+
+    sp = sub.add_parser("ls", help="list indexes with row/segment stats")
+    _store_flag(sp)
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    sp.set_defaults(index_func=_cmd_ls)
+
+    sp = sub.add_parser("verify",
+                        help="re-validate manifests + segment payloads "
+                             "(bad segments quarantine; exit 1 on problems)")
+    _store_flag(sp)
+    sp.add_argument("name", nargs="?", default=None,
+                    help="one index (default: all)")
+    sp.set_defaults(index_func=_cmd_verify)
+
+    sp = sub.add_parser("compact",
+                        help="fold live rows into one segment and drop "
+                             "tombstoned bytes")
+    _store_flag(sp)
+    sp.add_argument("name")
+    sp.set_defaults(index_func=_cmd_compact)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Standalone entry point (``python -m jimm_tpu.retrieval.cli``)."""
+    parser = argparse.ArgumentParser(prog="jimm-tpu-index")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    add_index_parser(sub)
+    args = parser.parse_args(["index", *(argv if argv is not None
+                                         else sys.argv[1:])])
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

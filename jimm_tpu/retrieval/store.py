@@ -1,0 +1,537 @@
+"""Persistent, incrementally-updatable vector store (host side, no jax).
+
+An *index* is a named, append-only set of immutable **segments** plus a
+tombstone list. Each segment is one content-addressed payload (header JSON
++ raw row-major vector bytes) living in an
+:class:`~jimm_tpu.aot.store.ArtifactStore` — which supplies the durability
+contract the AOT subsystem already proved out: atomic tempdir +
+``os.replace`` writes, per-read SHA-256 integrity, quarantine-never-delete
+on corruption, and multi-process safety. The per-index **manifest**
+(``indexes/<name>.json``) lists segment fingerprints and deleted ids and is
+itself replaced atomically, so a crashed writer can never leave an index a
+reader would half-trust.
+
+Mutation model (simple and crash-safe, like an LSM without levels):
+
+- ``add``     writes one new segment, then swaps in a manifest that
+  references it. Rows are L2-normalized before persisting (the ``cosine``
+  metric is a dot product over unit rows — exactly what
+  ``retrieval/topk.py`` scores on device).
+- ``delete``  only touches the manifest (tombstones); segment bytes are
+  immutable.
+- ``compact`` folds every live row into one fresh segment, clears the
+  tombstones, and drops the now-unreferenced segment entries.
+
+The **hot tier** is the same LRU that ``serve/cache.py`` introduced for
+prompt embeddings: loaded index matrices are memoized in an
+:class:`~jimm_tpu.serve.cache.EmbeddingCache` keyed by the manifest state
+hash (any add/delete/compact changes the key, so a stale matrix can never
+serve), and :class:`PersistentEmbeddingCache` generalizes the zero-shot
+class-weight cache into LRU-over-disk: repeat label sets hit host RAM
+within a process and the artifact store across process restarts.
+
+No jax import anywhere in this module: ``jimm-tpu index build|add|ls|
+verify`` stay pure-host tools, like the aot/tune/obs CLIs. bfloat16
+matrices use ``ml_dtypes`` (a numpy extension jax already depends on),
+loaded lazily and only when an index asks for bf16.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+import time
+from pathlib import Path
+from typing import Callable, Sequence
+
+import numpy as np
+
+from jimm_tpu.aot.store import ArtifactStore
+from jimm_tpu.serve.cache import EmbeddingCache
+
+__all__ = ["LoadedIndex", "PersistentEmbeddingCache", "RetrievalStoreError",
+           "RETRIEVAL_FORMAT_VERSION", "VectorStore"]
+
+#: bump when the segment payload framing or manifest schema changes —
+#: old entries then fail loudly instead of decoding garbage
+RETRIEVAL_FORMAT_VERSION = 1
+
+#: vector stores hold data, not derived artifacts: the backing
+#: ArtifactStore's LRU eviction must effectively never fire, so the default
+#: cap is far above any realistic corpus (override via max_bytes for tests)
+VECTOR_STORE_MAX_BYTES = 1 << 40
+
+_DTYPES = ("float32", "bfloat16")
+
+
+class RetrievalStoreError(RuntimeError):
+    """Index-level failure: unknown index, schema mismatch, or a segment
+    that failed integrity validation (already quarantined)."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "float32":
+        return np.dtype(np.float32)
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    raise RetrievalStoreError(
+        f"unsupported vector dtype {name!r}; choose from {_DTYPES}")
+
+
+def normalize_rows(vectors: np.ndarray) -> np.ndarray:
+    """Unit-L2 rows in float32 (zero rows stay zero instead of NaN)."""
+    mat = np.asarray(vectors, np.float32)
+    norms = np.linalg.norm(mat, axis=-1, keepdims=True)
+    return mat / np.where(norms == 0.0, 1.0, norms)
+
+
+def encode_segment(ids: Sequence[str], vectors: np.ndarray,
+                   dtype: str) -> bytes:
+    """Frame one segment payload: header JSON line + raw row bytes."""
+    mat = np.ascontiguousarray(np.asarray(vectors, _np_dtype(dtype)))
+    header = {"retrieval_format": RETRIEVAL_FORMAT_VERSION,
+              "ids": list(ids), "rows": int(mat.shape[0]),
+              "dim": int(mat.shape[1]), "dtype": dtype}
+    return json.dumps(header, sort_keys=True,
+                      separators=(",", ":")).encode() + b"\n" + mat.tobytes()
+
+
+def decode_segment(payload: bytes) -> tuple[list[str], np.ndarray]:
+    """Inverse of :func:`encode_segment`; raises RetrievalStoreError on any
+    framing/shape inconsistency (the caller quarantines)."""
+    head, sep, body = payload.partition(b"\n")
+    if not sep:
+        raise RetrievalStoreError("segment payload has no header line")
+    try:
+        header = json.loads(head)
+    except ValueError as e:
+        raise RetrievalStoreError(f"bad segment header: {e}") from None
+    if header.get("retrieval_format") != RETRIEVAL_FORMAT_VERSION:
+        raise RetrievalStoreError(
+            f"segment retrieval_format {header.get('retrieval_format')!r} "
+            f"!= {RETRIEVAL_FORMAT_VERSION}")
+    dtype = _np_dtype(header["dtype"])
+    rows, dim = int(header["rows"]), int(header["dim"])
+    expected = rows * dim * dtype.itemsize
+    if len(body) != expected:
+        raise RetrievalStoreError(
+            f"segment body is {len(body)} bytes, header promises {expected}")
+    ids = [str(s) for s in header["ids"]]
+    if len(ids) != rows:
+        raise RetrievalStoreError(
+            f"segment has {len(ids)} ids for {rows} rows")
+    mat = np.frombuffer(body, dtype).reshape(rows, dim)
+    return ids, mat
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadedIndex:
+    """One index materialized on host: live ids + the (N, D) matrix.
+
+    ``state`` hashes the manifest's segment list and tombstones — it
+    changes on every mutation, so it keys the hot-tier cache and the
+    staleness gauges serving exposes.
+    """
+
+    name: str
+    ids: tuple[str, ...]
+    vectors: np.ndarray
+    dim: int
+    dtype: str
+    metric: str
+    state: str
+    updated: float
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    def matrix_f32(self) -> np.ndarray:
+        """Float32 view of the corpus (the NumPy-oracle / scoring form)."""
+        return np.asarray(self.vectors, np.float32)
+
+
+class VectorStore:
+    """See module docstring. One root holds many named indexes plus the
+    persistent prompt-embedding tier; segment payloads share a single
+    content-addressed :class:`ArtifactStore`."""
+
+    def __init__(self, root: str | os.PathLike,
+                 max_bytes: int | None = None):
+        self.root = Path(root).expanduser()
+        self.artifacts = ArtifactStore(
+            self.root, max_bytes if max_bytes is not None
+            else VECTOR_STORE_MAX_BYTES)
+        self.indexes_dir = self.root / "indexes"
+        self.indexes_dir.mkdir(parents=True, exist_ok=True)
+        #: hot tier for loaded matrices — LRU keyed by (name, state) so a
+        #: mutated index can never serve a stale matrix
+        self.hot = EmbeddingCache(capacity=8)
+
+    # -- manifests --------------------------------------------------------
+
+    def _manifest_path(self, name: str) -> Path:
+        if not name or "/" in name or name.startswith("."):
+            raise RetrievalStoreError(f"bad index name {name!r}")
+        return self.indexes_dir / f"{name}.json"
+
+    def manifest(self, name: str) -> dict:
+        path = self._manifest_path(name)
+        try:
+            man = json.loads(path.read_text())
+        except FileNotFoundError:
+            raise RetrievalStoreError(
+                f"no index {name!r} under {self.root} (create it with "
+                f"`jimm-tpu index build`)") from None
+        except (OSError, ValueError) as e:
+            raise RetrievalStoreError(f"unreadable manifest for {name!r}: "
+                                      f"{e}") from None
+        if man.get("retrieval_format") != RETRIEVAL_FORMAT_VERSION:
+            raise RetrievalStoreError(
+                f"index {name!r} has retrieval_format "
+                f"{man.get('retrieval_format')!r}, this build reads "
+                f"{RETRIEVAL_FORMAT_VERSION}")
+        return man
+
+    def _write_manifest(self, name: str, man: dict) -> None:
+        man["updated"] = time.time()
+        path = self._manifest_path(name)
+        fd, tmp = tempfile.mkstemp(prefix=f".{name}-", dir=self.indexes_dir)
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(json.dumps(man, indent=1, sort_keys=True))
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def names(self) -> list[str]:
+        return sorted(p.stem for p in self.indexes_dir.glob("*.json"))
+
+    @staticmethod
+    def _state_hash(man: dict) -> str:
+        h = hashlib.sha256()
+        h.update(json.dumps(
+            {"segments": man.get("segments", []),
+             "tombstones": sorted(man.get("tombstones", []))},
+            sort_keys=True, separators=(",", ":")).encode())
+        return h.hexdigest()
+
+    # -- mutation ---------------------------------------------------------
+
+    def create(self, name: str, dim: int, *, dtype: str = "float32",
+               metric: str = "cosine", exist_ok: bool = False) -> dict:
+        if dtype not in _DTYPES:
+            raise RetrievalStoreError(
+                f"unsupported dtype {dtype!r}; choose from {_DTYPES}")
+        if metric != "cosine":
+            raise RetrievalStoreError(
+                f"unsupported metric {metric!r} (only 'cosine' for now)")
+        path = self._manifest_path(name)
+        if path.exists():
+            if exist_ok:
+                return self.manifest(name)
+            raise RetrievalStoreError(f"index {name!r} already exists")
+        man = {"retrieval_format": RETRIEVAL_FORMAT_VERSION, "name": name,
+               "dim": int(dim), "dtype": dtype, "metric": metric,
+               "created": time.time(), "segments": [], "tombstones": []}
+        self._write_manifest(name, man)
+        return man
+
+    def _live_ids(self, man: dict) -> set[str]:
+        dead = set(man.get("tombstones", []))
+        live: set[str] = set()
+        for seg in man.get("segments", []):
+            live.update(i for i in seg["ids"] if i not in dead)
+        return live
+
+    def add(self, name: str, ids: Sequence[str],
+            vectors: np.ndarray) -> str:
+        """Persist one batch of (id, vector) rows as a new segment and
+        reference it from the manifest. Returns the segment fingerprint.
+        Rows are unit-normalized; re-adding a tombstoned id revives it."""
+        man = self.manifest(name)
+        ids = [str(i) for i in ids]
+        mat = np.asarray(vectors)
+        if mat.ndim != 2 or mat.shape[0] != len(ids):
+            raise RetrievalStoreError(
+                f"vectors must be (len(ids), dim); got {mat.shape} for "
+                f"{len(ids)} ids")
+        if mat.shape[1] != man["dim"]:
+            raise RetrievalStoreError(
+                f"index {name!r} is dim {man['dim']}, vectors are dim "
+                f"{mat.shape[1]}")
+        if len(set(ids)) != len(ids):
+            raise RetrievalStoreError("duplicate ids within one add() batch")
+        if not ids:
+            raise RetrievalStoreError("add() needs at least one row")
+        clashes = self._live_ids(man) & set(ids)
+        if clashes:
+            raise RetrievalStoreError(
+                f"ids already live in index {name!r}: "
+                f"{sorted(clashes)[:5]}{'...' if len(clashes) > 5 else ''} "
+                f"(delete them first)")
+        if not np.all(np.isfinite(np.asarray(mat, np.float32))):
+            raise RetrievalStoreError("vectors contain non-finite values")
+        payload = encode_segment(ids, normalize_rows(mat), man["dtype"])
+        fp = hashlib.sha256(payload).hexdigest()
+        self.artifacts.put(fp, payload,
+                           meta={"label": f"retrieval:{name}",
+                                 "kind": "segment", "rows": len(ids),
+                                 "dim": int(man["dim"]),
+                                 "vector_dtype": man["dtype"],
+                                 "retrieval_format":
+                                     RETRIEVAL_FORMAT_VERSION})
+        man["segments"] = list(man.get("segments", [])) + [
+            {"fingerprint": fp, "rows": len(ids), "ids": ids}]
+        man["tombstones"] = sorted(set(man.get("tombstones", []))
+                                   - set(ids))
+        self._write_manifest(name, man)
+        return fp
+
+    def delete(self, name: str, ids: Sequence[str]) -> int:
+        """Tombstone ``ids``; returns how many were live. Segment bytes are
+        untouched until ``compact``."""
+        man = self.manifest(name)
+        live = self._live_ids(man)
+        dead = [str(i) for i in ids if str(i) in live]
+        if dead:
+            man["tombstones"] = sorted(set(man.get("tombstones", []))
+                                       | set(dead))
+            self._write_manifest(name, man)
+        return len(dead)
+
+    def compact(self, name: str) -> dict:
+        """Fold live rows into one segment, clear tombstones, and drop the
+        old segment entries. Returns a {segments_before/after, rows,
+        reclaimed_bytes} report."""
+        man = self.manifest(name)
+        loaded = self.load(name)
+        before = list(man.get("segments", []))
+        reclaimed = 0
+        new_segments = []
+        if len(loaded):
+            payload = encode_segment(list(loaded.ids), loaded.vectors,
+                                     man["dtype"])
+            fp = hashlib.sha256(payload).hexdigest()
+            self.artifacts.put(fp, payload,
+                               meta={"label": f"retrieval:{name}",
+                                     "kind": "segment",
+                                     "rows": len(loaded),
+                                     "dim": int(man["dim"]),
+                                     "vector_dtype": man["dtype"],
+                                     "retrieval_format":
+                                         RETRIEVAL_FORMAT_VERSION})
+            new_segments = [{"fingerprint": fp, "rows": len(loaded),
+                             "ids": list(loaded.ids)}]
+        man["segments"] = new_segments
+        man["tombstones"] = []
+        self._write_manifest(name, man)
+        keep = {s["fingerprint"] for s in new_segments}
+        for seg in before:
+            if seg["fingerprint"] in keep:
+                continue
+            entry = self.artifacts.entry_dir(seg["fingerprint"])
+            if entry.exists():
+                reclaimed += sum(p.stat().st_size
+                                 for p in entry.rglob("*") if p.is_file())
+                shutil.rmtree(entry, ignore_errors=True)
+        return {"segments_before": len(before),
+                "segments_after": len(new_segments),
+                "rows": len(loaded), "reclaimed_bytes": reclaimed}
+
+    # -- read -------------------------------------------------------------
+
+    def _read_segment(self, name: str, fingerprint: str
+                      ) -> tuple[list[str], np.ndarray]:
+        payload = self.artifacts.get(fingerprint)
+        if payload is None:
+            raise RetrievalStoreError(
+                f"index {name!r} references segment "
+                f"{fingerprint[:12]}... which is missing or failed "
+                f"integrity checks (see {self.artifacts.quarantine_dir})")
+        try:
+            return decode_segment(payload)
+        except RetrievalStoreError:
+            self.artifacts.quarantine(fingerprint,
+                                      "segment payload failed to decode")
+            raise
+
+    def load(self, name: str) -> LoadedIndex:
+        """Materialize an index on host; hot-tier memoized by manifest
+        state so repeat loads of an unmutated index are a dict probe."""
+        man = self.manifest(name)
+        state = self._state_hash(man)
+        dtype = _np_dtype(man["dtype"])
+        cache_key = f"index:{name}:{state}"
+        cached = self.hot.get(cache_key)
+        if cached is not None:
+            ids, mat = cached
+        else:
+            dead = set(man.get("tombstones", []))
+            # a re-added id leaves its stale row in the older segment; the
+            # newest segment mentioning an id owns it, older copies are dead
+            owner: dict[str, int] = {}
+            for si, seg in enumerate(man.get("segments", [])):
+                for sid in seg["ids"]:
+                    owner[sid] = si
+            id_list: list[str] = []
+            parts: list[np.ndarray] = []
+            for si, seg in enumerate(man.get("segments", [])):
+                seg_ids, seg_mat = self._read_segment(name,
+                                                      seg["fingerprint"])
+                keep = [i for i, sid in enumerate(seg_ids)
+                        if sid not in dead and owner.get(sid) == si]
+                if keep:
+                    id_list.extend(seg_ids[i] for i in keep)
+                    parts.append(seg_mat[keep])
+            mat = (np.concatenate(parts, axis=0) if parts
+                   else np.zeros((0, man["dim"]), dtype))
+            ids = tuple(id_list)
+            # EmbeddingCache stores "np.ndarray"s; an (ids, matrix) object
+            # array rides fine through get/put, skipping asarray coercion
+            self.hot.put(cache_key, (ids, mat))  # type: ignore[arg-type]
+        return LoadedIndex(name=name, ids=tuple(ids), vectors=mat,
+                           dim=int(man["dim"]), dtype=man["dtype"],
+                           metric=man["metric"], state=state,
+                           updated=float(man.get("updated",
+                                                 man.get("created", 0.0))))
+
+    def stats(self, name: str) -> dict:
+        man = self.manifest(name)
+        segs = man.get("segments", [])
+        total_rows = sum(int(s["rows"]) for s in segs)
+        live = len(self._live_ids(man))
+        nbytes = 0
+        for seg in segs:
+            entry = self.artifacts.entry_dir(seg["fingerprint"])
+            art = entry / "artifact.bin"
+            if art.is_file():
+                nbytes += art.stat().st_size
+        return {"name": name, "rows": live, "dim": int(man["dim"]),
+                "dtype": man["dtype"], "metric": man["metric"],
+                "segments": len(segs), "dead_rows": total_rows - live,
+                "tombstones": len(man.get("tombstones", [])),
+                "bytes": nbytes,
+                "updated": float(man.get("updated",
+                                         man.get("created", 0.0)))}
+
+    def ls(self) -> list[dict]:
+        return [self.stats(name) for name in self.names()]
+
+    def verify(self, name: str | None = None) -> list[dict]:
+        """Re-validate manifests and segment payloads; quarantine bad
+        segments. Returns one problem record per issue (empty == healthy).
+        """
+        problems: list[dict] = []
+        names = [name] if name is not None else self.names()
+        for nm in names:
+            try:
+                man = self.manifest(nm)
+            except RetrievalStoreError as e:
+                problems.append({"index": nm, "reason": str(e)})
+                continue
+            for seg in man.get("segments", []):
+                fp = seg["fingerprint"]
+                payload = self.artifacts.get(fp)
+                reason = None
+                if payload is None:
+                    reason = ("segment missing or failed store integrity "
+                              "(quarantined)")
+                else:
+                    try:
+                        seg_ids, seg_mat = decode_segment(payload)
+                    except RetrievalStoreError as e:
+                        reason = str(e)
+                        self.artifacts.quarantine(fp, reason)
+                    else:
+                        if seg_ids != [str(s) for s in seg["ids"]]:
+                            reason = "segment ids disagree with manifest"
+                        elif seg_mat.shape[1] != man["dim"]:
+                            reason = (f"segment dim {seg_mat.shape[1]} != "
+                                      f"index dim {man['dim']}")
+                        if reason:
+                            self.artifacts.quarantine(fp, reason)
+                if reason:
+                    problems.append({"index": nm, "segment": fp,
+                                     "reason": reason})
+        return problems
+
+    # -- prompt-embedding tier --------------------------------------------
+
+    def prompt_cache(self, capacity: int = 32) -> "PersistentEmbeddingCache":
+        """The persistent generalization of ``serve.cache
+        .class_embedding_cache()``: LRU hot tier in front of this store, so
+        repeat zero-shot label sets skip the text tower across process
+        restarts, not just within one process."""
+        return PersistentEmbeddingCache(self, capacity=capacity)
+
+
+class PersistentEmbeddingCache:
+    """Two-tier embedding matrix cache: ``serve/cache.py``'s LRU in host
+    RAM, this package's content-addressed store on disk. Same
+    ``get``/``put``/``get_or_build`` surface as :class:`EmbeddingCache`, so
+    the classify CLI and the zero-shot serving path swap it in unchanged.
+    """
+
+    def __init__(self, store: VectorStore, capacity: int = 32):
+        self.store = store
+        self.hot = EmbeddingCache(capacity=capacity)
+        self.disk_hits = 0
+        self.disk_misses = 0
+
+    @staticmethod
+    def _fingerprint(key: str) -> str:
+        return hashlib.sha256(b"prompt-embedding:"
+                              + key.encode()).hexdigest()
+
+    def get(self, key: str) -> np.ndarray | None:
+        value = self.hot.get(key)
+        if value is not None:
+            return value
+        payload = self.store.artifacts.get(self._fingerprint(key))
+        if payload is None:
+            self.disk_misses += 1
+            return None
+        try:
+            _ids, mat = decode_segment(payload)
+        except RetrievalStoreError:
+            self.disk_misses += 1
+            return None
+        self.disk_hits += 1
+        mat = np.asarray(mat, np.float32)
+        self.hot.put(key, mat)
+        return mat
+
+    def put(self, key: str, value: np.ndarray) -> None:
+        mat = np.asarray(value, np.float32)
+        self.hot.put(key, mat)
+        payload = encode_segment([str(i) for i in range(mat.shape[0])],
+                                 mat, "float32")
+        self.store.artifacts.put(self._fingerprint(key), payload,
+                                 meta={"kind": "prompt_embedding",
+                                       "rows": int(mat.shape[0]),
+                                       "retrieval_format":
+                                           RETRIEVAL_FORMAT_VERSION})
+
+    def get_or_build(self, key: str,
+                     builder: Callable[[], np.ndarray]) -> np.ndarray:
+        value = self.get(key)
+        if value is not None:
+            return value
+        value = np.asarray(builder(), np.float32)
+        self.put(key, value)
+        return value
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hot.hit_rate
+
+    def stats(self) -> dict:
+        return {**self.hot.stats(), "disk_hits": self.disk_hits,
+                "disk_misses": self.disk_misses}

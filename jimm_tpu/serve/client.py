@@ -131,6 +131,15 @@ class ServeClient:
             payload["timeout_s"] = timeout_s
         return self._request("POST", "/v1/embed", payload)["features"]
 
+    def embed_many(self, images, timeout_s: float | None = None) -> list:
+        """Bulk embed: one request, one ``features`` row per image. The
+        server submits each image individually so the engine coalesces the
+        burst into its warm buckets."""
+        payload = {"images": [encode_image_payload(img) for img in images]}
+        if timeout_s is not None:
+            payload["timeout_s"] = timeout_s
+        return self._request("POST", "/v1/embed", payload)["features"]
+
     def classify(self, image, tokens: dict,
                  timeout_s: float | None = None) -> dict:
         """``tokens``: ``{label: [ids]}`` (or ``{label: [[ids], ...]}`` for
@@ -141,3 +150,23 @@ class ServeClient:
         if timeout_s is not None:
             payload["timeout_s"] = timeout_s
         return self._request("POST", "/v1/classify", payload)
+
+    def search(self, *, vector=None, image=None, k: int | None = None,
+               timeout_s: float | None = None) -> dict:
+        """Top-k over the server's retrieval index. Pass a raw ``vector``
+        (searched directly) or an ``image`` (embedded through the engine
+        first). Returns ``{"ids", "scores", "index", "k", "trace_id"}``."""
+        if (vector is None) == (image is None):
+            raise ValueError("search needs exactly one of vector= or "
+                             "image=")
+        if vector is not None:
+            payload: dict = {"vector": (vector.astype("float32").tolist()
+                                        if hasattr(vector, "astype")
+                                        else list(vector))}
+        else:
+            payload = encode_image_payload(image)
+        if k is not None:
+            payload["k"] = int(k)
+        if timeout_s is not None:
+            payload["timeout_s"] = timeout_s
+        return self._request("POST", "/v1/search", payload)

@@ -9,9 +9,15 @@ Endpoints::
 
     GET  /healthz      liveness + queue depth / fill ratio snapshot
     GET  /metrics      Prometheus text exposition (jimm_serve_* series)
-    POST /v1/embed     {"image": [[...]]} -> {"features": [...]}
+    POST /v1/embed     {"image": [[...]]} -> {"features": [...]}; bulk form
+                       {"images": [img, ...]} -> {"features": [[...], ...]}
+                       (each image submits individually, so the engine
+                       coalesces the burst into its warm buckets)
     POST /v1/classify  {"image": ..., "tokens": {label: [ids]}}
                        -> {"scores": {label: p}, "cached": bool}
+    POST /v1/search    {"vector": [...]} or {"image": ...} (embedded via
+                       the engine first), optional "k" -> {"ids",
+                       "scores"} from the named retrieval index
 
 Images ride as nested JSON lists or as ``{"image_b64": base64(raw float32),
 "shape": [H, W, C]}`` (the client picks b64 when it can). Typed
@@ -171,6 +177,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, app.embed(payload))
             elif self.path == "/v1/classify":
                 self._send_json(200, app.classify(payload))
+            elif self.path == "/v1/search":
+                self._send_json(200, app.search(payload))
             else:
                 self._send_json(404, {"error": "not_found",
                                       "message": self.path})
@@ -193,11 +201,14 @@ class ServingServer:
 
     def __init__(self, engine: InferenceEngine, *,
                  zero_shot: ZeroShotService | None = None,
+                 retrieval=None,
                  host: str = "127.0.0.1", port: int = 0,
                  request_timeout_s: float = 30.0, warmup: bool = True,
                  metrics_logger=None, metrics_log_every_s: float = 10.0):
         self.engine = engine
         self.zero_shot = zero_shot
+        #: optional jimm_tpu.retrieval.RetrievalService backing /v1/search
+        self.retrieval = retrieval
         self.metrics: ServeMetrics = engine.metrics
         if zero_shot is not None:
             self.metrics.bind_gauge("cache_hit_rate",
@@ -224,6 +235,8 @@ class ServingServer:
             return
         if self._warmup:
             self.engine.warmup_blocking()
+            if self.retrieval is not None:
+                self.retrieval.warmup()
         loop = asyncio.new_event_loop()
         started = threading.Event()
 
@@ -301,11 +314,62 @@ class ServingServer:
                                trace_id=trace_id), self._loop)
         return future.result(timeout=self.request_timeout_s)
 
+    def _submit_many(self, images: list, timeout_s,
+                     trace_id: str) -> list[np.ndarray]:
+        """Submit a burst of single-item requests at once so the engine's
+        batcher coalesces them into its warm buckets — the bulk-embed path
+        rides the exact same admission/dispatch machinery as singles."""
+        assert self._loop is not None
+        futures = [asyncio.run_coroutine_threadsafe(
+            self.engine.submit(image, timeout_s=timeout_s,
+                               trace_id=f"{trace_id}.{i}"), self._loop)
+            for i, image in enumerate(images)]
+        return [f.result(timeout=self.request_timeout_s) for f in futures]
+
     def embed(self, payload: dict) -> dict:
         rid = new_trace_id()
+        if "images" in payload:
+            raw = payload["images"]
+            if not isinstance(raw, list) or not raw:
+                raise RequestError("'images' must be a non-empty list")
+            images = [decode_image_payload(
+                item if isinstance(item, dict) else {"image": item},
+                dtype=self.engine.dtype) for item in raw]
+            features = self._submit_many(images, payload.get("timeout_s"),
+                                         rid)
+            from jimm_tpu.retrieval.api import retrieval_metrics
+            retrieval_metrics()[1].inc(len(images))
+            return {"features": [np.asarray(f, np.float32).tolist()
+                                 for f in features],
+                    "count": len(features), "trace_id": rid}
         image = decode_image_payload(payload, dtype=self.engine.dtype)
         features = self._submit(image, payload.get("timeout_s"), rid)
         return {"features": np.asarray(features, np.float32).tolist(),
+                "trace_id": rid}
+
+    def search(self, payload: dict) -> dict:
+        """Top-k over the configured retrieval index: a raw query vector
+        searches directly; an image embeds through the engine first (same
+        buckets, admission, and replica dispatch as ``/v1/embed``)."""
+        if self.retrieval is None:
+            raise RequestError("this server has no retrieval index "
+                               "(start with serve --index)")
+        rid = new_trace_id()
+        if "vector" in payload:
+            try:
+                query = np.asarray(payload["vector"], np.float32)
+            except (TypeError, ValueError) as e:
+                raise RequestError(f"bad 'vector' payload: {e}") from None
+        else:
+            image = decode_image_payload(payload, dtype=self.engine.dtype)
+            query = np.asarray(
+                self._submit(image, payload.get("timeout_s"), rid),
+                np.float32)
+        values, ids = self.retrieval.search_blocking(query,
+                                                     k=payload.get("k"))
+        return {"index": self.retrieval.index.name,
+                "k": len(ids[0]), "ids": ids[0],
+                "scores": [round(float(v), 6) for v in values[0]],
                 "trace_id": rid}
 
     def classify(self, payload: dict) -> dict:
@@ -360,4 +424,6 @@ class ServingServer:
         # replica cold/stuck?" is answerable from a health probe
         if getattr(self.engine, "_multi", False):
             out["replicas"] = self.engine.replica_stats()
+        if self.retrieval is not None:
+            out["retrieval"] = self.retrieval.describe()
         return out

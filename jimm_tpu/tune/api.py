@@ -26,7 +26,7 @@ from typing import Any, Callable, Mapping, Sequence
 from jimm_tpu import obs
 from jimm_tpu.tune.cache import TuneCache, TuneKey, tune_key
 from jimm_tpu.tune.measure import measure
-from jimm_tpu.tune.space import flash_space, ln_space
+from jimm_tpu.tune.space import flash_space, ln_space, retrieval_space
 
 __all__ = ["KERNELS", "KernelSpec", "best_config", "configure", "get_cache",
            "tune_kernel"]
@@ -96,6 +96,37 @@ def _ln_bench(shapes: Shapes, dtypes: Dtypes,
     return lambda: step(x, scale, bias)
 
 
+def _retrieval_default(shapes: Shapes, dtypes: Dtypes) -> dict:
+    from jimm_tpu.retrieval.topk import DEFAULT_BLOCK_N
+    candidates = retrieval_space(shapes, dtypes)
+    feasible = {c["block_n"] for c in candidates}
+    return {"block_n": (DEFAULT_BLOCK_N if DEFAULT_BLOCK_N in feasible
+                        else max(feasible))}
+
+
+def _retrieval_bench(shapes: Shapes, dtypes: Dtypes,
+                     config: Mapping[str, int]) -> Callable[[], Any]:
+    """Timed closure: one streaming top-k pass at the candidate block over
+    a synthetic normalized corpus shaped like the live one. Explicit
+    block_n bypasses the tuner — no recursion."""
+    import jax
+    import numpy as np
+
+    from jimm_tpu.retrieval.topk import corpus_layout, make_topk_fn
+    batch, dim = int(shapes[0][-2]), int(shapes[0][-1])
+    n_rows = int(shapes[-1][-2])
+    dt = np.dtype(dtypes[-1]) if dtypes else np.dtype(np.float32)
+    rng = np.random.default_rng(0)
+    corpus = np.asarray(rng.standard_normal((n_rows, dim),
+                                            dtype=np.float32), dt)
+    queries = rng.standard_normal((batch, dim), dtype=np.float32)
+    blocks, offsets, valid = corpus_layout(
+        corpus, block_n=int(config["block_n"]))
+    step = jax.jit(make_topk_fn(10))
+    valid = np.int32(valid)
+    return lambda: step(blocks, offsets, valid, queries)
+
+
 @dataclasses.dataclass(frozen=True)
 class KernelSpec:
     """One tunable kernel: identity, search space, fallback, and bench."""
@@ -112,6 +143,9 @@ KERNELS: dict[str, KernelSpec] = {
                                   bench=_flash_bench),
     "layer_norm": KernelSpec(version=1, space=ln_space,
                              default=_ln_default, bench=_ln_bench),
+    "retrieval_topk": KernelSpec(version=1, space=retrieval_space,
+                                 default=_retrieval_default,
+                                 bench=_retrieval_bench),
 }
 
 

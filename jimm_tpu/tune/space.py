@@ -12,8 +12,10 @@ from __future__ import annotations
 
 from typing import Any, Sequence
 
-__all__ = ["FLASH_BLOCKS", "LN_BLOCK_ROWS", "VMEM_BUDGET", "flash_space",
-           "flash_vmem_bytes", "kernel_space", "ln_space", "ln_vmem_bytes"]
+__all__ = ["FLASH_BLOCKS", "LN_BLOCK_ROWS", "RETRIEVAL_BLOCK_N",
+           "VMEM_BUDGET", "flash_space", "flash_vmem_bytes", "kernel_space",
+           "ln_space", "ln_vmem_bytes", "retrieval_space",
+           "retrieval_vmem_bytes"]
 
 _LANES = 128
 _SUBLANES = 8
@@ -29,6 +31,12 @@ FLASH_BLOCKS = (128, 256, 512)
 #: LN row-block candidates — sublane-aligned, from minimum tile to the
 #: point where the (block_rows, features) fp32 working set dominates VMEM
 LN_BLOCK_ROWS = (8, 16, 32, 64, 128, 256, 512)
+
+#: corpus-block candidates for the streaming top-k scan — lane-aligned so
+#: the (block_n, D) corpus tile and (B, block_n) score tile both land on
+#: 128-lane boundaries; larger blocks amortize the per-step top_k merge,
+#: smaller ones cap the resident score tile
+RETRIEVAL_BLOCK_N = (128, 256, 512, 1024, 2048, 4096)
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -87,7 +95,34 @@ def ln_space(shapes: Sequence[Sequence[int]],
     return out or [{"block_rows": LN_BLOCK_ROWS[0]}]
 
 
-_SPACES = {"flash_attention": flash_space, "layer_norm": ln_space}
+def retrieval_vmem_bytes(block_n: int, dim: int, batch: int = 64) -> int:
+    """Coarse resident working set of one streaming top-k scan step: the
+    f32-upcast corpus block, the query tile, and the (batch, block_n)
+    score tile — doubled for the pipeline's in-flight block."""
+    fp_d = _ceil_to(dim, _LANES)
+    return 2 * (block_n * fp_d * 4 + batch * fp_d * 4
+                + batch * block_n * 4)
+
+
+def retrieval_space(shapes: Sequence[Sequence[int]],
+                    dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Feasible ``{"block_n"}`` candidates for a top-k workload shaped
+    ``[(batch, dim), (n_rows, dim)]``. Blocks past the 128-padded corpus
+    are redundant (one padded block already covers every row)."""
+    batch, dim = int(shapes[0][-2]), int(shapes[0][-1])
+    n_rows = int(shapes[-1][-2])
+    out = []
+    for bn in RETRIEVAL_BLOCK_N:
+        if bn > _ceil_to(max(n_rows, 1), _LANES) and out:
+            continue
+        if retrieval_vmem_bytes(bn, dim, batch) > VMEM_BUDGET:
+            continue
+        out.append({"block_n": bn})
+    return out or [{"block_n": RETRIEVAL_BLOCK_N[0]}]
+
+
+_SPACES = {"flash_attention": flash_space, "layer_norm": ln_space,
+           "retrieval_topk": retrieval_space}
 
 
 def kernel_space(kernel: str, shapes: Sequence[Sequence[int]],

@@ -1,0 +1,188 @@
+"""CI tier-1 smoke for the on-TPU retrieval platform (docs/retrieval.md).
+
+Forces 8 virtual CPU devices, builds a 10k-vector index, and proves the
+whole retrieval path end to end in one process:
+
+1. **Store + plan**: a tmp :class:`VectorStore` gets 10,000 unit rows;
+   ``plan_topology(2, 2)`` splits the corpus across 2 replicas (each a
+   2-device model-parallel submesh). ``block_n=128`` is pinned so the
+   corpus is >= 64x the block size — the streaming scan is exercised for
+   real, never a one-block degenerate.
+2. **Life 1**: a :class:`RetrievalService` against a tmp AOT store warms
+   every (replica, bucket); write-through populates the store.
+3. **Warm restart**: a second service over the same stores reaches
+   readiness with ZERO fresh traces and every bucket sourced ``"aot"`` —
+   sharded top-k executables round-trip across process lives.
+4. **Recall**: the warm service's top-10 against a NumPy oracle on 128
+   queries — recall@10 must be exactly 1.0 (score ties tolerated).
+5. **Load**: 64 concurrent clients in a closed loop against a live
+   ``/v1/search`` endpoint — every request answered, zero post-warmup
+   recompiles on either the searcher or the serving engine.
+
+Exits nonzero (with a JSON error line) on any violation.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m scripts.retrieval_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from concurrent.futures import ThreadPoolExecutor
+
+ROWS = 10_000
+DIM = 64
+K = 10
+BLOCK_N = 128          # 10_000 >= 64 * 128: the scan streams ~79 blocks
+REPLICAS = 2
+MODEL_PARALLEL = 2
+RECALL_QUERIES = 128
+CLIENTS = 64
+PER_CLIENT = 2
+TIE_EPS = 1e-5
+
+
+def fail(msg: str) -> int:
+    print(json.dumps({"metric": "retrieval_smoke", "value": 0.0,
+                      "error": msg}), flush=True)
+    return 1
+
+
+def main() -> int:
+    # must land before jax initializes its backends
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+
+    import jax
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import CLIP, preset
+    from jimm_tpu.aot import ArtifactStore
+    from jimm_tpu.cli import _tiny_override
+    from jimm_tpu.retrieval import RetrievalService, VectorStore
+    from jimm_tpu.retrieval.store import normalize_rows
+    from jimm_tpu.serve import (BucketTable, InferenceEngine, ServeClient,
+                                ServingServer, counting_forward,
+                                plan_topology)
+
+    if jax.device_count() < REPLICAS * MODEL_PARALLEL:
+        return fail(f"need {REPLICAS * MODEL_PARALLEL} devices, have "
+                    f"{jax.device_count()} — was XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count=8 set before "
+                    f"another jax import?")
+
+    rng = np.random.RandomState(7)
+    corpus = normalize_rows(rng.standard_normal((ROWS, DIM)).astype(
+        np.float32))
+    ids = [f"doc{i:05d}" for i in range(ROWS)]
+    queries = normalize_rows(rng.standard_normal(
+        (RECALL_QUERIES, DIM)).astype(np.float32))
+    plan = plan_topology(REPLICAS, MODEL_PARALLEL)
+    buckets = (1, 8)
+
+    with tempfile.TemporaryDirectory(prefix="jimm-retrieval-smoke-") as root:
+        vstore = VectorStore(os.path.join(root, "index"))
+        vstore.create("corpus", DIM)
+        vstore.add("corpus", ids, corpus)
+        store = ArtifactStore(os.path.join(root, "aot"))
+
+        # --- life 1: populate the AOT store through warmup ---------------
+        svc1 = RetrievalService.from_store(
+            vstore, "corpus", k=K, buckets=buckets, block_n=BLOCK_N,
+            plan=plan, aot_store=store)
+        svc1.warmup()
+        if not store.entries():
+            return fail("life-1 warmup wrote nothing to the AOT store")
+
+        # --- warm restart: sharded top-k AOT round-trip -------------------
+        service = RetrievalService.from_store(
+            vstore, "corpus", k=K, buckets=buckets, block_n=BLOCK_N,
+            plan=plan, aot_store=store)
+        report = service.warmup()
+        if service.trace_count():
+            return fail(f"warm restart paid {service.trace_count()} fresh "
+                        f"traces; top-k artifacts did not round-trip")
+        bad = {b: s for b, s in report.items() if s != "aot"}
+        if bad:
+            return fail(f"warm restart buckets not fully AOT-sourced: {bad}")
+
+        # --- recall@10 against the NumPy oracle ---------------------------
+        # (host argsort is the *oracle*, not the serving path — the served
+        # path is the device scan + bounded lexsort merge under test)
+        oracle_scores = queries @ corpus.T
+        kth = np.sort(oracle_scores, axis=1)[:, -K]
+        hits = 0
+        for start in range(0, RECALL_QUERIES, buckets[-1]):
+            batch = queries[start:start + buckets[-1]]
+            values, id_rows = service.search_blocking(batch)
+            for qi, row in enumerate(id_rows):
+                q = start + qi
+                for rank, rid in enumerate(row):
+                    got = float(values[qi, rank])
+                    if got >= kth[q] - TIE_EPS and abs(
+                            got - oracle_scores[q, int(rid[3:])]) < 1e-4:
+                        hits += 1
+        recall = hits / (RECALL_QUERIES * K)
+        if recall != 1.0:
+            return fail(f"recall@{K} = {recall:.4f} != 1.0 over "
+                        f"{RECALL_QUERIES} queries")
+
+        # --- 64-client closed loop through a live /v1/search --------------
+        cfg = _tiny_override(preset("clip-vit-base-patch16"))
+        model = CLIP(cfg, rngs=nnx.Rngs(0))
+        size = cfg.vision.image_size
+        forward, traces = counting_forward(model, "encode_image")
+        engine = InferenceEngine(forward, item_shape=(size, size, 3),
+                                 buckets=BucketTable((1,)),
+                                 max_delay_ms=2.0, trace_count=traces)
+        server = ServingServer(engine, retrieval=service, port=0)
+        server.start()
+        try:
+            engine_traces = traces()
+            topk_traces = service.trace_count()
+
+            def one_client(seed: int) -> int:
+                client = ServeClient(port=server.port, timeout_s=60.0)
+                try:
+                    done = 0
+                    for j in range(PER_CLIENT):
+                        q = queries[(seed * PER_CLIENT + j)
+                                    % RECALL_QUERIES]
+                        out = client.search(vector=q, k=K)
+                        if len(out["ids"]) == K:
+                            done += 1
+                    return done
+                finally:
+                    client.close()
+
+            with ThreadPoolExecutor(max_workers=CLIENTS) as pool:
+                answered = sum(pool.map(one_client, range(CLIENTS)))
+            if answered != CLIENTS * PER_CLIENT:
+                return fail(f"only {answered}/{CLIENTS * PER_CLIENT} "
+                            f"searches answered")
+            topk_delta = service.trace_count() - topk_traces
+            engine_delta = traces() - engine_traces
+            if topk_delta or engine_delta:
+                return fail(f"post-warmup recompiles: searcher={topk_delta} "
+                            f"engine={engine_delta}")
+        finally:
+            server.stop()
+
+        print(json.dumps({
+            "metric": "retrieval_smoke", "value": 1.0,
+            "rows": ROWS, "dim": DIM, "k": K, "block_n": BLOCK_N,
+            "topology": plan.describe(),
+            "recall_at_10": recall,
+            "searches": answered,
+            "warm_restart": {str(b): s for b, s in sorted(report.items())},
+            "store_entries": len(store.entries()),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,12 @@ response of a fresh engine is measured twice — compiling everything from
 scratch, then again restarted against the AOT artifact store DIR populated
 in between (docs/aot.md) — and the paired result lands in the same ledger
 format, so the warm-start win shows up in the bench trajectory.
+
+``--search`` switches to the retrieval workload (docs/retrieval.md): the
+same closed loop drives ``search_blocking`` over a synthetic index at each
+``--corpus-sizes`` entry, recording QPS + client p50/p99 per corpus size.
+Every ledger row carries a ``workload`` field ("embed" / "search" /
+"cold_start") so the serving trajectories stay separable in one file.
 """
 
 from __future__ import annotations
@@ -200,6 +206,7 @@ def bench_cold_start(args) -> dict:
                    else "serve_cold_start (cpu smoke)"),
         "value": round(cold_s / warm_s, 2) if warm_s else 0.0,
         "unit": "x speedup (ttfr cold/aot)",
+        "workload": "cold_start",
         "model": name + (":tiny" if (args.tiny or not on_tpu) else ""),
         "buckets": list(buckets.sizes),
         "ttfr_cold_s": round(cold_s, 3),
@@ -212,6 +219,91 @@ def bench_cold_start(args) -> dict:
         "replicas": 1,
         "model_parallel": 1,
     }
+
+
+def bench_search(args) -> tuple[list[dict], str | None]:
+    """Closed-loop ``search_blocking`` load at each corpus size. Returns
+    (ledger rows, first violation or None). The index is synthetic and
+    in-memory — this measures the scan + merge + dispatch path, not store
+    I/O — but the searcher is the real serving one, topology included."""
+    import concurrent.futures
+
+    import jax
+    import numpy as np
+
+    from jimm_tpu.obs import Histogram
+    from jimm_tpu.retrieval import RetrievalService
+    from jimm_tpu.retrieval.store import LoadedIndex, normalize_rows
+    from jimm_tpu.retrieval.topk import IndexSearcher
+    from jimm_tpu.serve import plan_topology
+
+    on_tpu = jax.default_backend() == "tpu"
+    plan = plan_topology(args.replicas, args.model_parallel)
+    dim = args.dim or (512 if on_tpu else 64)
+    sizes = [int(s) for s in args.corpus_sizes.split(",")]
+    clients = args.clients
+    per_client = max(1, (args.requests or 16 * clients) // clients)
+    total = per_client * clients
+    rng = np.random.RandomState(0)
+    queries = normalize_rows(
+        rng.standard_normal((clients, dim)).astype(np.float32))
+
+    recs: list[dict] = []
+    error = None
+    for n in sizes:
+        corpus = normalize_rows(
+            rng.standard_normal((n, dim)).astype(np.float32))
+        index = LoadedIndex(
+            name=f"bench{n}", ids=tuple(f"r{i}" for i in range(n)),
+            vectors=corpus, dim=dim, dtype="float32", metric="cosine",
+            state=f"bench{n}", updated=time.time())
+        searcher = IndexSearcher(index, k=args.k, buckets=(1,),
+                                 block_n=args.block_n, plan=plan)
+        service = RetrievalService(index, searcher)
+        service.warmup()
+        compiles_before = service.trace_count()
+        latency = Histogram("search_latency_seconds", window=max(total, 1))
+
+        def one_client(ci):
+            q = queries[ci % clients]
+            done = 0
+            for _ in range(per_client):
+                t0 = time.perf_counter()
+                service.search_blocking(q)
+                latency.observe(time.perf_counter() - t0)
+                done += 1
+            return done
+
+        t0 = time.monotonic()
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=clients) as pool:
+            done = sum(pool.map(one_client, range(clients)))
+        dt = time.monotonic() - t0
+        compile_delta = service.trace_count() - compiles_before
+        recs.append({
+            "metric": "search_qps" if on_tpu else "search_qps (cpu smoke)",
+            "value": round(done / dt, 2),
+            "unit": "searches/sec",
+            "workload": "search",
+            "corpus_rows": n,
+            "dim": dim,
+            "k": args.k,
+            "block_n": searcher.block_n,
+            "clients": clients,
+            "requests": total,
+            "p50_ms": round(latency.percentile(50) * 1e3, 3),
+            "p99_ms": round(latency.percentile(99) * 1e3, 3),
+            "compile_count_delta": compile_delta,
+            "n_devices": plan.n_devices,
+            "replicas": plan.replicas,
+            "model_parallel": plan.model_parallel,
+        })
+        if error is None and done != total:
+            error = f"corpus {n}: only {done}/{total} searches completed"
+        if error is None and compile_delta:
+            error = (f"corpus {n}: {compile_delta} recompile(s) after "
+                     f"warmup")
+    return recs, error
 
 
 def main() -> int:
@@ -245,7 +337,37 @@ def main() -> int:
                    help="benchmark cold-start time-to-first-response "
                         "without vs. with a populated AOT artifact store "
                         "at this path (skips the load loop)")
+    p.add_argument("--search", action="store_true",
+                   help="benchmark the retrieval search workload instead "
+                        "of embedding (one ledger row per corpus size)")
+    p.add_argument("--corpus-sizes", default="1000,10000",
+                   help='comma-separated index sizes for --search, e.g. '
+                        '"10000,100000,1000000"')
+    p.add_argument("--dim", type=int, default=None,
+                   help="embedding dim for --search (default: 512 on TPU, "
+                        "64 off-TPU)")
+    p.add_argument("--k", type=int, default=10,
+                   help="top-k width for --search")
+    p.add_argument("--block-n", type=int, default=None,
+                   help="corpus block size for --search (default: the "
+                        "tuner's best_config)")
     args = p.parse_args()
+
+    if args.search:
+        recs, error = bench_search(args)
+        for rec in recs:
+            print(json.dumps(rec), flush=True)
+        if args.record:
+            from scripts._measurements import MEASUREMENTS
+            ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            with open(MEASUREMENTS, "a") as f:
+                for rec in recs:
+                    f.write(json.dumps(
+                        {"ts": ts, "phase": "serve_bench", **rec}) + "\n")
+        if error:
+            print(json.dumps({"error": error}), flush=True)
+            return 1
+        return 0
 
     if args.aot:
         rec = bench_cold_start(args)
@@ -306,6 +428,7 @@ def main() -> int:
         "metric": ("serve_rps" if on_tpu else "serve_rps (cpu smoke)"),
         "value": round(done / dt, 2),
         "unit": "requests/sec",
+        "workload": "embed",
         "mode": "http" if args.http else "engine",
         "model": name + (":tiny" if (args.tiny or not on_tpu) else ""),
         "clients": args.clients,

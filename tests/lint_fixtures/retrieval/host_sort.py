@@ -1,0 +1,24 @@
+"""JL011 fixture: host-side full sorts in retrieval/serving hot paths."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def rank_everything(scores_dev):
+    scores = np.asarray(scores_dev)        # host copy of the device scores
+    order = np.argsort(-scores)            # JL011: full argsort on host
+    ranked = np.sort(scores)               # JL011: full sort on host
+    worst = jnp.argsort(scores)            # JL011: jnp alias, same sort
+    top = sorted(scores)                   # JL011: sorted() on array data
+    return order, ranked, worst, top
+
+
+def ok_paths(partial_vals, partial_idx, report):
+    vals = np.asarray(partial_vals)
+    # ok: lexsort over the bounded per-partition candidate set is the
+    # sanctioned host-side final merge
+    order = np.lexsort((partial_idx, -vals))
+    # ok: sorted() over plain python data (no array taint on `report`)
+    rows = sorted(report.items())
+    # ok: a justified deliberate host sort
+    pinned = np.argsort(vals)  # jaxlint: disable=JL011 tiny fixed-size set
+    return order, rows, pinned

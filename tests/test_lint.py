@@ -204,6 +204,33 @@ class TestRuleFixtures:
         assert check_device_put_placement(
             tree, "jimm_tpu/weights/loader.py") == []
 
+    def test_jl011_host_sort(self):
+        findings = findings_for("retrieval/host_sort.py")
+        assert rules_and_lines(findings) == {
+            ("JL011", 8),   # np.argsort over host copy of device scores
+            ("JL011", 9),   # np.sort
+            ("JL011", 10),  # jnp.argsort
+            ("JL011", 11),  # sorted() over array-derived data
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert any("lax.top_k" in f.message for f in findings)
+        # np.lexsort over bounded candidates, sorted() on plain python
+        # data, and the suppressed deliberate sort (lines 16-25) stay clean
+
+    def test_jl011_scoped_to_serve_and_retrieval_paths(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_host_sort
+        src = "import numpy as np\norder = np.argsort(-scores)\n"
+        tree = ast.parse(src)
+        assert check_host_sort(tree, "jimm_tpu/serve/server.py") != []
+        assert check_host_sort(tree, "jimm_tpu/retrieval/topk.py") != []
+        # elsewhere a host sort is unexceptional (CLI display, training
+        # eval), and test oracles *should* argsort
+        assert check_host_sort(tree, "jimm_tpu/cli.py") == []
+        assert check_host_sort(tree, "jimm_tpu/train/loop.py") == []
+        assert check_host_sort(tree, "tests/test_retrieval.py") == []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings

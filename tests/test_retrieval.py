@@ -1,0 +1,524 @@
+"""jimm_tpu.retrieval: vector store, streaming top-k, sharded search, and
+the /v1/search + bulk /v1/embed serving surface.
+
+The parity tests compare the device program against a stable NumPy argsort
+oracle — including at the awkward shapes (corpus not a multiple of the
+block, k larger than the block, exact score ties) where a blocked merge is
+easiest to get wrong. The sharded tests run the same corpus over a 2x2
+replica topology on the 8 virtual CPU devices and require bit-identical
+results plus an AOT-warm second life with zero traces.
+"""
+
+import concurrent.futures
+
+import numpy as np
+import pytest
+
+from jimm_tpu.retrieval import (IndexSearcher, PersistentEmbeddingCache,
+                                RetrievalService, RetrievalStoreError,
+                                Searcher, VectorStore, merge_partials,
+                                normalize_rows, streaming_topk)
+from jimm_tpu.retrieval.store import decode_segment, encode_segment
+
+
+def oracle_topk(queries, corpus, k):
+    """Reference ranking: full scores + stable argsort (ties -> lowest
+    global index first), the order the streaming merge must reproduce."""
+    scores = (np.asarray(queries, np.float32)
+              @ np.asarray(corpus, np.float32).T)
+    order = np.argsort(-scores, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(scores, order, axis=1), order
+
+
+def unit_rows(n, d, seed=0):
+    rng = np.random.RandomState(seed)
+    return normalize_rows(rng.randn(n, d).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+class TestVectorStore:
+    def test_create_add_load_roundtrip(self, tmp_path):
+        store = VectorStore(tmp_path)
+        store.create("idx", 16)
+        vecs = unit_rows(10, 16)
+        store.add("idx", [f"a{i}" for i in range(10)], vecs)
+        store.add("idx", [f"b{i}" for i in range(5)], unit_rows(5, 16, 1))
+        index = store.load("idx")
+        assert len(index) == 15
+        assert index.ids[:10] == tuple(f"a{i}" for i in range(10))
+        assert np.allclose(index.matrix_f32()[:10], vecs, atol=1e-6)
+        # rows come back unit-normalized even if the caller's weren't
+        store.add("idx", ["big"], np.full((1, 16), 3.0, np.float32))
+        mat = store.load("idx").matrix_f32()
+        assert np.allclose(np.linalg.norm(mat, axis=1), 1.0, atol=1e-5)
+
+    def test_rejections(self, tmp_path):
+        store = VectorStore(tmp_path)
+        store.create("idx", 8)
+        with pytest.raises(RetrievalStoreError, match="duplicate"):
+            store.add("idx", ["x", "x"], unit_rows(2, 8))
+        store.add("idx", ["x"], unit_rows(1, 8))
+        with pytest.raises(RetrievalStoreError, match="already live"):
+            store.add("idx", ["x"], unit_rows(1, 8))
+        with pytest.raises(RetrievalStoreError, match="dim"):
+            store.add("idx", ["y"], unit_rows(1, 4))
+        with pytest.raises(RetrievalStoreError, match="non-finite"):
+            store.add("idx", ["y"], np.full((1, 8), np.nan, np.float32))
+        with pytest.raises(RetrievalStoreError):
+            store.create("idx", 8)  # exists, no exist_ok
+        store.create("idx", 8, exist_ok=True)
+        with pytest.raises(RetrievalStoreError):
+            store.load("missing")
+        for bad in ("a/b", ".hidden"):
+            with pytest.raises(RetrievalStoreError):
+                store.create(bad, 8)
+
+    def test_delete_tombstones_then_readd(self, tmp_path):
+        store = VectorStore(tmp_path)
+        store.create("idx", 8)
+        store.add("idx", ["a", "b", "c"], unit_rows(3, 8))
+        assert store.delete("idx", ["b", "nope"]) == 1
+        index = store.load("idx")
+        assert index.ids == ("a", "c")
+        assert store.stats("idx")["dead_rows"] == 1
+        # a tombstoned id can be re-added with a fresh vector
+        fresh = unit_rows(1, 8, seed=9)
+        store.add("idx", ["b"], fresh)
+        index = store.load("idx")
+        assert index.ids == ("a", "c", "b")
+        assert np.allclose(index.matrix_f32()[2], fresh[0], atol=1e-6)
+
+    def test_compact_reclaims_and_preserves(self, tmp_path):
+        store = VectorStore(tmp_path)
+        store.create("idx", 8)
+        for s in range(4):
+            store.add("idx", [f"s{s}.{i}" for i in range(6)],
+                      unit_rows(6, 8, seed=s))
+        store.delete("idx", [f"s1.{i}" for i in range(6)])
+        before = store.load("idx")
+        report = store.compact("idx")
+        assert report["segments_before"] == 4
+        assert report["segments_after"] == 1
+        assert report["rows"] == 18
+        assert report["reclaimed_bytes"] > 0
+        after = store.load("idx")
+        assert after.ids == before.ids
+        assert np.allclose(after.matrix_f32(), before.matrix_f32())
+        assert store.stats("idx")["dead_rows"] == 0
+
+    def test_bf16_storage(self, tmp_path):
+        store = VectorStore(tmp_path)
+        store.create("idx", 8, dtype="bfloat16")
+        vecs = unit_rows(4, 8)
+        store.add("idx", list("abcd"), vecs)
+        index = store.load("idx")
+        assert index.dtype == "bfloat16"
+        assert np.allclose(index.matrix_f32(), vecs, atol=1e-2)
+
+    def test_segment_codec_rejects_bad_framing(self):
+        payload = encode_segment(["a"], unit_rows(1, 8), "float32")
+        ids, mat = decode_segment(payload)
+        assert ids == ["a"] and mat.shape == (1, 8)
+        with pytest.raises(RetrievalStoreError):
+            decode_segment(payload[:-3])  # truncated matrix bytes
+        with pytest.raises(RetrievalStoreError):
+            decode_segment(b"junk\n" + payload)
+
+    def test_corrupt_segment_quarantined(self, tmp_path):
+        store = VectorStore(tmp_path)
+        store.create("idx", 8)
+        fp = store.add("idx", ["a", "b"], unit_rows(2, 8))
+        entry = store.artifacts.entry_dir(fp)
+        for f in entry.iterdir():
+            if "meta" not in f.name:
+                f.write_bytes(b"\x00" * 64)
+        fresh = VectorStore(tmp_path)  # no hot-tier copy
+        with pytest.raises(RetrievalStoreError):
+            fresh.load("idx")
+        problems = VectorStore(tmp_path).verify()
+        assert problems and any(p["index"] == "idx" for p in problems)
+        qdir = fresh.artifacts.quarantine_dir
+        assert qdir.exists() and any(qdir.iterdir())
+
+    def test_ls_and_hot_tier_invalidation(self, tmp_path):
+        store = VectorStore(tmp_path)
+        store.create("one", 8)
+        store.add("one", ["a"], unit_rows(1, 8))
+        rows = store.ls()
+        assert [r["name"] for r in rows] == ["one"]
+        assert rows[0]["rows"] == 1
+        first = store.load("one")
+        # hot tier: same manifest state returns the same backing arrays
+        assert store.load("one").vectors is first.vectors
+        store.add("one", ["b"], unit_rows(1, 8, 1))
+        assert len(store.load("one")) == 2  # state changed -> reload
+
+
+class TestPersistentPromptCache:
+    def test_survives_process_restart(self, tmp_path):
+        cache = VectorStore(tmp_path).prompt_cache()
+        built = []
+
+        def build():
+            built.append(1)
+            return np.arange(6, dtype=np.float32).reshape(2, 3)
+
+        a = cache.get_or_build("clip:x:prompts", build)
+        b = cache.get_or_build("clip:x:prompts", build)
+        assert len(built) == 1 and np.allclose(a, b)
+        # a brand-new store instance = a restarted process: disk tier hits
+        cache2 = VectorStore(tmp_path).prompt_cache()
+        c = cache2.get_or_build("clip:x:prompts", build)
+        assert len(built) == 1
+        assert np.allclose(c, a)
+        assert cache2.disk_hits == 1
+        assert isinstance(cache2, PersistentEmbeddingCache)
+        assert cache2.get("never-seen") is None
+
+
+# ---------------------------------------------------------------------------
+# streaming top-k parity
+# ---------------------------------------------------------------------------
+
+class TestStreamingTopkParity:
+    def test_corpus_not_multiple_of_block(self):
+        corpus = unit_rows(1000, 24)
+        queries = unit_rows(4, 24, seed=3)
+        vals, idx = streaming_topk(queries, corpus, 10, block_n=128)
+        want_v, want_i = oracle_topk(queries, corpus, 10)
+        assert np.array_equal(idx, want_i)
+        assert np.allclose(vals, want_v, atol=1e-6)
+
+    def test_k_larger_than_block(self):
+        corpus = unit_rows(100, 16, seed=1)
+        queries = unit_rows(3, 16, seed=2)
+        vals, idx = streaming_topk(queries, corpus, 16, block_n=8)
+        want_v, want_i = oracle_topk(queries, corpus, 16)
+        assert np.array_equal(idx, want_i)
+        assert np.allclose(vals, want_v, atol=1e-6)
+
+    def test_k_exceeds_corpus(self):
+        corpus = unit_rows(5, 8)
+        vals, idx = streaming_topk(unit_rows(2, 8, 1), corpus, 9,
+                                   block_n=4)
+        assert np.all(idx[:, :5] >= 0)
+        assert np.all(idx[:, 5:] == -1)
+        assert np.all(np.isneginf(vals[:, 5:]))
+
+    def test_exact_ties_follow_stable_order(self):
+        base = unit_rows(7, 12, seed=4)
+        corpus = np.concatenate([base, base, base])  # every score x3
+        queries = unit_rows(2, 12, seed=5)
+        vals, idx = streaming_topk(queries, corpus, 9, block_n=5)
+        want_v, want_i = oracle_topk(queries, corpus, 9)
+        assert np.array_equal(idx, want_i)  # lowest global index wins ties
+        assert np.allclose(vals, want_v, atol=1e-6)
+
+    def test_merge_partials_matches_flat_oracle(self):
+        rng = np.random.RandomState(6)
+        vals = rng.randn(3, 2, 4).astype(np.float32)
+        idx = rng.permutation(100)[:24].reshape(3, 2, 4).astype(np.int64)
+        vals[1, 0, 2] = -np.inf
+        idx[1, 0, 2] = -1  # padding candidate must lose to everything
+        got_v, got_i = merge_partials(vals, idx, 5)
+        flat_v = vals.transpose(1, 0, 2).reshape(2, 12)
+        flat_i = idx.transpose(1, 0, 2).reshape(2, 12)
+        for b in range(2):
+            order = sorted(range(12),
+                           key=lambda j: (-flat_v[b, j],
+                                          flat_i[b, j] if flat_i[b, j] >= 0
+                                          else np.iinfo(np.int64).max))[:5]
+            assert list(got_i[b]) == [flat_i[b, j] for j in order]
+            assert np.allclose(got_v[b], [flat_v[b, j] for j in order])
+
+
+# ---------------------------------------------------------------------------
+# warm searchers: tune + AOT store integration
+# ---------------------------------------------------------------------------
+
+class TestSearcherWarmPaths:
+    def test_explicit_block_bypasses_tuner(self):
+        s = Searcher(unit_rows(300, 16), k=5, block_n=64)
+        assert s.block_n == 64
+
+    def test_tuner_space_registered(self):
+        from jimm_tpu.tune.api import KERNELS
+        from jimm_tpu.tune.space import retrieval_space
+        assert "retrieval_topk" in KERNELS
+        space = retrieval_space(shapes=[(8, 32), (10_000, 32)],
+                                dtypes=[np.dtype(np.float32)])
+        assert all(c["block_n"] >= 128 for c in space)
+        # tiny corpora don't get blocks wider than their (padded) rows
+        small = retrieval_space(shapes=[(8, 32), (100, 32)],
+                                dtypes=[np.dtype(np.float32)])
+        assert all(c["block_n"] <= 128 for c in small)
+
+    def test_aot_second_life_zero_traces(self, tmp_path):
+        from jimm_tpu.aot import ArtifactStore
+        corpus = unit_rows(500, 16, seed=7)
+        queries = unit_rows(4, 16, seed=8)
+        store = ArtifactStore(tmp_path / "aot")
+        life1 = Searcher(corpus, k=6, buckets=(4,), block_n=64,
+                         aot_store=store, label="t")
+        assert life1.warmup() == {4: "miss"}  # compiled + written through
+        assert life1.trace_count() >= 1
+        want_v, want_i = oracle_topk(queries, corpus, 6)
+        # second life: same shapes -> fully AOT-sourced, zero traces
+        life2 = Searcher(corpus, k=6, buckets=(4,), block_n=64,
+                         aot_store=store, label="t")
+        assert life2.warmup() == {4: "aot"}
+        vals, idx = life2.search_partial(queries)  # (S=1, B, k) partials
+        assert life2.trace_count() == 0
+        assert np.array_equal(idx[0], want_i)
+        assert np.allclose(vals[0], want_v, atol=1e-6)
+
+    def test_corrupt_artifact_degrades_to_fresh(self, tmp_path):
+        from jimm_tpu.aot import ArtifactStore
+        corpus = unit_rows(200, 16, seed=9)
+        store = ArtifactStore(tmp_path / "aot")
+        Searcher(corpus, k=4, buckets=(1,), block_n=64, aot_store=store,
+                 label="t").warmup()
+        fp = Searcher(corpus, k=4, buckets=(1,), block_n=64,
+                      aot_store=store, label="t").key_for(1).fingerprint()
+        entry = store.entry_dir(fp)
+        for f in entry.iterdir():
+            if "meta" not in f.name:
+                f.write_bytes(b"garbage")
+        s = Searcher(corpus, k=4, buckets=(1,), block_n=64,
+                     aot_store=store, label="t")
+        source = s.prepare(1)
+        assert source != "aot"  # bad payload must not be served
+        queries = unit_rows(2, 16, seed=10)
+        vals, idx = s.search_partial(queries)
+        want_v, want_i = oracle_topk(queries, corpus, 4)
+        assert np.array_equal(idx[0], want_i)
+        assert np.allclose(vals[0], want_v, atol=1e-6)
+
+    def test_bucket_padding_and_overflow_chunks(self):
+        corpus = unit_rows(128, 16, seed=11)
+        s = Searcher(corpus, k=3, buckets=(2, 4), block_n=64)
+        vals, idx = s.search_partial(unit_rows(3, 16, seed=12))
+        assert vals.shape[-2:] == (3, 3) and idx.shape[-2:] == (3, 3)
+        # past the max bucket: chunked through it, still exact
+        queries = unit_rows(9, 16, seed=13)
+        vals, idx = s.search_partial(queries)
+        want_v, want_i = oracle_topk(queries, corpus, 3)
+        assert np.array_equal(idx[0], want_i)
+        assert np.allclose(vals[0], want_v, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sharded vs unsharded parity over the PR 6 topology
+# ---------------------------------------------------------------------------
+
+class TestShardedParity:
+    @pytest.fixture()
+    def index(self, tmp_path):
+        store = VectorStore(tmp_path)
+        store.create("corpus", 32)
+        store.add("corpus", [f"v{i}" for i in range(700)],
+                  unit_rows(700, 32, seed=20))
+        return store.load("corpus")
+
+    def test_2x2_plan_matches_single_device(self, index, eight_devices):
+        from jimm_tpu.serve.topology import plan_topology
+        plan = plan_topology(2, 2)  # 2 replicas x (model=2) submeshes
+        flat = IndexSearcher(index, k=10, buckets=(1, 4), block_n=64)
+        sharded = IndexSearcher(index, k=10, buckets=(1, 4), block_n=64,
+                                plan=plan)
+        assert len(sharded.searchers) == 2
+        queries = np.random.RandomState(21).randn(4, 32).astype(np.float32)
+        fv, fi, fids = flat.search(queries)
+        sv, si, sids = sharded.search(queries)
+        assert np.array_equal(fi, si)
+        assert np.allclose(fv, sv, atol=1e-5)
+        assert fids == sids
+        assert fids[0][0] == f"v{fi[0, 0]}"
+
+    def test_sharded_aot_second_life(self, index, eight_devices, tmp_path):
+        from jimm_tpu.aot import ArtifactStore
+        from jimm_tpu.serve.topology import plan_topology
+        plan = plan_topology(2, 2)
+        store = ArtifactStore(tmp_path / "aot")
+        life1 = IndexSearcher(index, k=5, buckets=(4,), block_n=64,
+                              plan=plan, aot_store=store)
+        # replica 0 compiles + writes through; replica 1 shares the
+        # fingerprint (equal-padded partitions) and loads it -> "mixed"
+        assert life1.warmup()[4] in ("mixed", "miss")
+        life2 = IndexSearcher(index, k=5, buckets=(4,), block_n=64,
+                              plan=plan, aot_store=store)
+        assert life2.warmup() == {4: "aot"}
+        queries = np.random.RandomState(22).randn(3, 32).astype(np.float32)
+        sv, si, _ = life2.search(queries)
+        assert life2.trace_count() == 0
+        fv, fi, _ = IndexSearcher(index, k=5, buckets=(4,),
+                                  block_n=64).search(queries)
+        assert np.array_equal(fi, si)
+        assert np.allclose(fv, sv, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# service facade
+# ---------------------------------------------------------------------------
+
+class TestRetrievalService:
+    @pytest.fixture()
+    def service(self, tmp_path):
+        store = VectorStore(tmp_path)
+        store.create("idx", 16)
+        store.add("idx", [f"v{i}" for i in range(50)],
+                  unit_rows(50, 16, seed=30))
+        return RetrievalService.from_store(store, "idx", k=5, block_n=64)
+
+    def test_search_blocking_and_describe(self, service):
+        queries = np.random.RandomState(31).randn(2, 16)
+        values, ids = service.search_blocking(queries, k=3)
+        assert values.shape == (2, 3)
+        assert all(len(row) == 3 for row in ids)
+        assert np.all(np.diff(values, axis=1) <= 1e-6)  # sorted desc
+        d = service.describe()
+        assert d["index"] == "idx" and d["rows"] == 50 and d["k"] == 5
+        one_v, one_ids = service.search_blocking(queries[0])  # (D,) form
+        assert one_v.shape == (1, 5) and len(one_ids[0]) == 5
+
+    def test_request_validation(self, service):
+        from jimm_tpu.serve.admission import RequestError
+        with pytest.raises(RequestError, match="dim"):
+            service.search_blocking(np.zeros((1, 7), np.float32))
+        with pytest.raises(RequestError, match="non-finite"):
+            service.search_blocking(np.full((1, 16), np.inf, np.float32))
+        with pytest.raises(RequestError, match="k must be"):
+            service.search_blocking(np.zeros((1, 16), np.float32), k=9)
+        with pytest.raises(RequestError, match="k must be"):
+            service.search_blocking(np.zeros((1, 16), np.float32), k=0)
+
+    def test_metrics_and_gauges(self, service):
+        from jimm_tpu import obs
+        before = obs.get_registry("jimm_retrieval").counter(
+            "search_total").value
+        service.search_blocking(np.zeros((3, 16), np.float32))
+        snap = obs.snapshot()
+        assert snap["jimm_retrieval_search_total"] == before + 3
+        assert snap["jimm_retrieval_index_size"] == 50.0
+        assert snap["jimm_retrieval_index_segments"] == 1.0
+        assert snap["jimm_retrieval_index_staleness_seconds"] >= 0.0
+        # the retrieval_topk span lands as histogram series in jimm_spans
+        assert any("retrieval_topk" in k for k in snap)
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint integration (tiny CLIP + real index)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def search_server(tmp_path_factory):
+    from flax import nnx
+
+    from jimm_tpu import CLIP, preset
+    from jimm_tpu.cli import _tiny_override
+    from jimm_tpu.serve import (AdmissionPolicy, BucketTable,
+                                InferenceEngine, ServingServer,
+                                counting_forward)
+
+    cfg = _tiny_override(preset("clip-vit-base-patch16"))
+    model = CLIP(cfg, rngs=nnx.Rngs(0))
+    size = cfg.vision.image_size
+    dim = int(np.asarray(
+        model.encode_image(np.zeros((1, size, size, 3), np.float32))
+    ).shape[-1])
+    root = tmp_path_factory.mktemp("index-store")
+    vstore = VectorStore(root)
+    vstore.create("corpus", dim)
+    vstore.add("corpus", [f"doc{i}" for i in range(200)],
+               unit_rows(200, dim, seed=40))
+    retrieval = RetrievalService.from_store(vstore, "corpus", k=8,
+                                            block_n=64)
+    forward, traces = counting_forward(model, "encode_image")
+    engine = InferenceEngine(
+        forward, item_shape=(size, size, 3),
+        buckets=BucketTable((1, 4)), max_delay_ms=5.0,
+        policy=AdmissionPolicy(max_queue=256, default_timeout_s=30.0),
+        trace_count=traces)
+    server = ServingServer(engine, retrieval=retrieval, port=0)
+    server.start()
+    try:
+        yield server, model, traces, dim
+    finally:
+        server.stop()
+
+
+@pytest.fixture()
+def search_client(search_server):
+    from jimm_tpu.serve import ServeClient
+    server, _, _, _ = search_server
+    return ServeClient(port=server.port, timeout_s=60.0)
+
+
+class TestSearchEndpoint:
+    def test_vector_search(self, search_server, search_client):
+        _, _, _, dim = search_server
+        q = np.random.RandomState(41).randn(dim).astype(np.float32)
+        out = search_client.search(vector=q, k=4)
+        assert out["index"] == "corpus" and out["k"] == 4
+        assert len(out["ids"]) == 4 and len(out["scores"]) == 4
+        assert out["scores"] == sorted(out["scores"], reverse=True)
+        assert all(i.startswith("doc") for i in out["ids"])
+
+    def test_image_search_routes_through_engine(self, search_server,
+                                                search_client):
+        server, model, _, _ = search_server
+        img = np.random.RandomState(42).rand(
+            *server.engine.item_shape).astype(np.float32)
+        out = search_client.search(image=img)
+        feat = normalize_rows(np.asarray(model.encode_image(img[None]),
+                                         np.float32))
+        want, _ = oracle_topk(feat, server.retrieval.index.matrix_f32(), 1)
+        assert abs(out["scores"][0] - want[0, 0]) < 1e-4
+
+    def test_bulk_embed_counts_rows(self, search_server, search_client):
+        server, _, _, _ = search_server
+        imgs = [np.random.RandomState(50 + i).rand(
+            *server.engine.item_shape).astype(np.float32) for i in range(5)]
+        feats = search_client.embed_many(imgs)
+        assert len(feats) == 5
+        single = search_client.embed(imgs[0])
+        assert np.allclose(feats[0], single, atol=1e-4)
+        text = search_client.metrics_text()
+        assert "jimm_retrieval_embed_total" in text
+        assert "jimm_retrieval_search_total" in text
+        assert "jimm_retrieval_index_size 200" in text
+
+    def test_healthz_reports_retrieval(self, search_client):
+        h = search_client.healthz()
+        assert h["retrieval"]["index"] == "corpus"
+        assert h["retrieval"]["rows"] == 200
+
+    def test_concurrent_search_zero_recompiles(self, search_server,
+                                               search_client):
+        server, _, traces, dim = search_server
+        # prime both the engine buckets and the searcher bucket
+        search_client.search(vector=[0.0] * dim)
+        before = traces() + server.retrieval.trace_count()
+        rng = np.random.RandomState(43)
+        qs = [rng.randn(dim).astype(np.float32) for _ in range(64)]
+        with concurrent.futures.ThreadPoolExecutor(max_workers=16) as pool:
+            outs = list(pool.map(
+                lambda q: search_client.search(vector=q, k=2), qs))
+        assert len(outs) == 64
+        assert all(len(o["ids"]) == 2 for o in outs)
+        assert traces() + server.retrieval.trace_count() == before
+
+    def test_bad_requests(self, search_server, search_client):
+        from jimm_tpu.serve import ServeClientError
+        _, _, _, dim = search_server
+        with pytest.raises(ServeClientError) as ei:
+            search_client.search(vector=[1.0, 2.0])  # wrong dim
+        assert ei.value.code == "bad_request"
+        with pytest.raises(ServeClientError) as ei:
+            search_client.search(vector=[0.0] * dim, k=99)  # k > compiled
+        assert ei.value.code == "bad_request"
+        with pytest.raises(ValueError):
+            search_client.search()  # neither vector nor image
