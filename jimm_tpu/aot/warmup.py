@@ -82,16 +82,7 @@ class AotForward:
         #: bucket -> "aot" | "miss" | "fallback" (how it was warmed)
         self.sources: dict[int, str] = {}
         self._fresh, self.trace_count = counting_forward(model, method)
-        param_dtype = "unknown"
-        try:
-            import jax
-            from flax import nnx
-            leaves = jax.tree.leaves(nnx.state(model))
-            if leaves:
-                param_dtype = str(leaves[0].dtype)
-        except Exception:  # noqa: BLE001 — key quality, not correctness
-            pass
-        self._param_dtype = param_dtype
+        self._param_dtype = _model_param_dtype(model)
 
     # -- keys -------------------------------------------------------------
 
@@ -222,10 +213,21 @@ def warmup_store(model, *, method: str, buckets, item_shape,
 
 
 def _model_param_dtype(model) -> str:
+    """Aggregate dtype signature of the model's parameters: the sorted set
+    of leaf dtypes joined with "+" — "float32" for a plain model,
+    "float32+int8" for a quantized one. The first-leaf probe this replaces
+    made every mixed-precision model fingerprint identically to its fp32
+    twin, so an int8-quantized serve could adopt an fp32 artifact (and vice
+    versa). Single-dtype models produce the same string as before, keeping
+    existing artifact fingerprints valid."""
     try:
         import jax
         from flax import nnx
-        leaves = jax.tree.leaves(nnx.state(model))
-        return str(leaves[0].dtype) if leaves else "unknown"
-    except Exception:  # noqa: BLE001
+        # Param leaves only: RngState keys would tag every model with
+        # key<fry>+uint32 and churn existing store fingerprints
+        leaves = jax.tree.leaves(nnx.state(model, nnx.Param))
+        dtypes = {str(leaf.dtype) for leaf in leaves
+                  if hasattr(leaf, "dtype")}
+        return "+".join(sorted(dtypes)) if dtypes else "unknown"
+    except Exception:  # noqa: BLE001 — key quality, not correctness
         return "unknown"

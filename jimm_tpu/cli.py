@@ -252,6 +252,13 @@ def _tiny_override(cfg: Any) -> Any:
     raise TypeError(type(cfg))
 
 
+def _serve_dtype(args: argparse.Namespace) -> str:
+    """Resolve the serving precision from --dtype / the legacy --bf16."""
+    if getattr(args, "bf16", False) and args.dtype not in (None, "bf16"):
+        raise SystemExit(f"--bf16 conflicts with --dtype {args.dtype}")
+    return args.dtype or ("bf16" if getattr(args, "bf16", False) else "f32")
+
+
 # ---------------------------------------------------------------------------
 # Subcommands
 # ---------------------------------------------------------------------------
@@ -1286,7 +1293,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
         from jimm_tpu.tune import configure as tune_configure
         tune_configure(args.tune_cache)
 
-    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    serve_dtype = _serve_dtype(args)
+    # int8 builds/loads the model in f32, then quantizes in place below
+    dtype = jnp.bfloat16 if serve_dtype == "bf16" else jnp.float32
     if args.ckpt:
         fam = args.model or (_family(args.preset) if args.preset else None)
         if fam is None:
@@ -1304,7 +1313,18 @@ def cmd_serve(args: argparse.Namespace) -> int:
         model_key = f"{fam}:{args.preset}" + (":tiny" if args.tiny else "")
     else:
         raise SystemExit("need --ckpt (with --model) or --preset")
-    model_key += ":bf16" if args.bf16 else ":f32"
+    model_key += ":" + serve_dtype
+    if serve_dtype == "int8":
+        if args.model_parallel > 1:
+            raise SystemExit("--dtype int8 does not support "
+                             "--model-parallel > 1 yet (QuantLinear params "
+                             "carry no logical sharding axes); use data "
+                             "replicas")
+        # in-place Linear -> QuantLinear surgery BEFORE any forward is
+        # built, so the warm compiles (and AOT fingerprints, via the
+        # aggregate param_dtype) see the quantized model
+        from jimm_tpu.quant import quantize_model
+        quantize_model(model)
 
     method = "encode_image" if fam in ("clip", "siglip") else "__call__"
     size = model.config.vision.image_size
@@ -1332,8 +1352,11 @@ def cmd_serve(args: argparse.Namespace) -> int:
         trace_count = forward.trace_count
     else:
         forward, trace_count = counting_forward(model, method)
-    buckets = (BucketTable(tuple(int(s) for s in args.buckets.split(",")))
-               if args.buckets else default_buckets())
+    bucket_dtype = {"f32": "float32", "bf16": "bfloat16",
+                    "int8": "int8"}[serve_dtype]
+    buckets = (BucketTable(tuple(int(s) for s in args.buckets.split(",")),
+                           dtype=bucket_dtype)
+               if args.buckets else default_buckets(dtype=bucket_dtype))
     policy = AdmissionPolicy(max_queue=args.queue_size,
                              default_timeout_s=args.timeout_s,
                              shed_fraction=args.shed_fraction)
@@ -1370,7 +1393,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     server.start()
     ready = {"status": "serving", "host": args.host,
              "port": server.port, "model": model_key,
-             "buckets": list(buckets.sizes),
+             "buckets": list(buckets.sizes), "dtype": buckets.dtype,
              "warmup_s": round(time.monotonic() - t0, 3),
              "compile_count": trace_count()}
     if not plan.is_trivial:
@@ -1694,7 +1717,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="append metric snapshots as JSONL "
                          "(train/metrics.py format)")
     sp.add_argument("--metrics-every-s", type=float, default=10.0)
-    sp.add_argument("--bf16", action="store_true")
+    sp.add_argument("--bf16", action="store_true",
+                    help="legacy spelling of --dtype bf16")
+    sp.add_argument("--dtype", choices=["f32", "bf16", "int8"], default=None,
+                    help="serving precision (default f32). int8 quantizes "
+                         "the weights in place at startup (symmetric "
+                         "per-channel) and dispatches the fused Pallas "
+                         "int8 matmul path — docs/quantization.md")
     sp.add_argument("--aot-store", default=None,
                     help="consult this AOT artifact store before any "
                          "fresh compile (populate with `jimm-tpu aot "

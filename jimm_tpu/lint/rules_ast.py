@@ -52,6 +52,14 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   sets use ``np.lexsort`` (which is why lexsort is not banned).
   Deliberate host sorts carry a ``# jaxlint: disable=JL011``
   justification.
+- **JL012** silent float32/float64 upcast (``.astype(jnp.float32)`` /
+  ``jax.lax.convert_element_type(x, jnp.float32)``) in quantized ops
+  code outside a ``*dequant*``/``*quantize*``-named function — the int8
+  fast path wins by keeping operands int8 until the one fused dequant
+  at the accumulator; a stray upcast anywhere else re-materializes f32
+  tiles in VMEM and silently hands the MXU a f32 matmul. Rescales live
+  in ``_dequant``-style helpers (docs/quantization.md); deliberate
+  upcasts carry a ``# jaxlint: disable=JL012`` justification.
 """
 
 from __future__ import annotations
@@ -848,6 +856,91 @@ def check_host_sort(tree: ast.AST, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL012 — silent f32 upcast in quantized ops code
+# ---------------------------------------------------------------------------
+
+#: dtype leaf names whose appearance as an astype/convert target undoes the
+#: int8 storage win (bf16 stays allowed: mixed-precision epilogues are fine)
+_WIDE_FLOAT_DTYPES = frozenset({"float32", "float64"})
+
+#: substrings that sanction an enclosing function as THE dequant site — the
+#: one place per kernel where the int32 accumulator meets its scales
+_DEQUANT_NAME_MARKS = ("dequant", "quantize")
+
+
+def _path_is_quant_ops(path: str) -> bool:
+    """Quantization code: anything under a ``quant/`` package, plus ops
+    modules whose basename marks them as int8/quantized kernels."""
+    parts = path.replace("\\", "/").split("/")
+    if "quant" in parts[:-1]:
+        return True
+    base = parts[-1]
+    return "ops" in parts[:-1] and ("int8" in base or "quant" in base)
+
+
+def _wide_float_target(node: ast.expr) -> str | None:
+    """The float32/float64 name if ``node`` denotes one (dotted name like
+    ``jnp.float32`` or a ``"float32"`` string constant), else None."""
+    name = _dotted(node)
+    if name is not None:
+        leaf = name.rsplit(".", 1)[-1]
+        return leaf if leaf in _WIDE_FLOAT_DTYPES else None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value in _WIDE_FLOAT_DTYPES:
+        return node.value
+    return None
+
+
+def _in_dequant_function(node: ast.AST) -> bool:
+    cur: ast.AST | None = _parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+                mark in cur.name for mark in _DEQUANT_NAME_MARKS):
+            return True
+        cur = _parent(cur)
+    return False
+
+
+def check_quant_upcast(tree: ast.AST, path: str) -> list[Finding]:
+    """JL012: quantized ops keep everything int8 until the single fused
+    dequant — that is the whole bandwidth/MXU win. An ``.astype(f32)`` or
+    ``convert_element_type(x, f32)`` sprinkled anywhere else silently
+    rebuilds full-width tiles, and nothing crashes: the kernel just stops
+    being an int8 kernel. The sanctioned home for the rescale is a
+    function whose name says so (``_dequant*`` / ``*quantize*``);
+    deliberate upcasts elsewhere carry ``# jaxlint: disable=JL012``."""
+    if not _path_is_quant_ops(path) or _path_is_test(path):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = None
+        how = None
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "astype" and node.args:
+            target = _wide_float_target(node.args[0])
+            how = f".astype({target})"
+        else:
+            fname = _dotted(node.func)
+            if fname is not None \
+                    and fname.rsplit(".", 1)[-1] == "convert_element_type" \
+                    and len(node.args) >= 2:
+                target = _wide_float_target(node.args[1])
+                how = f"convert_element_type(..., {target})"
+        if target is None or _in_dequant_function(node):
+            continue
+        findings.append(Finding(
+            "JL012", ERROR, path, node.lineno,
+            f"{how} in quantized ops code outside a dequant/quantize "
+            f"helper silently re-materializes wide tiles and forfeits the "
+            f"int8 MXU path — keep the rescale in the fused _dequant "
+            f"epilogue (docs/quantization.md), or justify with "
+            f"# jaxlint: disable=JL012"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -864,4 +957,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_block_size_literal(tree, path)
     findings += check_device_put_placement(tree, path)
     findings += check_host_sort(tree, path)
+    findings += check_quant_upcast(tree, path)
     return findings

@@ -1,0 +1,226 @@
+"""Pallas TPU flash attention with int8-quantized Q/K — the serving variant.
+
+A registered low-precision variant of ``ops/flash_attention.py`` (the
+Flashlight template discipline: same grid layout, same online-softmax
+recurrence, same DMA-eliding causal index maps — only the score matmul
+changes). Q and K are quantized symmetrically per row at trace time
+(:func:`_quantize_heads`, scale = max|row|/127) so the (S, S) score matmul
+runs int8 x int8 -> int32 on the MXU at twice the bf16 rate; the int32
+scores dequantize through the per-row scale outer product inside
+:func:`_dequant_scores` (the one sanctioned f32 upcast — JL012), and the
+softmax + P@V accumulation stay in f32/storage dtype exactly as in the f32
+kernel. V is NOT quantized: the probability-weighted value sum is where
+per-row quantization error would compound, and keeping it full-precision is
+what holds end-to-end cosine above the 0.999 parity bound the smoke
+enforces.
+
+Head dim pads to 128 lanes for the int8 operands (int8 Mosaic tiles are
+(32, 128); d=64 towers would otherwise sit below the minimum lane tile).
+Zero padding quantizes to zero and contributes nothing to the dot.
+
+Forward-only by design: this is the serving fast path — training runs the
+differentiable f32/bf16 kernel. Block sizes resolve through
+``tune.best_config("flash_attention_int8", ...)``; VMEM per grid cell is
+modeled by :func:`_per_head_vmem_bytes` (mirrored jax-free in
+``tune.space.int8_flash_vmem_bytes``, sync-tested).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from jimm_tpu.ops.flash_attention import (NEG_INF, _LANES, _SEMANTICS,
+                                          _bcast_lanes, _causal_kv_index,
+                                          _ceil_to, _flatten_heads,
+                                          _from_lanes, _interpret, _pad_seq,
+                                          _pick_block, _unflatten_heads)
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+#: same per-cell budget as the f32 kernel (of ~16MB/core VMEM)
+_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+def _per_head_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
+    """Resident VMEM per head in one grid cell. int8 q/k tiles carry the
+    128-padded head dim; v and the out tile keep the storage dtype (bf16
+    bound); scales ride in the lse-style (hb, 1, block) layout. Mirrored
+    jax-free in ``tune.space.int8_flash_vmem_bytes`` (sync-tested)."""
+    dp = _ceil_to(d, _LANES)
+    return (block_q * dp + block_k * dp   # int8 q/k tiles
+            + 2 * block_k * d * 2         # v in + double-buffer
+            + block_q * d * 2             # out tile
+            + 2 * block_q * _LANES * 4    # m/l stats scratch
+            + block_q * d * 4             # fp32 accumulator
+            + (block_q + block_k) * 4     # per-row q/k scale tiles
+            + block_q * block_k * 6)      # s fp32 + p bf16 intermediate
+
+
+def _pick_hb(bn: int, block_q: int, block_k: int, d: int) -> int:
+    per_head = _per_head_vmem_bytes(block_q, block_k, d)
+    for hb in (8, 4, 2):
+        if bn % hb == 0 and hb * per_head <= _VMEM_BUDGET:
+            return hb
+    return 1
+
+
+def _dequant_scores(s: jax.Array, q_scale: jax.Array,
+                    k_scale: jax.Array) -> jax.Array:
+    """int32 score block -> f32 via the per-row quantization scales' outer
+    product. The ONE sanctioned f32 upcast in this kernel (JL012)."""
+    return s.astype(jnp.float32) * q_scale[:, None] * k_scale[None, :]
+
+
+def _fwd_kernel(qq_ref, kq_ref, v_ref, qs_ref, ks_ref, o_ref,
+                m_scr, l_scr, acc_scr, *, sk_real: int, block_k: int,
+                causal: bool, sm_scale: float, n_k: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    hb, bq, _ = qq_ref.shape
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def compute():
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = k_pos < sk_real
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            mask = mask & (k_pos <= q_pos)
+        for h in range(hb):
+            qq = qq_ref[h]                               # (bq, dp) int8
+            kq = kq_ref[h]                               # (bk, dp) int8
+            v = v_ref[h]                                 # (bk, d)
+            s_i32 = jax.lax.dot_general(
+                qq, kq, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            s = _dequant_scores(s_i32, qs_ref[h, 0, :],
+                                ks_ref[h, 0, :]) * sm_scale
+            s = jnp.where(mask, s, NEG_INF)
+            m_prev = _from_lanes(m_scr[h])
+            l_prev = _from_lanes(l_scr[h])
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_new[:, None])
+            corr = jnp.exp(m_prev - m_new)
+            l_new = l_prev * corr + jnp.sum(p, axis=1)
+            acc_scr[h] = acc_scr[h] * corr[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[h] = _bcast_lanes(m_new)
+            l_scr[h] = _bcast_lanes(l_new)
+
+    if causal:
+        pl.when(kj * block_k <= (qi + 1) * bq - 1)(compute)
+        last_j = jnp.minimum(n_k - 1, ((qi + 1) * bq - 1) // block_k)
+    else:
+        compute()
+        last_j = n_k - 1
+
+    @pl.when(kj == last_j)
+    def _finalize():
+        for h in range(hb):
+            l = _from_lanes(l_scr[h])
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[h] = (acc_scr[h] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _quantize_heads(x3: jax.Array, seq_p: int,
+                    d_p: int) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-row int8 quantization of a head-flattened (BN, S, D)
+    tensor, padded to (BN, seq_p, d_p). Returns the int8 tensor and the
+    fp32 scales in the kernel's lse-style (BN, 1, seq_p) layout. Padded
+    rows get scale 1.0 (finite dequant; their scores are masked anyway)."""
+    xf = x3.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    x_q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    x_q = x_q.astype(jnp.int8)
+    bn, seq, d = x3.shape
+    x_q = jnp.pad(x_q, ((0, 0), (0, seq_p - seq), (0, d_p - d)))
+    scale = jnp.pad(scale, ((0, 0), (0, seq_p - seq)), constant_values=1.0)
+    return x_q, scale[:, None, :]
+
+
+def _resolve_blocks(q, k, v, block_q, block_k):
+    """Trace-time block resolution through the tune cache — lookup only.
+    Explicit ints win, so the tuner's bench closures cannot recurse."""
+    if block_q is not None and block_k is not None:
+        return int(block_q), int(block_k)
+    from jimm_tpu.tune import best_config
+    cfg = best_config("flash_attention_int8",
+                      (q.shape, k.shape, v.shape),
+                      (q.dtype, k.dtype, v.dtype),
+                      default={"block_q": DEFAULT_BLOCK_Q,
+                               "block_k": DEFAULT_BLOCK_K})
+    return (int(block_q if block_q is not None else cfg["block_q"]),
+            int(block_k if block_k is not None else cfg["block_k"]))
+
+
+def flash_attention_int8(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                         is_causal: bool = False,
+                         block_q: int | None = None,
+                         block_k: int | None = None) -> jax.Array:
+    """int8-activation flash attention over ``(B, S, N, D)`` q/k/v.
+
+    Forward-only serving variant: Q/K quantize per row to int8, the score
+    matmul runs on the MXU in int8, softmax and P@V stay full-precision.
+    Scale is 1/sqrt(D) like `flash_attention`. Runs the Pallas interpreter
+    off-TPU so CPU tests and the quant parity harness exercise the same
+    code path.
+    """
+    b, sq, n, d = q.shape
+    sm_scale = 1.0 / (d ** 0.5)
+    block_q, block_k = _resolve_blocks(q, k, v, block_q, block_k)
+    block_q = min(_pick_block(sq, block_q), _ceil_to(sq, _LANES))
+    block_k = min(_pick_block(k.shape[1], block_k),
+                  _ceil_to(k.shape[1], _LANES))
+    q3, k3, v3 = map(_flatten_heads, (q, k, v))
+    bn = q3.shape[0]
+    sk = k3.shape[1]
+    sq_p, sk_p = _ceil_to(sq, block_q), _ceil_to(sk, block_k)
+    d_p = _ceil_to(d, _LANES)
+    qq, qs = _quantize_heads(q3, sq_p, d_p)
+    kq, ks = _quantize_heads(k3, sk_p, d_p)
+    vp = _pad_seq(v3, sk_p)
+    n_q, n_k = sq_p // block_q, sk_p // block_k
+    hb = _pick_hb(bn, block_q, block_k, d)
+    kernel = partial(_fwd_kernel, sk_real=sk, block_k=block_k,
+                     causal=is_causal, sm_scale=sm_scale, n_k=n_k)
+    kv_idx = (_causal_kv_index(block_q, block_k, n_k) if is_causal
+              else (lambda h, i, j: (h, j, 0)))
+    kv_stat_idx = (
+        (lambda h, i, j: (h, 0,
+                          _causal_kv_index(block_q, block_k, n_k)(h, i, j)[1]))
+        if is_causal else (lambda h, i, j: (h, 0, j)))
+    o = pl.pallas_call(
+        kernel,
+        grid=(bn // hb, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((hb, block_q, d_p), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((hb, block_k, d_p), kv_idx),
+            pl.BlockSpec((hb, block_k, d), kv_idx),
+            pl.BlockSpec((hb, 1, block_q), lambda h, i, j: (h, 0, i)),
+            pl.BlockSpec((hb, 1, block_k), kv_stat_idx),
+        ],
+        out_specs=pl.BlockSpec((hb, block_q, d), lambda h, i, j: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bn, sq_p, d), q3.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((hb, block_q, _LANES), jnp.float32),
+            pltpu.VMEM((hb, block_q, _LANES), jnp.float32),
+            pltpu.VMEM((hb, block_q, d), jnp.float32),
+        ],
+        compiler_params=_SEMANTICS,
+        interpret=_interpret(),
+    )(qq, kq, vp, qs, ks)
+    return _unflatten_heads(o[:, :sq], b, n)

@@ -1,0 +1,123 @@
+"""jimm_tpu.quant — in-place int8 model surgery for the serving fast path.
+
+:func:`quantize_model` walks a built nnx model and swaps every eligible
+``nnx.Linear`` for a :class:`QuantLinear` holding symmetric
+per-output-channel int8 weights plus fp32 scales. The replacement is pure
+attribute surgery (no re-init, no checkpoint round-trip), so it composes
+with the stacked-block layout: blocks built under ``nnx.vmap`` carry a
+leading ``layers`` axis on every parameter, quantization reduces over the
+input-features axis only (``axis=-2``), and ``nnx.scan`` slices the int8
+kernel and its scales per layer exactly as it slices fp32 kernels.
+
+``QuantLinear.__call__`` quantizes its activations dynamically per row
+(W8A8) and runs the fused Pallas kernel from ``ops/int8_matmul.py`` — int8
+x int8 -> int32 on the MXU, dequant + bias fused in the epilogue. The same
+scheme as ``weights/quantize.py``'s checkpoint rewrite, applied live.
+
+Skipped by design:
+
+- ``Attention`` q/k/v when ``fused_qkv`` is on — that path concatenates
+  the raw ``.kernel`` parameters into one (H, 3H) matmul and would crash
+  on a QuantLinear; the out projection still quantizes.
+- Everything that is not an ``nnx.Linear`` (conv patch embed, token /
+  positional embeddings, norms) — lookups and normalizations gain no MXU
+  time from int8.
+
+Counted in the ``jimm_quant`` registry (``jimm_quant_layers_quantized_total``)
+and timed under the ``quantize_model`` span (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from jimm_tpu import obs
+from jimm_tpu.ops.int8_matmul import quantized_linear
+
+__all__ = ["QuantLinear", "quantize_linear", "quantize_model"]
+
+
+class QuantLinear(nnx.Module):
+    """An ``nnx.Linear`` replacement holding int8 weights + fp32 scales.
+
+    ``w_q`` is ``(din, dout)`` int8 (or ``(L, din, dout)`` inside stacked
+    blocks), ``scale`` is the matching per-output-channel fp32 scale, and
+    ``bias`` stays fp32. The forward quantizes activations per row and
+    dispatches to the fused Pallas int8 matmul; output comes back in the
+    layer's compute dtype so downstream modules see the same interface as
+    the Linear they replaced.
+    """
+
+    def __init__(self, w_q, scale, bias=None, *, dtype=None):
+        self.w_q = nnx.Param(w_q)
+        self.scale = nnx.Param(scale)
+        self.bias = nnx.Param(bias) if bias is not None else None
+        self.dtype = dtype
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        w_q = self.w_q[...]
+        scale = self.scale[...]
+        bias = self.bias[...] if self.bias is not None else None
+        lead = x.shape[:-1]
+        y = quantized_linear(x.reshape(-1, x.shape[-1]), w_q, scale, bias)
+        out_dtype = self.dtype if self.dtype is not None else x.dtype
+        return y.reshape(lead + (w_q.shape[-1],)).astype(out_dtype)
+
+
+def quantize_linear(lin: nnx.Linear, *, dtype=None) -> QuantLinear:
+    """Symmetric per-output-channel int8 surgery on one Linear. Reduces
+    over the input-features axis only (``axis=-2``), so stacked
+    ``(L, din, dout)`` kernels quantize per layer per output channel."""
+    w = lin.kernel[...]
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale[..., None, :]), -127, 127)
+    q = q.astype(jnp.int8)
+    bias = getattr(lin, "bias", None)
+    # nnx.Linear(use_bias=False) keeps a Param whose value is None
+    bias_value = getattr(bias, "value", None) if bias is not None else None
+    if bias_value is not None:
+        bias_value = jnp.asarray(bias_value).astype(jnp.float32)
+    return QuantLinear(q, scale, bias_value,
+                       dtype=dtype if dtype is not None
+                       else getattr(lin, "dtype", None))
+
+
+def _skip(parent: nnx.Module, name: str) -> bool:
+    from jimm_tpu.nn.transformer import Attention
+    return (isinstance(parent, Attention)
+            and getattr(parent, "fused_qkv", False)
+            and name in ("q", "k", "v"))
+
+
+def _walk(module: nnx.Module, seen: set[int]) -> int:
+    if id(module) in seen:
+        return 0
+    seen.add(id(module))
+    count = 0
+    for name, child in list(vars(module).items()):
+        if isinstance(child, nnx.Linear):
+            if _skip(module, name):
+                continue
+            setattr(module, name, quantize_linear(child))
+            count += 1
+        elif isinstance(child, nnx.Module):
+            count += _walk(child, seen)
+        elif isinstance(child, (list, tuple)):
+            for item in child:
+                if isinstance(item, nnx.Module):
+                    count += _walk(item, seen)
+    return count
+
+
+def quantize_model(model: nnx.Module) -> int:
+    """Replace every eligible ``nnx.Linear`` in ``model`` (in place) with a
+    :class:`QuantLinear`. Returns the number of layers quantized."""
+    with obs.span("quantize_model"):
+        count = _walk(model, set())
+    obs.get_registry("jimm_quant").counter(
+        "layers_quantized_total").inc(count)
+    return count

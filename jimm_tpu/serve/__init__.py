@@ -13,8 +13,9 @@ from jimm_tpu.serve.admission import (AdmissionController, AdmissionPolicy,
                                       DeadlineExceededError, EngineClosedError,
                                       QueueFullError, RequestError,
                                       ServeError, ServeMetrics)
-from jimm_tpu.serve.buckets import (DEFAULT_BATCH_BUCKETS, TPU_BATCH_BUCKETS,
-                                    BucketTable, default_buckets, pad_batch)
+from jimm_tpu.serve.buckets import (DEFAULT_BATCH_BUCKETS, SERVE_DTYPES,
+                                    TPU_BATCH_BUCKETS, BucketTable,
+                                    default_buckets, pad_batch)
 from jimm_tpu.serve.cache import (EmbeddingCache, class_embedding_cache,
                                   prompt_set_key)
 from jimm_tpu.serve.client import (ServeClient, ServeClientError,
@@ -30,7 +31,8 @@ __all__ = [
     "DEFAULT_BATCH_BUCKETS", "DeadlineExceededError", "EmbeddingCache",
     "EngineClosedError", "InferenceEngine", "QueueFullError", "ReplicaForward",
     "RequestError", "ServeClient", "ServeClientError", "ServeError",
-    "ServeMetrics", "ServingServer", "TPU_BATCH_BUCKETS", "TopologyPlan",
+    "SERVE_DTYPES", "ServeMetrics", "ServingServer", "TPU_BATCH_BUCKETS",
+    "TopologyPlan",
     "ZeroShotService", "build_replica_forwards", "class_embedding_cache",
     "counting_forward", "decode_image_payload", "default_buckets",
     "encode_image_payload", "pad_batch", "plan_topology", "prompt_set_key",

@@ -26,18 +26,32 @@ DEFAULT_BATCH_BUCKETS: tuple[int, ...] = (1, 2, 4, 8)
 #: inference bench tracks (256 is BASELINE's inference batch).
 TPU_BATCH_BUCKETS: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
+#: precisions a serving stack can declare. The dtype names the precision
+#: the warm-compiled forwards COMPUTE in — batch assembly stays fp32
+#: images; "int8" means quantized weights + dynamic int8 activations
+#: inside the Pallas kernels (docs/quantization.md).
+SERVE_DTYPES: tuple[str, ...] = ("float32", "bfloat16", "int8")
+
 
 @dataclasses.dataclass(frozen=True)
 class BucketTable:
-    """An ascending, de-duplicated set of allowed batch sizes."""
+    """An ascending, de-duplicated set of allowed batch sizes, tagged with
+    the serving precision. The dtype rides the table (not the engine)
+    because it is part of the same compile-shape contract: one warm
+    executable per (bucket, dtype), and MEASUREMENTS rows / ready lines
+    report both axes."""
 
     sizes: tuple[int, ...]
+    dtype: str = "float32"
 
     def __post_init__(self) -> None:
         sizes = tuple(sorted(set(int(s) for s in self.sizes)))
         if not sizes or sizes[0] < 1:
             raise ValueError(f"bucket sizes must be >= 1, got {self.sizes}")
         object.__setattr__(self, "sizes", sizes)
+        if self.dtype not in SERVE_DTYPES:
+            raise ValueError(f"unknown serve dtype {self.dtype!r}; "
+                             f"known: {SERVE_DTYPES}")
 
     @property
     def max_size(self) -> int:
@@ -81,12 +95,13 @@ def pad_batch(rows: Sequence[np.ndarray], bucket: int) -> np.ndarray:
     return np.concatenate([stacked, pad])
 
 
-def default_buckets(platform: str | None = None) -> BucketTable:
-    """The platform's declared bucket table. ``platform`` defaults to the
-    active JAX backend; resolving it lazily keeps this module importable
-    without initializing a backend."""
+def default_buckets(platform: str | None = None,
+                    dtype: str = "float32") -> BucketTable:
+    """The platform's declared bucket table at the given serving precision.
+    ``platform`` defaults to the active JAX backend; resolving it lazily
+    keeps this module importable without initializing a backend."""
     if platform is None:
         import jax
         platform = jax.default_backend()
     return BucketTable(TPU_BATCH_BUCKETS if platform == "tpu"
-                       else DEFAULT_BATCH_BUCKETS)
+                       else DEFAULT_BATCH_BUCKETS, dtype=dtype)

@@ -26,7 +26,9 @@ from typing import Any, Callable, Mapping, Sequence
 from jimm_tpu import obs
 from jimm_tpu.tune.cache import TuneCache, TuneKey, tune_key
 from jimm_tpu.tune.measure import measure
-from jimm_tpu.tune.space import flash_space, ln_space, retrieval_space
+from jimm_tpu.tune.space import (flash_space, int8_flash_space,
+                                 int8_matmul_space, ln_space,
+                                 retrieval_space)
 
 __all__ = ["KERNELS", "KernelSpec", "best_config", "configure", "get_cache",
            "tune_kernel"]
@@ -127,6 +129,61 @@ def _retrieval_bench(shapes: Shapes, dtypes: Dtypes,
     return lambda: step(blocks, offsets, valid, queries)
 
 
+def _int8_matmul_default(shapes: Shapes, dtypes: Dtypes) -> dict:
+    from jimm_tpu.ops.int8_matmul import DEFAULT_BLOCK_M, DEFAULT_BLOCK_N
+    return {"block_m": DEFAULT_BLOCK_M, "block_n": DEFAULT_BLOCK_N}
+
+
+def _int8_matmul_bench(shapes: Shapes, dtypes: Dtypes,
+                       config: Mapping[str, int]) -> Callable[[], Any]:
+    """Timed closure: the fused dequantizing matmul (forward only — it is a
+    serving kernel). Explicit block kwargs bypass the tuner — no
+    recursion."""
+    import jax
+    import jax.numpy as jnp
+
+    from jimm_tpu.ops.int8_matmul import int8_matmul
+    m, k = (int(d) for d in shapes[0][-2:])
+    n = int(shapes[1][-1])
+    kx, kw = jax.random.split(jax.random.PRNGKey(0))
+    x_q = jax.random.randint(kx, (m, k), -127, 128, jnp.int8)
+    w_q = jax.random.randint(kw, (k, n), -127, 128, jnp.int8)
+    x_s = jnp.full((m,), 0.01, jnp.float32)
+    w_s = jnp.full((n,), 0.01, jnp.float32)
+    bias = jnp.zeros((n,), jnp.float32)
+    bm, bn = int(config["block_m"]), int(config["block_n"])
+
+    step = jax.jit(lambda xq, xs, wq, ws, b: int8_matmul(
+        xq, xs, wq, ws, b, activation="gelu", block_m=bm, block_n=bn))
+    return lambda: step(x_q, x_s, w_q, w_s, bias)
+
+
+def _int8_flash_default(shapes: Shapes, dtypes: Dtypes) -> dict:
+    from jimm_tpu.ops.flash_attention_int8 import (DEFAULT_BLOCK_K,
+                                                   DEFAULT_BLOCK_Q)
+    return {"block_q": DEFAULT_BLOCK_Q, "block_k": DEFAULT_BLOCK_K}
+
+
+def _int8_flash_bench(shapes: Shapes, dtypes: Dtypes,
+                      config: Mapping[str, int]) -> Callable[[], Any]:
+    """Timed closure: int8 flash forward at the candidate blocks (the
+    variant is forward-only by design — serving is the consumer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from jimm_tpu.ops.flash_attention_int8 import flash_attention_int8
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    dt = jnp.dtype(dtypes[0]) if dtypes else jnp.float32
+    q = jax.random.normal(kq, tuple(shapes[0]), dt)
+    k = jax.random.normal(kk, tuple(shapes[1]), dt)
+    v = jax.random.normal(kv, tuple(shapes[2]), dt)
+    bq, bk = int(config["block_q"]), int(config["block_k"])
+
+    step = jax.jit(lambda q, k, v: flash_attention_int8(
+        q, k, v, block_q=bq, block_k=bk))
+    return lambda: step(q, k, v)
+
+
 @dataclasses.dataclass(frozen=True)
 class KernelSpec:
     """One tunable kernel: identity, search space, fallback, and bench."""
@@ -146,6 +203,12 @@ KERNELS: dict[str, KernelSpec] = {
     "retrieval_topk": KernelSpec(version=1, space=retrieval_space,
                                  default=_retrieval_default,
                                  bench=_retrieval_bench),
+    "int8_matmul": KernelSpec(version=1, space=int8_matmul_space,
+                              default=_int8_matmul_default,
+                              bench=_int8_matmul_bench),
+    "flash_attention_int8": KernelSpec(version=1, space=int8_flash_space,
+                                       default=_int8_flash_default,
+                                       bench=_int8_flash_bench),
 }
 
 

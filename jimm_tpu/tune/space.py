@@ -12,13 +12,16 @@ from __future__ import annotations
 
 from typing import Any, Sequence
 
-__all__ = ["FLASH_BLOCKS", "LN_BLOCK_ROWS", "RETRIEVAL_BLOCK_N",
-           "VMEM_BUDGET", "flash_space", "flash_vmem_bytes", "kernel_space",
-           "ln_space", "ln_vmem_bytes", "retrieval_space",
-           "retrieval_vmem_bytes"]
+__all__ = ["FLASH_BLOCKS", "INT8_FLASH_BLOCKS", "INT8_MATMUL_BLOCK_M",
+           "INT8_MATMUL_BLOCK_N", "LN_BLOCK_ROWS", "RETRIEVAL_BLOCK_N",
+           "VMEM_BUDGET", "flash_space", "flash_vmem_bytes",
+           "int8_flash_space", "int8_flash_vmem_bytes", "int8_matmul_space",
+           "int8_matmul_vmem_bytes", "kernel_space", "ln_space",
+           "ln_vmem_bytes", "retrieval_space", "retrieval_vmem_bytes"]
 
 _LANES = 128
 _SUBLANES = 8
+_INT8_SUBLANES = 32
 
 #: mirrors ops.flash_attention._VMEM_BUDGET (sync-tested)
 VMEM_BUDGET = 8 * 1024 * 1024
@@ -121,8 +124,88 @@ def retrieval_space(shapes: Sequence[Sequence[int]],
     return out or [{"block_n": RETRIEVAL_BLOCK_N[0]}]
 
 
+#: int8 matmul grid tiles: rows align to the int8 32-sublane tile, columns
+#: to 128 lanes. The wrapper clamps to the padded M/N, so oversize
+#: candidates are pruned here as redundant.
+INT8_MATMUL_BLOCK_M = (32, 64, 128, 256, 512)
+INT8_MATMUL_BLOCK_N = (128, 256, 512)
+
+#: int8 flash q/k blocks share the f32 kernel's lane-aligned candidates
+#: (`_pick_block` clamps to the padded sequence the same way)
+INT8_FLASH_BLOCKS = (128, 256, 512)
+
+
+def int8_matmul_vmem_bytes(block_m: int, block_n: int, k: int) -> int:
+    """jax-free mirror of ``ops.int8_matmul._per_cell_vmem_bytes``
+    (sync-tested): int8 x/w tiles at 128-padded K, lane-broadcast row
+    scales, 1-D column scale + bias, int32 acc + f32 epilogue + out."""
+    kp = _ceil_to(k, _LANES)
+    return (block_m * kp
+            + kp * block_n
+            + block_m * _LANES * 4
+            + 2 * block_n * 4
+            + 3 * block_m * block_n * 4)
+
+
+def int8_matmul_space(shapes: Sequence[Sequence[int]],
+                      dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Feasible ``{"block_m", "block_n"}`` candidates for an int8 matmul
+    shaped ``[(M, K), (K, N)]``. Blocks past the tile-padded M/N are
+    redundant (the wrapper clamps); VMEM-infeasible cells are pruned."""
+    m, k = int(shapes[0][-2]), int(shapes[0][-1])
+    n = int(shapes[1][-1])
+    out = []
+    for bm in INT8_MATMUL_BLOCK_M:
+        if bm > _ceil_to(m, _INT8_SUBLANES):
+            continue
+        for bn in INT8_MATMUL_BLOCK_N:
+            if bn > _ceil_to(n, _LANES):
+                continue
+            if int8_matmul_vmem_bytes(bm, bn, k) > VMEM_BUDGET:
+                continue
+            out.append({"block_m": bm, "block_n": bn})
+    return out or [{"block_m": INT8_MATMUL_BLOCK_M[0],
+                    "block_n": INT8_MATMUL_BLOCK_N[0]}]
+
+
+def int8_flash_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
+    """jax-free mirror of ``ops.flash_attention_int8._per_head_vmem_bytes``
+    (sync-tested): int8 q/k at the 128-padded head dim, storage-dtype v and
+    out, f32 stats/accumulator, lse-layout scale tiles."""
+    dp = _ceil_to(d, _LANES)
+    return (block_q * dp + block_k * dp
+            + 2 * block_k * d * 2
+            + block_q * d * 2
+            + 2 * block_q * _LANES * 4
+            + block_q * d * 4
+            + (block_q + block_k) * 4
+            + block_q * block_k * 6)
+
+
+def int8_flash_space(shapes: Sequence[Sequence[int]],
+                     dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Feasible ``{"block_q", "block_k"}`` candidates for int8 flash
+    attention over q/k/v shapes ``(B, S, N, D)`` (or head-flattened)."""
+    q, k = shapes[0], shapes[1]
+    sq, sk, d = int(q[-3]), int(k[-3]), int(q[-1])
+    out = []
+    for bq in INT8_FLASH_BLOCKS:
+        if bq > _ceil_to(sq, _LANES):
+            continue
+        for bk in INT8_FLASH_BLOCKS:
+            if bk > _ceil_to(sk, _LANES):
+                continue
+            if int8_flash_vmem_bytes(bq, bk, d) > VMEM_BUDGET:
+                continue
+            out.append({"block_q": bq, "block_k": bk})
+    return out or [{"block_q": INT8_FLASH_BLOCKS[0],
+                    "block_k": INT8_FLASH_BLOCKS[0]}]
+
+
 _SPACES = {"flash_attention": flash_space, "layer_norm": ln_space,
-           "retrieval_topk": retrieval_space}
+           "retrieval_topk": retrieval_space,
+           "int8_matmul": int8_matmul_space,
+           "flash_attention_int8": int8_flash_space}
 
 
 def kernel_space(kernel: str, shapes: Sequence[Sequence[int]],

@@ -1,0 +1,159 @@
+"""Post-training symmetric per-output-channel int8 checkpoint quantization.
+
+Pure-numpy checkpoint rewriting in the ``weights/surgery.py`` style: the
+unit of work is the flat HF-keyed state dict, so quantization composes with
+every loader/exporter in the package. :func:`save_quantized` rides
+``weights/export.save_pretrained``'s ``state_hook`` — the fp32 state dict
+is rewritten in flight, lands in ``model.safetensors`` via
+``safetensors_io.save_file`` (whose header already speaks ``"I8"``), and
+reloads with plain ``safetensors_io.load_file``.
+
+Scheme (shared with ``jimm_tpu.quant`` and the Pallas kernels): symmetric,
+zero-point-free, one fp32 scale per output channel — ``scale =
+max|channel| / 127`` over every axis but the first (HF/torch layout puts
+``out_features`` first). The max-abs element therefore quantizes to exactly
+±127, which makes the scheme *exactly idempotent*: re-quantizing a
+dequantized tensor reproduces the same int8 bits and bit-identical scales
+(tested in ``tests/test_quantize.py``). Scales are stored alongside the
+int8 tensor under ``<name>.scale_q8`` — a suffix no HF checkpoint uses, so
+quantized and plain state dicts coexist in one namespace.
+
+Tensors that stay fp32: anything 0/1-D (norms, biases), embeddings and
+positional tables (their rows are looked up, not matmul'd — quantizing
+them buys no MXU time and costs accuracy), and the logit scale/bias
+temperature parameters.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from jimm_tpu import obs
+
+#: suffix for the per-output-channel fp32 scales stored beside each int8
+#: tensor — unambiguous (no HF checkpoint key ends with it), unlike bare
+#: ``.scale`` which collides with LayerNorm parameters
+SCALE_SUFFIX = ".scale_q8"
+
+#: stamped into config.json by `save_quantized` so loaders can recognize a
+#: quantized checkpoint without scanning tensor dtypes
+QUANT_FORMAT = "int8-v1"
+
+#: name substrings that keep their tensor fp32 even when >= 2-D
+EXCLUDE_SUBSTRINGS = ("embed", "position", "pos_", "norm", "ln_",
+                      "logit_scale", "logit_bias")
+
+_FLOAT_KINDS = ("f",)  # bf16 arrives as ml_dtypes (kind 'V'); see below
+
+
+def _is_float(arr: np.ndarray) -> bool:
+    if arr.dtype.kind in _FLOAT_KINDS:
+        return True
+    # ml_dtypes.bfloat16 registers as a void-kind dtype; name is stable
+    return arr.dtype.name == "bfloat16"
+
+
+def default_predicate(name: str, arr: np.ndarray) -> bool:
+    """Should this state-dict tensor be quantized? Float, at least 2-D
+    (matmul operand), and not on the exclude list."""
+    if arr.ndim < 2 or not _is_float(arr):
+        return False
+    lname = name.lower()
+    return not any(s in lname for s in EXCLUDE_SUBSTRINGS)
+
+
+def quantize_tensor(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-output-channel int8 quantization of one tensor.
+
+    Channels are rows of the first axis (HF/torch ``out_features``-first
+    layout). Returns ``(int8 tensor, fp32 scales shaped (w.shape[0],))``.
+    All-zero channels get scale 1.0 so dequantization stays finite.
+    """
+    wf = np.asarray(w, np.float32)
+    axes = tuple(range(1, wf.ndim))
+    amax = np.max(np.abs(wf), axis=axes)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    bshape = (-1,) + (1,) * (wf.ndim - 1)
+    q = np.clip(np.rint(wf / scale.reshape(bshape)), -127, 127)
+    return q.astype(np.int8), scale
+
+
+def dequantize_tensor(q: np.ndarray, scale: np.ndarray,
+                      dtype=np.float32) -> np.ndarray:
+    """Inverse of :func:`quantize_tensor`: ``q * scale`` per channel."""
+    bshape = (-1,) + (1,) * (q.ndim - 1)
+    return (q.astype(np.float32)
+            * np.asarray(scale, np.float32).reshape(bshape)).astype(dtype)
+
+
+def is_quantized_state(state: dict) -> bool:
+    return any(name.endswith(SCALE_SUFFIX) for name in state)
+
+
+def quantize_state_dict(state: dict, *, predicate=None) -> dict:
+    """Rewrite a flat HF state dict: eligible tensors become int8 with a
+    ``<name>.scale_q8`` fp32 companion; everything else passes through.
+    Already-int8 tensors pass through untouched (dict-level idempotence).
+    """
+    pred = predicate or default_predicate
+    out: dict[str, np.ndarray] = {}
+    n_quantized = 0
+    with obs.span("quantize_state"):
+        for name, arr in state.items():
+            arr = np.asarray(arr)
+            if name.endswith(SCALE_SUFFIX) or arr.dtype == np.int8:
+                out[name] = arr
+                continue
+            if pred(name, arr):
+                q, scale = quantize_tensor(arr)
+                out[name] = q
+                out[name + SCALE_SUFFIX] = scale
+                n_quantized += 1
+            else:
+                out[name] = arr
+    obs.get_registry("jimm_quant").counter(
+        "tensors_quantized_total").inc(n_quantized)
+    return out
+
+
+def dequantize_state_dict(state: dict, *, dtype=np.float32) -> dict:
+    """Inverse of :func:`quantize_state_dict`: int8 tensors with a stored
+    scale come back as ``dtype``; scale keys are consumed."""
+    out: dict[str, np.ndarray] = {}
+    for name, arr in state.items():
+        if name.endswith(SCALE_SUFFIX):
+            continue
+        arr = np.asarray(arr)
+        scale = state.get(name + SCALE_SUFFIX)
+        if scale is not None and arr.dtype == np.int8:
+            out[name] = dequantize_tensor(arr, scale, dtype)
+        else:
+            out[name] = arr
+    return out
+
+
+def save_quantized(model, save_dir, *, predicate=None) -> None:
+    """Export ``model`` as an int8-quantized HF-style checkpoint directory
+    (rides ``save_pretrained``'s state hook; config.json gains a
+    ``jimm_quant`` stanza so the format is self-describing)."""
+    from jimm_tpu.weights.export import save_pretrained
+
+    def _hook(state):
+        return quantize_state_dict(state, predicate=predicate)
+
+    def _config(config):
+        config = dict(config)
+        config["jimm_quant"] = {"format": QUANT_FORMAT,
+                                "scheme": "symmetric-per-channel",
+                                "scale_suffix": SCALE_SUFFIX}
+        return config
+
+    save_pretrained(model, save_dir, state_hook=_hook, config_hook=_config)
+
+
+def load_dequantized(path, *, dtype=np.float32) -> dict:
+    """Load a ``model.safetensors`` written by :func:`save_quantized` and
+    return the dequantized fp-typed state dict (ready for the standard
+    loaders)."""
+    from jimm_tpu.weights.safetensors_io import load_file
+    return dequantize_state_dict(load_file(path), dtype=dtype)

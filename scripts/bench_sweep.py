@@ -37,12 +37,26 @@ def hung_variants(model: str, min_hangs: int = 2) -> list[dict]:
     least ``min_hangs`` times. A variant that deterministically hangs
     (variant-specific compile pathology, not a dropped tunnel) would
     otherwise be retried first on every resume, burn its full watchdog
-    budget each window, and starve every grid row after it."""
+    budget each window, and starve every grid row after it.
+
+    A hang only counts against the variant when the same watcher attempt
+    (phase + attempt tag from the persist step) also landed a successful
+    measurement — proof the tunnel was up when the watchdog fired. A
+    dropped tunnel hangs *every* variant it touches; blaming the variant
+    for that would defer it permanently on connectivity noise alone."""
+    records = read_records(MEASUREMENTS)
+    # watcher attempts corroborated alive: they produced >= 1 real record
+    alive = {(rec.get("phase"), rec.get("attempt"))
+             for rec in records
+             if rec.get("model") == model
+             and isinstance(rec.get("mfu"), (int, float))
+             and rec.get("mfu") > 0}
     counts: dict[str, int] = {}
     variants: dict[str, dict] = {}
-    for rec in read_records(MEASUREMENTS):
+    for rec in records:
         if (rec.get("model") == model and isinstance(rec.get("variant"), dict)
-                and "variant watchdog" in str(rec.get("error", ""))):
+                and "variant watchdog" in str(rec.get("error", ""))
+                and (rec.get("phase"), rec.get("attempt")) in alive):
             key = json.dumps(rec["variant"], sort_keys=True)
             counts[key] = counts.get(key, 0) + 1
             variants[key] = rec["variant"]

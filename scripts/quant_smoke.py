@@ -1,0 +1,148 @@
+"""CI tier-1 smoke for the int8 low-precision serving fast path.
+
+Two phases, end to end on CPU (interpret-mode Pallas int8 kernels):
+
+1. **Parity**: ``scripts.quant_parity`` on the CPU-tiny CLIP preset must
+   hold the acceptance floor — per-image cosine >= 0.999 against the f32
+   twin and synthetic zero-shot top-1 agreement >= 0.99.
+2. **Serve, two lives**: an int8-quantized model behind the store-backed
+   AOT forward. Life 1 starts against an EMPTY tmp store: bucket warmup
+   compiles each bucket once (write-through exports them), and a mixed
+   stream of request sizes afterwards must add ZERO fresh traces. Life 2
+   is a fresh forward + engine (what a process restart gets) against the
+   now-warm store: every bucket must source ``"aot"``, the compile gauge
+   must stay 0, and one answered request must match the live quantized
+   model. The AOT key must also carry the mixed ``float32+int8`` param
+   dtype so int8 artifacts can never be adopted by an f32 serve.
+
+Exits nonzero (with a JSON error line) on any violation.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m scripts.quant_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+COSINE_FLOOR = 0.999
+TOP1_FLOOR = 0.99
+
+
+def fail(msg: str) -> int:
+    print(json.dumps({"metric": "quant_smoke", "value": 0.0, "error": msg}),
+          flush=True)
+    return 1
+
+
+def run_parity() -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "scripts.quant_parity", "--preset", "tiny"],
+        env=env, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"quant_parity failed: {proc.stderr[-1500:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    # --- phase A: measured parity on the tiny preset ----------------------
+    parity = run_parity()
+    if parity["cosine_min"] < COSINE_FLOOR:
+        return fail(f"cosine_min {parity['cosine_min']} < {COSINE_FLOOR}")
+    if parity["top1_agreement"] < TOP1_FLOOR:
+        return fail(f"top1_agreement {parity['top1_agreement']} "
+                    f"< {TOP1_FLOOR}")
+
+    # --- phase B: int8 serve, two lives over one store --------------------
+    import asyncio
+
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import CLIP, preset
+    from jimm_tpu.aot import ArtifactStore
+    from jimm_tpu.aot.warmup import AotForward
+    from jimm_tpu.cli import _tiny_override
+    from jimm_tpu.quant import quantize_model
+    from jimm_tpu.serve import BucketTable, InferenceEngine
+
+    buckets = (1, 2)
+    cfg = _tiny_override(preset("clip-vit-base-patch16"))
+    model = CLIP(cfg, rngs=nnx.Rngs(0))
+    quantize_model(model)
+    size = cfg.vision.image_size
+    rng = np.random.RandomState(0)
+
+    async def drive(engine, items):
+        await engine.start()
+        try:
+            return [np.asarray(r) for r in await asyncio.gather(
+                *[engine.submit(x) for x in items])]
+        finally:
+            await engine.stop()
+
+    with tempfile.TemporaryDirectory(prefix="jimm-quant-smoke-") as root:
+        store = ArtifactStore(root)
+
+        # --- life 1: empty store, warmup compiles once, then zero --------
+        fwd1 = AotForward(model, method="encode_image",
+                          item_shape=(size, size, 3), store=store,
+                          label="quant_smoke:int8")
+        pd = fwd1.key_for(1).describe()["param_dtype"]
+        if "int8" not in pd or "float32" not in pd:
+            return fail(f"quantized param_dtype fingerprint is {pd!r}; an "
+                        f"f32 serve could adopt int8 artifacts")
+        eng1 = InferenceEngine(fwd1, item_shape=(size, size, 3),
+                               buckets=BucketTable(buckets, dtype="int8"),
+                               max_delay_ms=2.0,
+                               trace_count=fwd1.trace_count)
+        eng1.warmup_blocking()
+        warm_traces = fwd1.trace_count()
+        items = [rng.randn(size, size, 3).astype(np.float32)
+                 for _ in range(5)]
+        asyncio.run(drive(eng1, items))
+        post = fwd1.trace_count() - warm_traces
+        if post != 0:
+            return fail(f"life 1 paid {post} post-warmup recompile(s)")
+
+        # --- life 2: fresh forward/engine, fully store-sourced -----------
+        fwd2 = AotForward(model, method="encode_image",
+                          item_shape=(size, size, 3), store=store,
+                          label="quant_smoke:int8")
+        eng2 = InferenceEngine(fwd2, item_shape=(size, size, 3),
+                               buckets=BucketTable(buckets, dtype="int8"),
+                               max_delay_ms=2.0,
+                               trace_count=fwd2.trace_count)
+        eng2.warmup_blocking()
+        sources = {b: r["source"] for b, r in eng2.warmup_report.items()}
+        if sources != {b: "aot" for b in buckets}:
+            return fail(f"warm restart not fully AOT-sourced: {sources}")
+        if eng2.metrics.snapshot()["compile_count"] != 0:
+            return fail(f"warm restart paid "
+                        f"{eng2.metrics.snapshot()['compile_count']} "
+                        f"fresh compiles")
+        got = asyncio.run(drive(eng2, items[:1]))[0]
+        want = np.asarray(model.encode_image(items[0][None]))[0]
+        if not np.allclose(got, want, rtol=1e-4, atol=1e-4):
+            return fail("AOT-loaded int8 forward disagrees with the live "
+                        "quantized model")
+        if fwd2.trace_count() != 0:
+            return fail(f"warm restart traced {fwd2.trace_count()} times")
+
+    print(json.dumps({"metric": "quant_smoke", "value": 1.0,
+                      "cosine_min": parity["cosine_min"],
+                      "top1_agreement": parity["top1_agreement"],
+                      "layers_quantized": parity["layers_quantized"],
+                      "param_dtype": pd,
+                      "buckets": list(buckets),
+                      "life2_sources": sources}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,9 +58,15 @@ def build_engine(args):
     cfg = preset(name)
     if args.tiny or not on_tpu:  # off-TPU always smoke-sizes (like bench.py)
         cfg = _tiny_override(cfg)
-    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    serve_dtype = args.dtype or ("bf16" if on_tpu else "f32")
+    dtype = jnp.bfloat16 if serve_dtype == "bf16" else jnp.float32
     model = _model_cls(fam)(cfg, rngs=nnx.Rngs(0), dtype=dtype,
                             param_dtype=dtype)
+    if serve_dtype == "int8":
+        # same in-place surgery `jimm-tpu serve --dtype int8` does, so the
+        # bench times the exact quantized forward serving dispatches
+        from jimm_tpu.quant import quantize_model
+        quantize_model(model)
     method = "encode_image" if fam in ("clip", "siglip") else "__call__"
     size = cfg.vision.image_size
     plan = plan_topology(getattr(args, "replicas", None),
@@ -70,8 +76,11 @@ def build_engine(args):
     else:
         forward, traces = build_replica_forwards(
             model, plan, method=method, item_shape=(size, size, 3))
-    buckets = (BucketTable(tuple(int(s) for s in args.buckets.split(",")))
-               if args.buckets else default_buckets())
+    bucket_dtype = {"f32": "float32", "bf16": "bfloat16",
+                    "int8": "int8"}[serve_dtype]
+    buckets = (BucketTable(tuple(int(s) for s in args.buckets.split(",")),
+                           dtype=bucket_dtype)
+               if args.buckets else default_buckets(dtype=bucket_dtype))
     engine = InferenceEngine(
         forward, item_shape=(size, size, 3), buckets=buckets,
         max_delay_ms=args.max_delay_ms,
@@ -323,6 +332,11 @@ def main() -> int:
     p.add_argument("--requests", type=int, default=0,
                    help="total requests (0 = 16 per client)")
     p.add_argument("--max-delay-ms", type=float, default=5.0)
+    p.add_argument("--dtype", choices=["f32", "bf16", "int8"], default=None,
+                   help="serving precision (default: bf16 on TPU, f32 off). "
+                        "int8 quantizes the model in place and benches the "
+                        "fused Pallas int8 path — docs/quantization.md; "
+                        "the ledger row carries a `dtype` field either way")
     p.add_argument("--replicas", type=int, default=1,
                    help="data-parallel replica groups (each gets its own "
                         "submesh and executor thread)")
@@ -440,6 +454,7 @@ def main() -> int:
         "batch_fill_ratio": round(metrics.batch_fill_ratio, 4),
         "batches": metrics.count("batches_total"),
         "buckets": list(engine.buckets.sizes),
+        "dtype": engine.buckets.dtype,
         "warmup_s": round(warmup_s, 3),
         "compile_count_delta": compile_delta,
         "n_devices": plan.n_devices,

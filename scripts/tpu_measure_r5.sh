@@ -51,6 +51,10 @@ for line in open(path, errors="replace"):
         rec = json.loads(line)
     except Exception:
         continue
+    # skip-resume notices carry no measurement — persisting one per variant
+    # per window bloats the ledger without adding a datapoint
+    if set(rec) - {"variant", "model", "metric", "case"} == {"skipped"}:
+        continue
     rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
            "phase": phase, "attempt": int(attempt), "rc": int(rc), **rec}
     out.write(json.dumps(rec) + "\n")
@@ -150,9 +154,14 @@ adopt_refresh() {  # adopt_refresh <phase> <preset-args...>
   # even if the sweep never finishes (windows are scarce).
   local phase=$1; shift
   local n last
+  # grep -c already prints 0 on no match, so `|| echo 0` used to yield the
+  # two-line "0\n0", making the -gt below an invalid integer test that
+  # passed by accident — strip to digits and default empty to 0
   n=$(grep -c "\"phase\": \"$phase\"" /root/repo/MEASUREMENTS.jsonl \
-      2>/dev/null || echo 0)
-  last=$(cat "$STATE/adopt_$phase.count" 2>/dev/null || echo 0)
+      2>/dev/null || true)
+  n=${n//[^0-9]/}; n=${n:-0}
+  last=$(cat "$STATE/adopt_$phase.count" 2>/dev/null || true)
+  last=${last//[^0-9]/}; last=${last:-0}
   [ "$n" -gt "$last" ] || return 0
   if env JIMM_PLATFORM=cpu timeout 300 \
       python -m scripts.adopt_sweep --phase "$phase" "$@" --apply; then

@@ -1,0 +1,29 @@
+"""JL012 fixture: silent f32 upcasts in quantized ops code."""
+import jax
+import jax.numpy as jnp
+
+
+def int8_forward(acc, x_q, w_q, scales):
+    y = acc.astype(jnp.float32)                        # JL012: bare upcast
+    xf = jax.lax.convert_element_type(x_q, jnp.float32)  # JL012: CET upcast
+    wf = w_q.astype("float32")                         # JL012: string dtype
+    return y + xf @ wf * scales
+
+
+def _dequant(acc, x_scale, w_scale):
+    # ok: the sanctioned rescale site — enclosing name says dequant
+    return acc.astype(jnp.float32) * x_scale[:, None] * w_scale[None, :]
+
+
+def quantize_rows(x):
+    # ok: quantization itself computes scales in f32 by definition
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    return jnp.round(x / scale[:, None]).astype(jnp.int8), scale
+
+
+def epilogue_cast(acc):
+    # ok: bf16 epilogues are mixed-precision policy, not a silent f32 demotion
+    half = acc.astype(jnp.bfloat16)
+    # ok: a justified deliberate upcast
+    debug = acc.astype(jnp.float32)  # jaxlint: disable=JL012 parity probe
+    return half, debug

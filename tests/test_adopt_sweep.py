@@ -315,13 +315,42 @@ def test_flashchk_resumes_at_unproven_cases(tmp_path, monkeypatch):
 
 def test_sweep_defers_variants_that_hang_repeatedly(tmp_path, monkeypatch):
     import scripts.bench_sweep as bs
-    hang = {"model": "siglip_b16_256", "variant": {"remat": "dots+ln"},
-            "error": "variant watchdog after 600s (tunnel hang?)"}
+
+    def hang(attempt):
+        return {"model": "siglip_b16_256", "variant": {"remat": "dots+ln"},
+                "error": "variant watchdog after 600s (tunnel hang?)",
+                "phase": "sweep", "attempt": attempt}
+
+    def ok(attempt):
+        # corroboration: the same attempt landed a real measurement, so
+        # the tunnel was up when the watchdog fired
+        return {"model": "siglip_b16_256", "variant": {"ln": "fused"},
+                "mfu": 0.41, "device": "TPU v5 lite",
+                "phase": "sweep", "attempt": attempt}
+
     other_err = {"model": "siglip_b16_256", "variant": {"ln": "fused"},
-                 "error": "ValueError('block spec')"}
-    p = _write(tmp_path, [hang, other_err, hang])
+                 "error": "ValueError('block spec')",
+                 "phase": "sweep", "attempt": 1}
+    p = _write(tmp_path, [hang(1), ok(1), other_err, hang(2), ok(2)])
     monkeypatch.setattr(bs, "MEASUREMENTS", p)
-    # two hang records -> deferred; one non-watchdog error -> still retried
+    # two corroborated hangs -> deferred; non-watchdog error -> retried
     assert bs.hung_variants("siglip_b16_256") == [{"remat": "dots+ln"}]
     assert bs.hung_variants("siglip_b16_256", min_hangs=3) == []
     assert bs.hung_variants("vit_l16_384") == []
+
+
+def test_sweep_uncorroborated_hangs_do_not_defer(tmp_path, monkeypatch):
+    """A dropped tunnel hangs every variant it touches: watchdog records
+    from attempts that landed no successful measurement must not count
+    toward deferral, or connectivity noise permanently blames variants."""
+    import scripts.bench_sweep as bs
+    hangs = [{"model": "siglip_b16_256", "variant": {"remat": "dots+ln"},
+              "error": "variant watchdog after 600s (tunnel hang?)",
+              "phase": "sweep", "attempt": a} for a in (1, 2, 3)]
+    # a success in a *different* attempt corroborates nothing above
+    ok = {"model": "siglip_b16_256", "variant": {"ln": "fused"},
+          "mfu": 0.41, "device": "TPU v5 lite",
+          "phase": "sweep", "attempt": 4}
+    p = _write(tmp_path, hangs + [ok])
+    monkeypatch.setattr(bs, "MEASUREMENTS", p)
+    assert bs.hung_variants("siglip_b16_256") == []

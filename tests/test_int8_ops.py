@@ -1,0 +1,152 @@
+"""Pallas int8 kernels: fused dequantizing matmul and int8 flash attention.
+
+CPU runs exercise interpret-mode Pallas (the same wrapper/padding code the
+TPU path uses); the TPU contract is held by cross-lowering — ``.lower(
+lowering_platforms=("tpu",))`` must produce Mosaic without block==array
+escapes at odd shapes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jimm_tpu.ops.attention import reference_attention
+from jimm_tpu.ops.flash_attention_int8 import flash_attention_int8
+from jimm_tpu.ops.int8_matmul import (int8_matmul, quantize_rows,
+                                      quantized_linear)
+
+#: (M, K, N) triples off the tile grid — exercises every padding branch
+ODD_MATMUL_SHAPES = [(1, 7, 5), (5, 100, 33), (33, 64, 128),
+                     (257, 769, 129), (16, 768, 768)]
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def quantize_cols(w):
+    """Per-output-channel weight quantization (K, N) -> int8 + (N,) scales,
+    the test-side mirror of weights.quantize's out-features-first scheme."""
+    amax = np.max(np.abs(w), axis=0)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale[None, :]), -127, 127).astype(np.int8)
+    return jnp.asarray(q), jnp.asarray(scale)
+
+
+class TestInt8Matmul:
+    @pytest.mark.parametrize("m,k,n", ODD_MATMUL_SHAPES)
+    def test_matches_integer_reference_exactly(self, rng, m, k, n):
+        # int8 dots up to K=769 stay exact in f32 (sums < 2^24), so the
+        # kernel must agree with the dequantized int reference to f32
+        # rounding only — any real error means wrong padding/indexing
+        x = jnp.asarray(rng.normal(size=(m, k)).astype(np.float32))
+        w = rng.normal(size=(k, n)).astype(np.float32)
+        x_q, x_s = quantize_rows(x)
+        w_q, w_s = quantize_cols(w)
+        got = int8_matmul(x_q, x_s, w_q, w_s)
+        ref = (np.asarray(x_q, np.float32) * np.asarray(x_s)[:, None]) \
+            @ (np.asarray(w_q, np.float32) * np.asarray(w_s)[None, :])
+        np.testing.assert_allclose(np.asarray(got), ref,
+                                   atol=1e-4 * max(1, k // 64), rtol=1e-6)
+
+    def test_fused_bias_and_activations(self, rng):
+        x = jnp.asarray(rng.normal(size=(9, 40)).astype(np.float32))
+        w = rng.normal(size=(40, 17)).astype(np.float32)
+        bias = jnp.asarray(rng.normal(size=(17,)).astype(np.float32))
+        x_q, x_s = quantize_rows(x)
+        w_q, w_s = quantize_cols(w)
+        base = np.asarray(int8_matmul(x_q, x_s, w_q, w_s))
+        with_bias = np.asarray(int8_matmul(x_q, x_s, w_q, w_s, bias))
+        np.testing.assert_allclose(with_bias, base + np.asarray(bias),
+                                   atol=1e-5)
+        relu = np.asarray(int8_matmul(x_q, x_s, w_q, w_s, bias,
+                                      activation="relu"))
+        np.testing.assert_allclose(relu, np.maximum(with_bias, 0),
+                                   atol=1e-5)
+        gelu = np.asarray(int8_matmul(x_q, x_s, w_q, w_s, bias,
+                                      activation="gelu"))
+        np.testing.assert_allclose(
+            gelu, np.asarray(jax.nn.gelu(jnp.asarray(with_bias),
+                                         approximate=False)), atol=1e-5)
+
+    def test_unknown_activation_raises(self, rng):
+        x = jnp.asarray(rng.normal(size=(4, 8)).astype(np.float32))
+        x_q, x_s = quantize_rows(x)
+        w_q, w_s = quantize_cols(rng.normal(size=(8, 8)).astype(np.float32))
+        with pytest.raises(ValueError, match="activation"):
+            jax.block_until_ready(
+                int8_matmul(x_q, x_s, w_q, w_s, activation="swish"))
+
+    def test_quantize_rows_scheme(self, rng):
+        x = jnp.asarray(rng.normal(size=(6, 33)).astype(np.float32))
+        x_q, x_s = quantize_rows(x)
+        assert x_q.dtype == jnp.int8 and x_s.dtype == jnp.float32
+        # the max-abs element of every row quantizes to exactly +-127
+        assert np.all(np.max(np.abs(np.asarray(x_q)), axis=1) == 127)
+        # zero rows stay finite with scale 1.0
+        zq, zs = quantize_rows(jnp.zeros((2, 16)))
+        assert np.all(np.asarray(zq) == 0) and np.all(np.asarray(zs) == 1.0)
+
+    def test_quantized_linear_close_to_f32_linear(self, rng):
+        x = jnp.asarray(rng.normal(size=(12, 96)).astype(np.float32))
+        w = rng.normal(size=(96, 48)).astype(np.float32)
+        bias = jnp.asarray(rng.normal(size=(48,)).astype(np.float32))
+        w_q, w_s = quantize_cols(w)
+        got = np.asarray(quantized_linear(x, w_q, w_s, bias))
+        ref = np.asarray(x) @ w + np.asarray(bias)
+        cos = (got * ref).sum() / (np.linalg.norm(got)
+                                   * np.linalg.norm(ref))
+        assert cos > 0.999
+
+    def test_explicit_blocks_and_out_dtype(self, rng):
+        x = jnp.asarray(rng.normal(size=(40, 64)).astype(np.float32))
+        x_q, x_s = quantize_rows(x)
+        w_q, w_s = quantize_cols(rng.normal(size=(64, 40)).astype(np.float32))
+        auto = np.asarray(int8_matmul(x_q, x_s, w_q, w_s))
+        pinned = int8_matmul(x_q, x_s, w_q, w_s, block_m=32, block_n=128,
+                             out_dtype=jnp.bfloat16)
+        assert pinned.dtype == jnp.bfloat16
+        # bf16 keeps ~8 mantissa bits: compare relatively, not absolutely
+        np.testing.assert_allclose(np.asarray(pinned, np.float32), auto,
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_lowers_on_tpu_backend(self, rng):
+        # odd shape: every pad/clamp path must produce Mosaic-legal blocks
+        x = jnp.asarray(rng.normal(size=(5, 100)).astype(np.float32))
+        x_q, x_s = quantize_rows(x)
+        w_q, w_s = quantize_cols(rng.normal(size=(100, 33))
+                                 .astype(np.float32))
+        fn = jax.jit(int8_matmul)
+        fn.trace(x_q, x_s, w_q, w_s).lower(
+            lowering_platforms=("tpu",))  # must not raise
+
+
+class TestInt8FlashAttention:
+    @pytest.mark.parametrize("seq,causal", [(64, False), (100, False),
+                                            (257, True), (577, False)])
+    def test_close_to_reference_attention(self, rng, seq, causal):
+        q, k, v = (jnp.asarray(rng.normal(size=(1, seq, 2, 32))
+                               .astype(np.float32)) for _ in range(3))
+        got = np.asarray(flash_attention_int8(q, k, v, is_causal=causal))
+        ref = np.asarray(reference_attention(q, k, v, is_causal=causal))
+        assert np.max(np.abs(got - ref)) < 0.1
+        cos = (got * ref).sum() / (np.linalg.norm(got)
+                                   * np.linalg.norm(ref))
+        assert cos > 0.999
+
+    def test_explicit_blocks(self, rng):
+        q, k, v = (jnp.asarray(rng.normal(size=(1, 128, 2, 32))
+                               .astype(np.float32)) for _ in range(3))
+        auto = np.asarray(flash_attention_int8(q, k, v))
+        pinned = np.asarray(flash_attention_int8(q, k, v, block_q=128,
+                                                 block_k=128))
+        np.testing.assert_allclose(pinned, auto, atol=1e-5)
+
+    def test_lowers_on_tpu_backend(self, rng):
+        q, k, v = (jnp.asarray(rng.normal(size=(1, 100, 2, 32))
+                               .astype(np.float32)) for _ in range(3))
+        fn = jax.jit(lambda q, k, v: flash_attention_int8(q, k, v,
+                                                          is_causal=True))
+        fn.trace(q, k, v).lower(lowering_platforms=("tpu",))  # must not raise

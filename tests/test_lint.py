@@ -231,6 +231,36 @@ class TestRuleFixtures:
         assert check_host_sort(tree, "jimm_tpu/train/loop.py") == []
         assert check_host_sort(tree, "tests/test_retrieval.py") == []
 
+    def test_jl012_quant_upcast(self):
+        findings = findings_for("ops/int8_bad_upcast.py")
+        assert rules_and_lines(findings) == {
+            ("JL012", 7),   # bare .astype(jnp.float32) on the accumulator
+            ("JL012", 8),   # jax.lax.convert_element_type(..., jnp.float32)
+            ("JL012", 9),   # string dtype spelling .astype("float32")
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert any("_dequant" in f.message for f in findings)
+        # the _dequant/quantize_rows sanctioned sites, the bf16 epilogue,
+        # and the suppressed deliberate upcast (lines 13-29) stay clean
+
+    def test_jl012_scoped_to_quant_ops_paths(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_quant_upcast
+        src = "y = acc.astype(jnp.float32)\n"
+        tree = ast.parse(src)
+        assert check_quant_upcast(tree, "jimm_tpu/ops/int8_matmul.py") != []
+        assert check_quant_upcast(
+            tree, "jimm_tpu/ops/flash_attention_int8.py") != []
+        assert check_quant_upcast(tree, "jimm_tpu/quant/__init__.py") != []
+        # non-quantized ops and the rest of the tree upcast freely (f32 IS
+        # their compute dtype), and tests compare against f32 on purpose
+        assert check_quant_upcast(
+            tree, "jimm_tpu/ops/flash_attention.py") == []
+        assert check_quant_upcast(tree, "jimm_tpu/ops/layer_norm.py") == []
+        assert check_quant_upcast(tree, "jimm_tpu/train/loop.py") == []
+        assert check_quant_upcast(tree, "tests/test_int8_ops.py") == []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings

@@ -1,0 +1,186 @@
+"""int8 quantization: checkpoint rewrite (weights/quantize.py), live model
+surgery (jimm_tpu.quant), the serve dtype axis, and the AOT param-dtype
+fingerprint that keeps int8 and f32 artifacts apart."""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+from flax import nnx
+
+from jimm_tpu import CLIP, preset
+from jimm_tpu.cli import _tiny_override
+from jimm_tpu.weights.quantize import (SCALE_SUFFIX, default_predicate,
+                                       dequantize_state_dict,
+                                       dequantize_tensor, is_quantized_state,
+                                       load_dequantized, quantize_state_dict,
+                                       quantize_tensor, save_quantized)
+
+
+@pytest.fixture(scope="module")
+def tiny_clip():
+    cfg = _tiny_override(preset("clip-vit-base-patch16"))
+    return cfg, CLIP(cfg, rngs=nnx.Rngs(0))
+
+
+class TestQuantizeTensor:
+    def test_scheme_properties(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(16, 33)).astype(np.float32)
+        q, scale = quantize_tensor(w)
+        assert q.dtype == np.int8 and scale.dtype == np.float32
+        assert scale.shape == (16,)
+        # symmetric max-abs: each channel's extreme lands exactly on +-127
+        assert np.all(np.max(np.abs(q), axis=1) == 127)
+        np.testing.assert_allclose(scale, np.max(np.abs(w), axis=1) / 127.0)
+
+    def test_zero_channel_stays_finite(self):
+        w = np.zeros((3, 8), np.float32)
+        w[1] = 2.0
+        q, scale = quantize_tensor(w)
+        assert scale[0] == 1.0 and scale[2] == 1.0
+        assert np.all(np.isfinite(dequantize_tensor(q, scale)))
+
+    def test_roundtrip_error_bounded_by_half_step(self):
+        rng = np.random.default_rng(1)
+        w = rng.normal(size=(8, 64)).astype(np.float32)
+        q, scale = quantize_tensor(w)
+        err = np.abs(dequantize_tensor(q, scale) - w)
+        assert np.all(err <= scale[:, None] / 2 + 1e-7)
+
+    def test_requantize_is_bit_stable(self):
+        # the max element quantizes to exactly +-127, so a dequantized
+        # tensor re-quantizes to the SAME bits and bit-identical scales —
+        # repeated rewrite passes cannot drift
+        rng = np.random.default_rng(2)
+        w = rng.normal(size=(5, 40)).astype(np.float32)
+        q1, s1 = quantize_tensor(w)
+        q2, s2 = quantize_tensor(dequantize_tensor(q1, s1))
+        assert np.array_equal(q1, q2)
+        assert np.array_equal(s1, s2)
+
+
+class TestStateDict:
+    def test_predicate_excludes_non_matmul_tensors(self):
+        mat = np.ones((4, 4), np.float32)
+        assert default_predicate("vision_model.mlp.fc1.weight", mat)
+        assert not default_predicate("bias", np.ones((4,), np.float32))
+        assert not default_predicate("layer_norm.weight", mat)
+        assert not default_predicate(
+            "embeddings.position_embedding.weight", mat)
+        assert not default_predicate("logit_scale", mat)
+        assert not default_predicate("k", np.ones((4, 4), np.int32))
+
+    def test_quantize_dequantize_round_trip(self):
+        rng = np.random.default_rng(0)
+        state = {"a.weight": rng.normal(size=(8, 16)).astype(np.float32),
+                 "a.bias": rng.normal(size=(8,)).astype(np.float32),
+                 "norm.weight": rng.normal(size=(16, 16))
+                 .astype(np.float32)}
+        qstate = quantize_state_dict(state)
+        assert is_quantized_state(qstate)
+        assert qstate["a.weight"].dtype == np.int8
+        assert ("a.weight" + SCALE_SUFFIX) in qstate
+        # pass-throughs untouched
+        assert np.array_equal(qstate["a.bias"], state["a.bias"])
+        assert np.array_equal(qstate["norm.weight"], state["norm.weight"])
+        back = dequantize_state_dict(qstate)
+        assert set(back) == set(state)
+        assert back["a.weight"].dtype == np.float32
+
+    def test_quantize_state_dict_idempotent(self):
+        rng = np.random.default_rng(0)
+        state = {"w.weight": rng.normal(size=(4, 8)).astype(np.float32)}
+        once = quantize_state_dict(state)
+        twice = quantize_state_dict(once)
+        assert all(np.array_equal(twice[k], once[k]) for k in once)
+
+    def test_safetensors_round_trip_bit_stable(self, tmp_path, tiny_clip):
+        from jimm_tpu.weights.safetensors_io import load_file
+        _, model = tiny_clip
+        save_quantized(model, tmp_path)
+        raw = load_file(tmp_path / "model.safetensors")
+        assert is_quantized_state(raw)
+        assert any(v.dtype == np.int8 for v in raw.values())
+        # re-quantizing the dequantized checkpoint reproduces every int8
+        # tensor and every scale bit for bit
+        requant = quantize_state_dict(dequantize_state_dict(raw))
+        assert set(requant) == set(raw)
+        assert all(np.array_equal(requant[k], raw[k]) for k in raw)
+
+    def test_save_quantized_stamps_config(self, tmp_path, tiny_clip):
+        _, model = tiny_clip
+        save_quantized(model, tmp_path)
+        config = json.loads(
+            pathlib.Path(tmp_path, "config.json").read_text())
+        assert config["jimm_quant"]["format"] == "int8-v1"
+        assert config["jimm_quant"]["scale_suffix"] == SCALE_SUFFIX
+        full = load_dequantized(tmp_path / "model.safetensors")
+        assert not is_quantized_state(full)
+        assert all(v.dtype != np.int8 for v in full.values())
+
+
+class TestQuantizeModel:
+    def test_counts_and_stays_close(self, tiny_clip):
+        from jimm_tpu.quant import QuantLinear, quantize_model
+        cfg, model_f32 = tiny_clip
+        model_q = CLIP(cfg, rngs=nnx.Rngs(0))
+        n = quantize_model(model_q)
+        # per tower stack: q/k/v/out + fc1/fc2, plus the two projections
+        assert n == 14
+        assert isinstance(model_q.visual_projection, QuantLinear)
+        x = np.random.RandomState(0).randn(
+            2, cfg.vision.image_size, cfg.vision.image_size, 3
+        ).astype(np.float32)
+        a = np.asarray(model_q.encode_image(x))
+        b = np.asarray(model_f32.encode_image(x))
+        cos = (a * b).sum(-1) / (np.linalg.norm(a, axis=-1)
+                                 * np.linalg.norm(b, axis=-1))
+        assert cos.min() > 0.999
+
+    def test_fused_qkv_projections_are_skipped(self):
+        from jimm_tpu.nn.transformer import Attention
+        from jimm_tpu.quant import QuantLinear, quantize_model
+        attn = Attention(64, 2, nnx.Rngs(0), fused_qkv=True)
+        n = quantize_model(attn)
+        # fused_qkv reads raw .kernel params for the (H, 3H) concat: q/k/v
+        # must stay Linear; only the out projection quantizes
+        assert n == 1
+        assert isinstance(attn.out, QuantLinear)
+        assert all(isinstance(getattr(attn, p), nnx.Linear)
+                   for p in ("q", "k", "v"))
+        x = np.random.RandomState(0).randn(1, 8, 64).astype(np.float32)
+        assert np.asarray(attn(x)).shape == (1, 8, 64)
+
+
+class TestServeDtypeAxis:
+    def test_bucket_table_carries_dtype(self):
+        from jimm_tpu.serve import SERVE_DTYPES, BucketTable
+        assert BucketTable((1, 2)).dtype == "float32"
+        assert BucketTable((1, 2), dtype="int8").dtype == "int8"
+        assert set(SERVE_DTYPES) == {"float32", "bfloat16", "int8"}
+
+    def test_unknown_dtype_rejected(self):
+        from jimm_tpu.serve import BucketTable
+        with pytest.raises(ValueError, match="serve dtype"):
+            BucketTable((1, 2), dtype="fp8")
+
+    def test_default_buckets_pass_dtype_through(self):
+        from jimm_tpu.serve import default_buckets
+        assert default_buckets("cpu", dtype="int8").dtype == "int8"
+
+
+class TestAotParamDtype:
+    def test_mixed_precision_fingerprint(self, tiny_clip):
+        from jimm_tpu.aot.warmup import _model_param_dtype
+        from jimm_tpu.quant import quantize_model
+        cfg, _ = tiny_clip
+        model = CLIP(cfg, rngs=nnx.Rngs(0))
+        # plain model: single dtype, same string as the old first-leaf
+        # probe — existing store fingerprints stay valid
+        assert _model_param_dtype(model) == "float32"
+        quantize_model(model)
+        # quantized model: aggregated signature, so an int8 serve can
+        # never adopt (or be adopted by) the f32 twin's artifacts
+        assert _model_param_dtype(model) == "float32+int8"

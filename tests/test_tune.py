@@ -245,6 +245,43 @@ class TestSpace:
         assert kernel_space("layer_norm", ((1, 100000),), ("float32",))
         assert kernel_space("flash_attention",
                             ((1, 8, 1, 4096),) * 3, ("float32",) * 3)
+        assert kernel_space("int8_matmul", ((1, 100000), (100000, 1)),
+                            ("int8", "int8"))
+        assert kernel_space("flash_attention_int8",
+                            ((1, 16384, 1, 128),) * 3, ("float32",) * 3)
+
+    def test_int8_matmul_space_prunes_to_shape(self):
+        cands = kernel_space("int8_matmul", ((40, 64), (64, 40)),
+                             ("int8", "int8"))
+        assert cands
+        for c in cands:
+            # m=40 -> 64-row ceiling; n=40 -> one 128-lane tile
+            assert c["block_m"] <= 64 and c["block_n"] <= 128
+
+    def test_int8_matmul_vmem_formula_matches_ops(self):
+        from jimm_tpu.ops import int8_matmul as im
+        from jimm_tpu.tune.space import VMEM_BUDGET, int8_matmul_vmem_bytes
+        assert VMEM_BUDGET == im._VMEM_BUDGET
+        for bm in (32, 64, 256):
+            for bn in (128, 512):
+                for k in (64, 768):
+                    assert int8_matmul_vmem_bytes(bm, bn, k) == \
+                        im._per_cell_vmem_bytes(bm, bn, k)
+
+    def test_int8_flash_vmem_formula_matches_ops(self):
+        from jimm_tpu.ops import flash_attention_int8 as fi
+        from jimm_tpu.tune.space import int8_flash_vmem_bytes
+        for bq in (128, 512):
+            for bk in (128, 512):
+                for d in (64, 128):
+                    assert int8_flash_vmem_bytes(bq, bk, d) == \
+                        fi._per_head_vmem_bytes(bq, bk, d)
+
+    def test_int8_kernels_registered(self):
+        for name in ("int8_matmul", "flash_attention_int8"):
+            assert name in KERNELS
+            assert KERNELS[name].version >= 1
+            assert callable(KERNELS[name].bench)
 
 
 class TestMeasure:
