@@ -26,7 +26,7 @@ import numpy as np
 from jimm_tpu.aot.keys import AOT_FORMAT_VERSION, AotKey, serve_forward_key
 from jimm_tpu.aot.store import ArtifactStore
 
-__all__ = ["AotForward", "aot_metrics", "warmup_store"]
+__all__ = ["AotForward", "aot_metrics", "warmup_naflex", "warmup_store"]
 
 
 def aot_metrics():
@@ -209,6 +209,55 @@ def warmup_store(model, *, method: str, buckets, item_shape,
                           "seconds": round(time.monotonic() - t0, 3),
                           "action": "compiled",
                           "bytes": len(payload)}
+    return report
+
+
+def warmup_naflex(model, *, batch_buckets, seq_buckets=None,
+                  method: str = "encode_image_naflex") -> dict:
+    """Warm-compile the NaFlex forward for every (batch, seq) bucket pair.
+
+    NaFlex batches carry three arrays — padded patches, per-sample spatial
+    shapes, and the key-padding mask — so the compile-shape contract is the
+    (batch bucket, seq bucket) grid rather than the single-input tables
+    `warmup_store` covers (the AOT store's ``serve_forward_key`` is unary;
+    this is a fresh-jit warmup, not a store export). Mask *contents* are
+    runtime data: one compile per pair serves every real-token count, and
+    the key mask routes attention onto the masked flash variant
+    (``ops/flash_attention.py``) instead of densifying. Returns
+    ``{(batch, seq): {"seconds", "traces"}}``.
+    """
+    import math
+    import time
+
+    import jax
+
+    from flax import nnx
+    from jimm_tpu.serve.buckets import DEFAULT_NAFLEX_SEQ_BUCKETS
+    if seq_buckets is None:
+        seq_buckets = DEFAULT_NAFLEX_SEQ_BUCKETS
+    vc = model.config.vision
+    patch_dim = vc.patch_size * vc.patch_size * 3
+    state = {"traces": 0}
+
+    @nnx.jit
+    def _fwd(m, patches, shapes, mask):
+        state["traces"] += 1
+        return getattr(m, method)(patches, shapes, mask)
+
+    report: dict[tuple[int, int], dict] = {}
+    for b in sorted({int(s) for s in batch_buckets}):
+        for s in sorted({int(s) for s in seq_buckets}):
+            g = max(int(math.isqrt(s)), 1)
+            patches = np.zeros((b, s, patch_dim), np.float32)
+            shapes = np.full((b, 2), g, np.int32)
+            mask = np.zeros((b, s), bool)
+            mask[:, :g * g] = True
+            before = state["traces"]
+            t0 = time.monotonic()
+            jax.block_until_ready(_fwd(model, patches, shapes, mask))
+            report[(b, s)] = {
+                "seconds": round(time.monotonic() - t0, 4),
+                "traces": state["traces"] - before}
     return report
 
 

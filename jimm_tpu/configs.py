@@ -19,7 +19,8 @@ from typing import Any, Literal
 
 Pooling = Literal["cls", "map", "last", "eot", "none"]
 Activation = Literal["gelu", "gelu_tanh", "quick_gelu"]
-AttnImpl = Literal["auto", "xla", "flash", "ring", "ulysses", "saveable"]
+AttnImpl = Literal["auto", "xla", "flash", "flash_masked", "flash_bias",
+                   "sigmoid", "ring", "ulysses", "saveable"]
 #: "dots" + optional "+ln"/"+act"/"+attn" save-list extensions
 RematPolicy = str
 

@@ -252,9 +252,11 @@ class Transformer(nnx.Module):
         if not self.cfg.pipeline:
             return self._apply_stack(self.blocks, x, mask)
         if mask is not None:
-            raise ValueError("attention masks are not supported on the "
-                             "pipelined path yet; use pipeline=False for "
-                             "NaFlex/masked batches")
+            raise ValueError(
+                "attention masks are not supported on the pipelined path "
+                "yet (the stage loop has no mask plumbing); use "
+                "pipeline=False — the non-pipelined path runs key-padding "
+                "masks on the flash kernel (impl='flash_masked' / 'auto')")
 
         from jimm_tpu.parallel.pipeline import (circular_layer_order,
                                                 pipeline_forward)

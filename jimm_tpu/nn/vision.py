@@ -52,7 +52,16 @@ class MAPHead(nnx.Module):
         self.probe = nnx.Param(
             logical(nnx.initializers.xavier_uniform(), None, None, "embed")(
                 rngs.params(), (1, 1, cfg.width), param_dtype))
-        self.attn = Attention(cfg.width, cfg.num_heads, rngs, impl="xla",
+        # follows the tower's attn_impl: with the masked flash variant the
+        # MAP probe's key-padding mask no longer forces the dense XLA path
+        # ("auto" still picks XLA at short seq — the probe query is 1 row).
+        # ring/ulysses shard the query sequence, which a 1-row probe cannot
+        # satisfy, so sequence-parallel towers keep the dense pool.
+        pool_impl = cfg.attn_impl
+        if pool_impl in ("ring", "ulysses"):
+            pool_impl = "auto"
+        self.attn = Attention(cfg.width, cfg.num_heads, rngs,
+                              impl=pool_impl,
                               dtype=dtype, param_dtype=param_dtype)
         self.ln = _layernorm(cfg.width, cfg.ln_eps, rngs, dtype=dtype,
                              param_dtype=param_dtype)

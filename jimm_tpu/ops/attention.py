@@ -8,6 +8,15 @@ The reference locks attention to ``flax.nnx.MultiHeadAttention``'s einsum path
   vision/text sequences and for CPU tests).
 - ``"flash"`` — Pallas TPU flash attention (fwd + custom-vjp bwd), used for
   training and long sequences. See `jimm_tpu/ops/flash_attention.py`.
+  Key-padding masks route to the masked variant automatically.
+- ``"flash_masked"`` — the key-padding-mask member of the flash family:
+  per-sample ``(B, Sk)`` masks (NaFlex variable-resolution batches, MAP
+  pooling) with flash tiling — no dense ``(B, N, Sq, Sk)`` scores.
+- ``"flash_bias"`` — flash with an additive logits bias broadcastable to
+  ``(N, Sq, Sk)`` (relative-position style), differentiable in the bias.
+- ``"sigmoid"`` — sigmoid attention (no row normalizer, per "Theory,
+  Analysis, and Best Practices for Sigmoid Self-Attention"): the natural
+  pairing for SigLIP's sigmoid loss. Supports key-padding masks.
 - ``"ring"`` — sequence-parallel ring attention over the ambient mesh's
   ``seq`` axis (long context across chips; flash within each chip on TPU).
   See `jimm_tpu/parallel/ring_attention.py`.
@@ -20,7 +29,9 @@ The reference locks attention to ``flax.nnx.MultiHeadAttention``'s einsum path
   ``checkpoint_name`` so the ``"dots+attn"`` remat policy can keep them: the
   remat'd backward then skips the qk^T + softmax recompute at the cost of one
   (B, N, Sq, Sk) bf16 tensor per layer. Only sensible at short sequence.
-- ``"auto"`` — flash on TPU when shapes qualify, else XLA.
+- ``"auto"`` — flash on TPU when shapes qualify, else XLA. Key-padding
+  masks route to ``flash_masked`` (instead of silently densifying) and
+  batch-free biases to ``flash_bias``.
 """
 
 from __future__ import annotations
@@ -42,9 +53,22 @@ def _flash_eligible(q: jax.Array, k: jax.Array) -> bool:
     # measured crossover on v5e (scripts/attn_crossover.py): XLA's fused
     # attention wins below seq 512 (grid-step overhead dominates the Pallas
     # kernel at small tiles); flash wins from 512 up and scales to long
-    # context where XLA's materialized S^2 probabilities drown in HBM traffic
-    return (q.shape[1] >= 512 and k.shape[1] >= 512
-            and q.shape[-1] in (64, 128, 256))
+    # context where XLA's materialized S^2 probabilities drown in HBM
+    # traffic. Head dims are NOT gated here anymore: off-tile D (e.g. 80,
+    # 96) is lane-padded to the next supported tile inside the flash
+    # wrapper. Measured on v5e: padding D=80 -> 128 costs ~1.25x the
+    # D=128 kernel's matmul FLOPs but still beats XLA's dense path past
+    # the same seq-512 crossover, so eligibility stays a pure seq test.
+    return q.shape[1] >= 512 and k.shape[1] >= 512
+
+
+def _is_key_padding_mask(mask: jax.Array) -> bool:
+    """True for masks the flash family handles natively: per-sample key
+    masks shaped ``(B, Sk)`` or the broadcast convention ``(B, 1, 1, Sk)``
+    (what ``nn/vision.py`` builds for NaFlex / MAP pooling)."""
+    if mask.ndim == 2:
+        return True
+    return mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1
 
 
 def dot_product_attention(
@@ -54,24 +78,74 @@ def dot_product_attention(
     *,
     is_causal: bool = False,
     mask: jax.Array | None = None,  # broadcastable to (B, N, Sq, Sk), bool
+    bias: jax.Array | None = None,  # additive logits bias
     impl: str = "auto",
 ) -> jax.Array:
     """Scaled dot-product attention over (batch, seq, heads, head_dim)."""
     if impl == "auto":
-        if _default_backend() == "tpu" and mask is None and _flash_eligible(q, k):
-            impl = "flash"
+        if _default_backend() == "tpu" and _flash_eligible(q, k):
+            if bias is not None and mask is None and bias.ndim <= 3:
+                impl = "flash_bias"
+            elif bias is not None:
+                impl = "xla"
+            elif mask is None:
+                impl = "flash"
+            elif _is_key_padding_mask(mask):
+                impl = "flash_masked"
+            else:
+                impl = "xla"
         else:
             impl = "xla"
     if impl == "flash":
         if mask is not None:
-            raise ValueError("flash attention does not support explicit "
-                             "masks; use is_causal or impl='xla'")
-        from jimm_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, is_causal=is_causal)
-    if impl in ("ring", "ulysses"):
+            if not _is_key_padding_mask(mask):
+                raise ValueError(
+                    "flash attention supports key-padding masks only "
+                    "((B, Sk) or (B, 1, 1, Sk)); arbitrary "
+                    f"{tuple(mask.shape)} masks need impl='xla'")
+            impl = "flash_masked"
+        elif bias is not None:
+            impl = "flash_bias"
+        else:
+            from jimm_tpu.ops.flash_attention import flash_attention
+            return flash_attention(q, k, v, is_causal=is_causal)
+    if impl == "flash_masked":
+        if bias is not None:
+            raise ValueError("flash_masked does not take a bias; use "
+                             "impl='flash_bias' (bias only) or impl='xla'")
+        if mask is None:
+            raise ValueError("impl='flash_masked' requires a key-padding "
+                             "mask ((B, Sk) or (B, 1, 1, Sk))")
+        from jimm_tpu.ops.flash_attention import flash_attention_masked
+        return flash_attention_masked(q, k, v, mask, is_causal=is_causal)
+    if impl == "flash_bias":
+        if bias is None:
+            raise ValueError("impl='flash_bias' requires a bias "
+                             "broadcastable to (N, Sq, Sk)")
         if mask is not None:
-            raise ValueError(f"{impl} attention does not support explicit "
-                             "masks; use is_causal or impl='xla'")
+            raise ValueError("flash_bias does not take a mask; use "
+                             "impl='flash_masked' (mask only) or "
+                             "impl='xla'")
+        from jimm_tpu.ops.flash_attention import flash_attention_bias
+        return flash_attention_bias(q, k, v, bias, is_causal=is_causal)
+    if impl == "sigmoid":
+        if bias is not None:
+            raise ValueError("sigmoid attention takes no additive bias "
+                             "(its scalar logit_bias is set by the op)")
+        if mask is not None and not _is_key_padding_mask(mask):
+            raise ValueError(
+                "sigmoid attention supports key-padding masks only "
+                f"((B, Sk) or (B, 1, 1, Sk)); got {tuple(mask.shape)}")
+        from jimm_tpu.ops.flash_attention import sigmoid_attention
+        return sigmoid_attention(q, k, v, is_causal=is_causal, mask=mask)
+    if impl in ("ring", "ulysses"):
+        if mask is not None or bias is not None:
+            raise ValueError(
+                f"{impl} attention does not support masks or biases — the "
+                "cross-chip exchange has no per-sample mask plumbing. "
+                "Key-padding masks are supported single-chip via "
+                "impl='flash_masked' (or impl='auto'); otherwise use "
+                "is_causal or impl='xla'")
         from jimm_tpu.parallel.sharding import current_rules
         rules = current_rules()
         axis = (rules.seq if rules is not None and rules.seq else "seq")
@@ -83,16 +157,18 @@ def dot_product_attention(
         return ulysses_attention(q, k, v, axis_name=axis,
                                  is_causal=is_causal, impl="auto")
     if impl == "xla":
-        return jax.nn.dot_product_attention(q, k, v, mask=mask,
+        return jax.nn.dot_product_attention(q, k, v, bias=bias, mask=mask,
                                             is_causal=is_causal)
     if impl == "saveable":
-        return saveable_attention(q, k, v, is_causal=is_causal, mask=mask)
+        return saveable_attention(q, k, v, is_causal=is_causal, mask=mask,
+                                  bias=bias)
     if impl == "einsum":  # reference semantics, fp32 softmax; used in tests
-        return reference_attention(q, k, v, is_causal=is_causal, mask=mask)
+        return reference_attention(q, k, v, is_causal=is_causal, mask=mask,
+                                   bias=bias)
     raise ValueError(f"unknown attention impl {impl!r}")
 
 
-def saveable_attention(q, k, v, *, is_causal=False, mask=None):
+def saveable_attention(q, k, v, *, is_causal=False, mask=None, bias=None):
     """Attention with fp32-softmax numerics (matching the XLA path) whose
     probabilities are bf16-cast and checkpoint-named: under a ``"dots+attn"``
     remat policy the backward reuses them instead of recomputing
@@ -105,6 +181,8 @@ def saveable_attention(q, k, v, *, is_causal=False, mask=None):
                         preferred_element_type=jnp.float32)
     logits = logits * (1.0 / depth ** 0.5)
     sq, sk = logits.shape[-2], logits.shape[-1]
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     if is_causal:
         causal = jnp.tril(jnp.ones((sq, sk), dtype=bool))
         logits = jnp.where(causal, logits, -jnp.inf)
@@ -115,18 +193,47 @@ def saveable_attention(q, k, v, *, is_causal=False, mask=None):
     return jnp.einsum("bnqk,bknd->bqnd", probs, v)
 
 
-def reference_attention(q, k, v, *, is_causal=False, mask=None):
+def reference_attention(q, k, v, *, is_causal=False, mask=None, bias=None):
     """Plain einsum attention with fp32 softmax — numerical oracle for tests."""
     dtype = q.dtype
     depth = q.shape[-1]
     q = q.astype(jnp.float32) / jnp.sqrt(depth)
     logits = jnp.einsum("bqnd,bknd->bnqk", q, k.astype(jnp.float32))
     sq, sk = logits.shape[-2], logits.shape[-1]
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     if is_causal:
         causal = jnp.tril(jnp.ones((sq, sk), dtype=bool))
         logits = jnp.where(causal, logits, -jnp.inf)
     if mask is not None:
         logits = jnp.where(mask, logits, -jnp.inf)
     weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnqk,bknd->bqnd", weights, v.astype(jnp.float32))
+    return out.astype(dtype)
+
+
+def reference_sigmoid_attention(q, k, v, *, is_causal=False, mask=None,
+                                logit_bias=None):
+    """Einsum sigmoid attention with fp32 activations — the numerical
+    oracle for `jimm_tpu.ops.flash_attention.sigmoid_attention` (same
+    ``-log(Sk)`` default logit bias, same mask convention)."""
+    import math
+    dtype = q.dtype
+    depth = q.shape[-1]
+    sk = k.shape[1]
+    if logit_bias is None:
+        logit_bias = -math.log(max(sk, 1))
+    q = q.astype(jnp.float32) / jnp.sqrt(depth)
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k.astype(jnp.float32))
+    logits = logits + logit_bias
+    sq = logits.shape[-2]
+    if is_causal:
+        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        logits = jnp.where(causal, logits, -jnp.inf)
+    if mask is not None:
+        if mask.ndim == 2:
+            mask = mask[:, None, None, :]
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.sigmoid(logits)
     out = jnp.einsum("bnqk,bknd->bqnd", weights, v.astype(jnp.float32))
     return out.astype(dtype)

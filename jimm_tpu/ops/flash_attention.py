@@ -1,35 +1,64 @@
-"""Pallas TPU flash attention: online-softmax forward + custom-VJP backward.
+"""Pallas TPU flash attention: a templated kernel family, not one kernel.
 
-Replaces ``nnx.MultiHeadAttention``'s materialized (Sq, Sk) attention matrix
-(ref `common/transformer.py:67-87`) with a blocked kernel. The kv loop is a
-GRID dimension, not an in-kernel loop over a resident copy: each (head,
-q-block, kv-block) grid cell sees exactly one (block_q, d) q tile and one
-(block_k, d) k/v tile, so VMEM holds a single working set while Mosaic's
-grid pipeline streams the next kv block from HBM in parallel with compute.
-Running softmax statistics (the flash-attention recurrence) persist across
-the innermost kv grid steps in VMEM scratch, following the layout of the
-reference TPU kernel (jax.experimental.pallas.ops.tpu.flash_attention:
-(block_q, 128) lane-broadcast m/l, fp32 (block_q, d) accumulator). HBM
-traffic is O(S*D) and VMEM is O(block^2) — long-context (8k-32k+) sequences
-stream instead of overflowing VMEM (round-1 kernel pulled the whole padded
-K/V per cell; VERDICT r1 weak #3).
+The tiling / online-normalizer / custom-VJP scaffolding is shared; a
+:class:`VariantSpec` (score transform + normalizer kind, mask source, bias
+source) instantiates the members:
 
-The backward pass recomputes attention blockwise from the saved logsumexp —
-two kernels (dq; dk/dv) in the standard flash-attention-2 arrangement, fp32
-accumulation throughout, with the same streamed-grid structure.
+- ``flash_attention``        — softmax, optional causal (the original).
+- ``flash_attention_lse``    — softmax returning per-row logsumexp (the
+  ring-attention building block).
+- ``flash_attention_masked`` — softmax with a per-sample ``(B, Sk)``
+  key-padding mask, streamed as additive f32 rows. Unblocks NaFlex and MAP
+  pooling on the flash path (`nn/vision.py::forward_naflex`).
+- ``flash_attention_bias``   — softmax with an additive bias broadcastable
+  to ``(N, Sq, Sk)`` (relative-position style), fwd + bwd including dbias
+  via a dedicated batch-innermost accumulation kernel.
+- ``sigmoid_attention``      — elementwise ``sigmoid(s + logit_bias)``
+  scores, NO row normalizer ("Theory, Analysis, and Best Practices for
+  Sigmoid Self-Attention"): the online loop drops the m/l statistics
+  entirely, and the backward needs no lse/delta.
 
-Numerical contract: matches `jimm_tpu.ops.attention.reference_attention`
-(fp32 softmax einsum) to ~1e-5 in f32, tested in interpret mode on CPU and
-compiled on TPU (`tests/test_flash_attention.py`).
+Kernel structure (all variants): the kv loop is a GRID dimension, not an
+in-kernel loop over a resident copy — each (head-block, q-block, kv-block)
+grid cell sees one (block_q, d) q tile and one (block_k, d) k/v tile, so
+VMEM holds a single working set while Mosaic's grid pipeline streams the
+next kv block from HBM in parallel with compute. Softmax variants keep the
+flash-attention recurrence in VMEM scratch ((block_q, 128) lane-broadcast
+m/l, fp32 accumulator); the sigmoid variant keeps only the accumulator.
+HBM traffic is O(S*D) and VMEM is O(block^2).
 
-Masking uses a large negative constant (not -inf) so padded/fully-masked rows
-degrade to garbage-but-finite values that the wrapper slices off — no NaNs
-reach the gradient.
+The backward recomputes attention blockwise (from the saved logsumexp for
+softmax kinds; from scratch for sigmoid) — dq kernel plus dk/dv kernel in
+the flash-attention-2 arrangement, and for the bias variant a third kernel
+whose grid runs batch innermost to accumulate dbias across samples.
+
+Numerical contract: softmax variants match
+`jimm_tpu.ops.attention.reference_attention` (fp32 softmax einsum) to
+~1e-5 in f32; the sigmoid variant matches
+`reference_sigmoid_attention`. Tested in interpret mode on CPU and
+compiled on TPU (`tests/test_flash_variants.py`,
+`scripts/flash_compiled_check.py`).
+
+Masking uses a large negative constant (not -inf) so padded/fully-masked
+rows degrade to garbage-but-finite values — no NaNs reach the gradient.
+Contract for the masked softmax variants: a query row whose keys are ALL
+masked produces finite garbage output, and contributes exactly zero
+gradient as long as its output cotangent is zero — consumers must mask
+such rows downstream (NaFlex's MAP pooling does). The sigmoid variant has
+no such row: zero valid keys simply yields a zero output row.
+
+Head dims that are not one of the tested MXU tiles (64/128/256) are
+zero-padded to the next tile inside the wrappers (the padded lanes
+contribute 0 to every dot product and are sliced off the outputs), so the
+dispatch layer no longer falls back to XLA on e.g. d=80 towers — see the
+crossover note in docs/performance.md.
 """
 
 from __future__ import annotations
 
+import math
 from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +75,29 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 _LANES = 128  # scratch m/l are lane-broadcast for Mosaic-friendly layout
 
+#: head-dim tiles the kernels are tuned for; other dims zero-pad up
+_HEAD_TILES = (64, 128, 256)
+
+
+class VariantSpec(NamedTuple):
+    """Static template parameters for one family member (hashable — rides
+    through ``custom_vjp`` nondiff args and ``partial`` into the kernels).
+
+    - ``kind``: ``"softmax"`` (online max/sum recurrence, lse residual) or
+      ``"sigmoid"`` (elementwise transform, accumulate-only loop).
+    - ``has_mask``: stream per-sample additive key-padding rows
+      ``(BN, 1, Sk)`` (0 keep / NEG_INF drop) into every score tile.
+    - ``has_bias``: stream additive ``(N, Sq, Sk)`` f32 bias tiles into
+      every score tile; the backward gains a dbias kernel.
+    """
+
+    kind: str = "softmax"
+    has_mask: bool = False
+    has_bias: bool = False
+
+
+_SOFTMAX = VariantSpec()
+
 
 def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
@@ -61,58 +113,86 @@ def _from_lanes(x: jax.Array) -> jax.Array:
     return jnp.max(x, axis=1)
 
 
+def _scores(q, k, sm_scale, mask_row, bias_tile, pos_mask):
+    """One head's fp32 score tile: dot, scale, additive mask/bias, then the
+    positional (padding/causal) mask. q/k stay in their storage dtype
+    (bf16) so the MXU runs at full bf16 rate with fp32 accumulation; the
+    softmax scale is applied to the fp32 logits AFTER the dot (pre-scaling
+    q in bf16 would round)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    if bias_tile is not None:
+        s = s + bias_tile
+    if mask_row is not None:
+        s = s + mask_row
+    return jnp.where(pos_mask, s, NEG_INF)
+
+
 # ---------------------------------------------------------------------------
-# Forward kernel
+# Forward kernel (template)
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                sk_real: int, block_k: int, causal: bool, sm_scale: float,
-                n_k: int):
+def _fwd_kernel(*refs, sk_real: int, block_k: int, causal: bool,
+                sm_scale: float, logit_bias: float, n_k: int,
+                spec: VariantSpec):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
+    softmax = spec.kind == "softmax"
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    mask_ref = next(it) if spec.has_mask else None
+    bias_ref = next(it) if spec.has_bias else None
+    o_ref = next(it)
+    lse_ref = next(it) if softmax else None
+    m_scr = next(it) if softmax else None
+    l_scr = next(it) if softmax else None
+    acc_scr = next(it)
     hb, bq, d = q_ref.shape
 
     @pl.when(kj == 0)
     def _init():
-        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
-        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        if softmax:
+            m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+            l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
     def compute():
         # position mask is head-independent: build once, reuse per head
         k_pos = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1)
-        mask = k_pos < sk_real
+        pos = k_pos < sk_real
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
-            mask = mask & (k_pos <= q_pos)
+            pos = pos & (k_pos <= q_pos)
         # static loop over the hb heads resident in this grid cell — one
         # cell amortizes grid-step overhead over hb MXU calls (the d=64
         # per-head matmuls are too small to hide it one at a time)
         for h in range(hb):
-            # q/k stay in their storage dtype (bf16) so the MXU runs at
-            # full bf16 rate with fp32 accumulation; the softmax scale is
-            # applied to the fp32 logits AFTER the dot (pre-scaling q in
-            # bf16 would round)
-            q = q_ref[h]                                 # (bq, d)
-            k = k_ref[h]                                 # (bk, d)
             v = v_ref[h]
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale
-            s = jnp.where(mask, s, NEG_INF)
-            m_prev = _from_lanes(m_scr[h])
-            l_prev = _from_lanes(l_scr[h])
-            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-            p = jnp.exp(s - m_new[:, None])
-            corr = jnp.exp(m_prev - m_new)
-            l_new = l_prev * corr + jnp.sum(p, axis=1)
-            acc_scr[h] = acc_scr[h] * corr[:, None] + jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            m_scr[h] = _bcast_lanes(m_new)
-            l_scr[h] = _bcast_lanes(l_new)
+            s = _scores(q_ref[h], k_ref[h], sm_scale,
+                        mask_ref[h] if spec.has_mask else None,
+                        bias_ref[h] if spec.has_bias else None, pos)
+            if softmax:
+                m_prev = _from_lanes(m_scr[h])
+                l_prev = _from_lanes(l_scr[h])
+                m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+                p = jnp.exp(s - m_new[:, None])
+                corr = jnp.exp(m_prev - m_new)
+                l_new = l_prev * corr + jnp.sum(p, axis=1)
+                acc_scr[h] = acc_scr[h] * corr[:, None] + jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                m_scr[h] = _bcast_lanes(m_new)
+                l_scr[h] = _bcast_lanes(l_new)
+            else:
+                # no normalizer, no running statistics: each kv block's
+                # sigmoid scores contribute independently to the sum
+                p = jax.nn.sigmoid(s + logit_bias)
+                acc_scr[h] += jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
 
     if causal:
         # kv blocks strictly above the diagonal contribute nothing: the
@@ -129,22 +209,50 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     @pl.when(kj == last_j)
     def _finalize():
         for h in range(hb):
-            m = _from_lanes(m_scr[h])
-            l = _from_lanes(l_scr[h])
-            l_safe = jnp.where(l == 0.0, 1.0, l)
-            o_ref[h] = (acc_scr[h] / l_safe[:, None]).astype(o_ref.dtype)
-            lse_ref[h, 0, :] = m + jnp.log(l_safe)
+            if softmax:
+                m = _from_lanes(m_scr[h])
+                l = _from_lanes(l_scr[h])
+                l_safe = jnp.where(l == 0.0, 1.0, l)
+                o_ref[h] = (acc_scr[h] / l_safe[:, None]).astype(o_ref.dtype)
+                lse_ref[h, 0, :] = m + jnp.log(l_safe)
+            else:
+                o_ref[h] = acc_scr[h].astype(o_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
-# Backward kernels
+# Backward kernels (templates)
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, sk_real: int, block_k: int, causal: bool,
-                   sm_scale: float, n_k: int):
+def _ds_tile(spec, s, do, v, lse, delta, logit_bias):
+    """Shared backward score-gradient: recompute p from the fp32 score
+    tile, then ``ds`` (unscaled — the chain-rule sm_scale lands at the
+    dq/dk finalize, and dbias takes ds as-is). Returns (p, ds)."""
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    if spec.kind == "softmax":
+        p = jnp.exp(s - lse[:, None])
+        ds = p * (dp - delta[:, None])
+    else:
+        p = jax.nn.sigmoid(s + logit_bias)
+        ds = p * (1.0 - p) * dp
+    return p, ds
+
+
+def _bwd_dq_kernel(*refs, sk_real: int, block_k: int, causal: bool,
+                   sm_scale: float, logit_bias: float, n_k: int,
+                   spec: VariantSpec):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
+    softmax = spec.kind == "softmax"
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    mask_ref = next(it) if spec.has_mask else None
+    bias_ref = next(it) if spec.has_bias else None
+    do_ref = next(it)
+    lse_ref = next(it) if softmax else None
+    delta_ref = next(it) if softmax else None
+    dq_ref = next(it)
+    dq_scr = next(it)
     hb, bq, d = q_ref.shape
 
     @pl.when(kj == 0)
@@ -154,26 +262,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def compute():
         k_pos = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1)
-        mask = k_pos < sk_real
+        pos = k_pos < sk_real
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
-            mask = mask & (k_pos <= q_pos)
+            pos = pos & (k_pos <= q_pos)
         for h in range(hb):
-            q = q_ref[h]
             k = k_ref[h]
-            v = v_ref[h]
-            do = do_ref[h]
-            lse = lse_ref[h, 0, :]
-            delta = delta_ref[h, 0, :]
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale
-            s = jnp.where(mask, s, NEG_INF)
-            p = jnp.exp(s - lse[:, None])
-            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-            ds = p * (dp - delta[:, None])
+            s = _scores(q_ref[h], k, sm_scale,
+                        mask_ref[h] if spec.has_mask else None,
+                        bias_ref[h] if spec.has_bias else None, pos)
+            _, ds = _ds_tile(spec, s, do_ref[h], v_ref[h],
+                             lse_ref[h, 0, :] if softmax else None,
+                             delta_ref[h, 0, :] if softmax else None,
+                             logit_bias)
             dq_scr[h] += jax.lax.dot_general(
                 ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -188,11 +290,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[...] = (dq_scr[...] * sm_scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, sq_real: int,
-                    block_q: int, causal: bool, sm_scale: float, n_q: int):
+def _bwd_dkv_kernel(*refs, sq_real: int, block_q: int, causal: bool,
+                    sm_scale: float, logit_bias: float, n_q: int,
+                    spec: VariantSpec):
     kj = pl.program_id(1)
     qi = pl.program_id(2)
+    softmax = spec.kind == "softmax"
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    mask_ref = next(it) if spec.has_mask else None
+    bias_ref = next(it) if spec.has_bias else None
+    do_ref = next(it)
+    lse_ref = next(it) if softmax else None
+    delta_ref = next(it) if softmax else None
+    dk_ref = next(it)
+    dv_ref = next(it)
+    dk_scr = next(it)
+    dv_scr = next(it)
     hb, bk, d = k_ref.shape
 
     @pl.when(qi == 0)
@@ -203,32 +317,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def compute():
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, bk), 0)
-        mask = q_pos < sq_real
+        pos = q_pos < sq_real
         if causal:
             k_pos = kj * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 1)
-            mask = mask & (k_pos <= q_pos)
+            pos = pos & (k_pos <= q_pos)
         for h in range(hb):
-            k = k_ref[h]
-            v = v_ref[h]
             q = q_ref[h]
             do = do_ref[h]
-            lse = lse_ref[h, 0, :]
-            delta = delta_ref[h, 0, :]
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale
-            s = jnp.where(mask, s, NEG_INF)
-            p = jnp.exp(s - lse[:, None])
+            s = _scores(q, k_ref[h], sm_scale,
+                        mask_ref[h] if spec.has_mask else None,
+                        bias_ref[h] if spec.has_bias else None, pos)
+            p, ds = _ds_tile(spec, s, do, v_ref[h],
+                             lse_ref[h, 0, :] if softmax else None,
+                             delta_ref[h, 0, :] if softmax else None,
+                             logit_bias)
             # dv's MXU input is a rounded copy; ds keeps the fp32 p
             # (matching the dq kernel) so dk isn't computed from a
             # double-rounded p
             dv_scr[h] += jax.lax.dot_general(
                 p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-            ds = p * (dp - delta[:, None])
             dk_scr[h] += jax.lax.dot_general(
                 ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -244,6 +353,55 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # ds was accumulated unscaled; the chain-rule sm_scale lands here
         dk_ref[...] = (dk_scr[...] * sm_scale).astype(dk_ref.dtype)
         dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_dbias_kernel(*refs, sq_real: int, sk_real: int, block_q: int,
+                      block_k: int, causal: bool, sm_scale: float,
+                      logit_bias: float, n_b: int, spec: VariantSpec):
+    """dbias for the bias variant: grid (N/hb, n_q, n_k, B) with batch
+    INNERMOST ("arbitrary"), so one (head-block, q-block, k-block) bias
+    tile stays resident while per-sample ds tiles accumulate in scratch;
+    the result is written once at the last batch step. dbias is exactly
+    ``ds`` (no sm_scale — bias adds to the scaled logits)."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    bi = pl.program_id(3)
+    softmax = spec.kind == "softmax"
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    mask_ref = next(it) if spec.has_mask else None
+    bias_ref = next(it)
+    do_ref = next(it)
+    lse_ref = next(it) if softmax else None
+    delta_ref = next(it) if softmax else None
+    db_ref = next(it)
+    db_scr = next(it)
+    hb, bq, d = q_ref.shape
+
+    @pl.when(bi == 0)
+    def _init():
+        db_scr[...] = jnp.zeros(db_scr.shape, jnp.float32)
+
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, block_k), 1)
+    pos = k_pos < sk_real
+    if causal:
+        q_pos = qi * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        pos = pos & (k_pos <= q_pos)
+    for h in range(hb):
+        s = _scores(q_ref[h], k_ref[h], sm_scale,
+                    mask_ref[h] if spec.has_mask else None,
+                    bias_ref[h], pos)
+        _, ds = _ds_tile(spec, s, do_ref[h], v_ref[h],
+                         lse_ref[h, 0, :] if softmax else None,
+                         delta_ref[h, 0, :] if softmax else None,
+                         logit_bias)
+        db_scr[h] += ds
+
+    @pl.when(bi == n_b - 1)
+    def _finalize():
+        db_ref[...] = db_scr[...]
 
 
 # ---------------------------------------------------------------------------
@@ -267,6 +425,24 @@ def _pad_seq(x: jax.Array, target: int) -> jax.Array:
     return jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
 
 
+def _pad_last(x: jax.Array, target: int) -> jax.Array:
+    pad = target - x.shape[-1]
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+
+
+def _head_pad_target(d: int) -> int:
+    """Next supported head tile >= d (64/128/256), or the 128-padded width
+    past 256. Zero-padded lanes contribute 0 to q·k and produce output
+    columns the wrappers slice off, so ANY head dim runs on the flash path
+    (the dispatch allowlist used to punt d=80-style towers to XLA)."""
+    for t in _HEAD_TILES:
+        if d <= t:
+            return t
+    return _ceil_to(d, _LANES)
+
+
 def _interpret() -> bool:
     # looked up per call (NOT cached): scripts may configure the platform
     # after an earlier flash-attention touch, and a cached answer would
@@ -278,6 +454,9 @@ from jimm_tpu.utils.compat import pallas_tpu_compiler_params
 
 _SEMANTICS = pallas_tpu_compiler_params(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
+#: the dbias grid: batch innermost so the bias tile accumulates in scratch
+_SEMANTICS4 = pallas_tpu_compiler_params(
+    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 
 def _causal_kv_index(block_q: int, block_k: int, n_k: int):
@@ -302,143 +481,265 @@ def _causal_q_index(block_q: int, block_k: int, lse_layout: bool = False):
         return (h, 0, i) if lse_layout else (h, i, 0)
     return idx
 
+
+def _mask_fwd_index(block_q: int, block_k: int, n_k: int, causal: bool):
+    """Additive-mask rows live in lse layout (heads, 1, Sk); clamp the kv
+    index exactly like `_causal_kv_index` so skipped cells elide DMAs."""
+    if not causal:
+        return lambda h, i, j: (h, 0, j)
+
+    def idx(h, i, j):
+        jmax = jnp.minimum(n_k - 1, ((i + 1) * block_q - 1) // block_k)
+        return (h, 0, jnp.minimum(j, jmax))
+    return idx
+
+
+def _bias_fwd_index(block_q: int, block_k: int, n_k: int, n_hb: int,
+                    causal: bool):
+    """Bias tiles are per-HEAD (no batch dim): flattened head-block h of
+    the (B*N)-row grid maps to bias head-block ``h % (N/hb)``."""
+    if not causal:
+        return lambda h, i, j: (h % n_hb, i, j)
+
+    def idx(h, i, j):
+        jmax = jnp.minimum(n_k - 1, ((i + 1) * block_q - 1) // block_k)
+        return (h % n_hb, i, jnp.minimum(j, jmax))
+    return idx
+
+
+def _bias_dkv_index(block_q: int, block_k: int, n_hb: int, causal: bool):
+    if not causal:
+        return lambda h, j, i: (h % n_hb, i, j)
+
+    def idx(h, j, i):
+        i = jnp.maximum(i, (j * block_k) // block_q)
+        return (h % n_hb, i, j)
+    return idx
+
+
 #: VMEM budget for one grid cell's resident tiles (of ~16MB/core), leaving
 #: room for Mosaic's input double-buffering and intermediates
 _VMEM_BUDGET = 8 * 1024 * 1024
 
 
-def _per_head_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
+def _per_head_vmem_bytes(block_q: int, block_k: int, d: int, *,
+                         kind: str = "softmax", has_mask: bool = False,
+                         has_bias: bool = False) -> int:
     """Estimated resident VMEM per head in one grid cell — the model behind
     `_pick_hb`, exposed for `scripts/vmem_probe.py` to validate against
-    Mosaic's compile-time accounting (one shared formula, no drift)."""
-    return (
-        3 * block_k * d * 2            # k/v in + one of q/do
-        + 2 * block_q * d * 2          # q tile + bf16 out tile
-        + 2 * block_q * _LANES * 4     # m/l stats scratch
-        + 2 * block_q * d * 4          # fp32 accumulators
-        + block_q * block_k * 6)       # s fp32 + p bf16 intermediate
+    Mosaic's compile-time accounting (one shared formula, no drift). The
+    per-variant terms are mirrored jax-free in `tune/space.py`
+    (sync-tested in tests/test_tune.py)."""
+    n = (3 * block_k * d * 2            # k/v in + one of q/do
+         + 2 * block_q * d * 2          # q tile + bf16 out tile
+         + 2 * block_q * d * 4          # fp32 accumulators
+         + block_q * block_k * 6)       # s fp32 + p bf16 intermediate
+    if kind == "softmax":
+        n += 2 * block_q * _LANES * 4   # m/l stats scratch (sigmoid: none)
+    if has_mask:
+        n += block_k * 4                # additive key-padding row
+    if has_bias:
+        n += 2 * block_q * block_k * 4  # bias in-tile + dbias scratch/out
+    return n
 
 
-def _pick_hb(bn: int, block_q: int, block_k: int, d: int) -> int:
+def _pick_hb(bn: int, block_q: int, block_k: int, d: int,
+             spec: VariantSpec = _SOFTMAX, n_heads: int | None = None) -> int:
     """Heads per grid cell: the per-head (S, 64) matmuls are too small to
     hide the ~us grid-step sequencing cost, so each cell processes `hb`
-    heads back to back (measured ~2x on ViT-shape attention on v5e)."""
-    per_head = _per_head_vmem_bytes(block_q, block_k, d)
+    heads back to back (measured ~2x on ViT-shape attention on v5e). The
+    bias variant additionally needs hb | N so a head block never straddles
+    two samples' rows (its bias index map divides by N/hb)."""
+    per_head = _per_head_vmem_bytes(block_q, block_k, d, kind=spec.kind,
+                                    has_mask=spec.has_mask,
+                                    has_bias=spec.has_bias)
     for hb in (8, 4, 2):
-        if bn % hb == 0 and hb * per_head <= _VMEM_BUDGET:
+        if bn % hb:
+            continue
+        if spec.has_bias and (n_heads or bn) % hb:
+            continue
+        if hb * per_head <= _VMEM_BUDGET:
             return hb
     return 1
 
 
-def _flash_fwd_impl(q3, k3, v3, causal, sm_scale, block_q, block_k):
+def _fwd_pallas(q3, k3, v3, maskadd, bias, causal, spec, sm_scale,
+                logit_bias, block_q, block_k):
+    """Assemble and run the forward pallas_call for any variant. Returns
+    (o_padded, lse_padded_or_None)."""
+    softmax = spec.kind == "softmax"
     bn, sq, d = q3.shape
     sk = k3.shape[1]
     sq_p, sk_p = _ceil_to(sq, block_q), _ceil_to(sk, block_k)
     qp, kp, vp = (_pad_seq(q3, sq_p), _pad_seq(k3, sk_p), _pad_seq(v3, sk_p))
     n_q, n_k = sq_p // block_q, sk_p // block_k
-    hb = _pick_hb(bn, block_q, block_k, d)
+    n_heads = bias.shape[0] if spec.has_bias else bn
+    hb = _pick_hb(bn, block_q, block_k, d, spec, n_heads)
     kernel = partial(_fwd_kernel, sk_real=sk, block_k=block_k, causal=causal,
-                     sm_scale=sm_scale, n_k=n_k)
+                     sm_scale=sm_scale, logit_bias=logit_bias, n_k=n_k,
+                     spec=spec)
     kv_idx = (_causal_kv_index(block_q, block_k, n_k) if causal
               else (lambda h, i, j: (h, j, 0)))
-    o, lse = pl.pallas_call(
+    inputs = [qp, kp, vp]
+    in_specs = [
+        pl.BlockSpec((hb, block_q, d), lambda h, i, j: (h, i, 0)),
+        pl.BlockSpec((hb, block_k, d), kv_idx),
+        pl.BlockSpec((hb, block_k, d), kv_idx),
+    ]
+    if spec.has_mask:
+        inputs.append(jnp.pad(maskadd, ((0, 0), (0, 0), (0, sk_p - sk))))
+        in_specs.append(pl.BlockSpec(
+            (hb, 1, block_k), _mask_fwd_index(block_q, block_k, n_k, causal)))
+    if spec.has_bias:
+        inputs.append(jnp.pad(bias, ((0, 0), (0, sq_p - sq),
+                                     (0, sk_p - sk))))
+        in_specs.append(pl.BlockSpec(
+            (hb, block_q, block_k),
+            _bias_fwd_index(block_q, block_k, n_k, n_heads // hb, causal)))
+    out_specs = [pl.BlockSpec((hb, block_q, d), lambda h, i, j: (h, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bn, sq_p, d), q3.dtype)]
+    scratch = [pltpu.VMEM((hb, block_q, d), jnp.float32)]
+    if softmax:
+        out_specs.append(pl.BlockSpec((hb, 1, block_q),
+                                      lambda h, i, j: (h, 0, i)))
+        out_shape.append(jax.ShapeDtypeStruct((bn, 1, sq_p), jnp.float32))
+        scratch = [pltpu.VMEM((hb, block_q, _LANES), jnp.float32),
+                   pltpu.VMEM((hb, block_q, _LANES), jnp.float32)] + scratch
+    outs = pl.pallas_call(
         kernel,
         grid=(bn // hb, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((hb, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((hb, block_k, d), kv_idx),
-            pl.BlockSpec((hb, block_k, d), kv_idx),
-        ],
-        out_specs=[
-            pl.BlockSpec((hb, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((hb, 1, block_q), lambda h, i, j: (h, 0, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bn, sq_p, d), q3.dtype),
-            jax.ShapeDtypeStruct((bn, 1, sq_p), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((hb, block_q, _LANES), jnp.float32),
-            pltpu.VMEM((hb, block_q, _LANES), jnp.float32),
-            pltpu.VMEM((hb, block_q, d), jnp.float32),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         compiler_params=_SEMANTICS,
         interpret=_interpret(),
-    )(qp, kp, vp)
+    )(*inputs)
+    return outs[0], (outs[1] if softmax else None)
+
+
+def _flash_fwd_impl(q3, k3, v3, maskadd, bias, causal, spec, sm_scale,
+                    logit_bias, block_q, block_k):
+    sq = q3.shape[1]
+    o, lse = _fwd_pallas(q3, k3, v3, maskadd, bias, causal, spec, sm_scale,
+                         logit_bias, block_q, block_k)
     # the names make o/lse saveable by remat policies (`"dots"` in
     # `Transformer._remat_policy` saves them): jax.checkpoint traces through
     # custom_vjp fwd rules, and without a saveable mark the whole forward
     # kernel would re-run inside the backward pass of a remat'd layer
     o = checkpoint_name(o[:, :sq], "flash_o")
-    lse = checkpoint_name(lse[:, 0, :sq], "flash_lse")
-    return o, (q3, k3, v3, o, lse)
+    if lse is not None:
+        lse = checkpoint_name(lse[:, 0, :sq], "flash_lse")
+    return o, (q3, k3, v3, maskadd, bias, o, lse)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q3, k3, v3, causal, sm_scale, block_q, block_k):
-    o, _ = _flash_fwd_impl(q3, k3, v3, causal, sm_scale, block_q, block_k)
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q3, k3, v3, maskadd, bias, causal, spec, sm_scale, logit_bias,
+           block_q, block_k):
+    o, _ = _flash_fwd_impl(q3, k3, v3, maskadd, bias, causal, spec,
+                           sm_scale, logit_bias, block_q, block_k)
     return o
 
 
-def _flash_fwd(q3, k3, v3, causal, sm_scale, block_q, block_k):
-    return _flash_fwd_impl(q3, k3, v3, causal, sm_scale, block_q, block_k)
+def _flash_fwd(q3, k3, v3, maskadd, bias, causal, spec, sm_scale,
+               logit_bias, block_q, block_k):
+    return _flash_fwd_impl(q3, k3, v3, maskadd, bias, causal, spec,
+                           sm_scale, logit_bias, block_q, block_k)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, do, dlse=None):
-    q3, k3, v3, o, lse = res
+def _flash_bwd(causal, spec, sm_scale, logit_bias, block_q, block_k, res,
+               do, dlse=None):
+    softmax = spec.kind == "softmax"
+    q3, k3, v3, maskadd, bias, o, lse = res
     bn, sq, d = q3.shape
     sk = k3.shape[1]
     sq_p, sk_p = _ceil_to(sq, block_q), _ceil_to(sk, block_k)
     n_q, n_k = sq_p // block_q, sk_p // block_k
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    if dlse is not None:
-        # An lse cotangent folds exactly into delta: the lse output adds
-        # dlse_i * p_ij to ds_ij, and the kernels compute
-        # ds = p * (dp - delta), so delta -= dlse covers it for free.
-        delta = delta - dlse.astype(jnp.float32)
     qp, dop = _pad_seq(q3, sq_p), _pad_seq(do, sq_p)
     kp, vp = _pad_seq(k3, sk_p), _pad_seq(v3, sk_p)
-    lse_p = jnp.pad(lse, ((0, 0), (0, sq_p - lse.shape[1])))[:, None]
-    delta_p = jnp.pad(delta, ((0, 0), (0, sq_p - delta.shape[1])))[:, None]
+    n_heads = bias.shape[0] if spec.has_bias else bn
+    hb = _pick_hb(bn, block_q, block_k, d, spec, n_heads)
+    n_hb = n_heads // hb
 
-    hb = _pick_hb(bn, block_q, block_k, d)
+    mp = (jnp.pad(maskadd, ((0, 0), (0, 0), (0, sk_p - sk)))
+          if spec.has_mask else None)
+    bp = (jnp.pad(bias, ((0, 0), (0, sq_p - sq), (0, sk_p - sk)))
+          if spec.has_bias else None)
+    stats = []
+    if softmax:
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1)
+        if dlse is not None:
+            # An lse cotangent folds exactly into delta: the lse output adds
+            # dlse_i * p_ij to ds_ij, and the kernels compute
+            # ds = p * (dp - delta), so delta -= dlse covers it for free.
+            delta = delta - dlse.astype(jnp.float32)
+        lse_p = jnp.pad(lse, ((0, 0), (0, sq_p - lse.shape[1])))[:, None]
+        delta_p = jnp.pad(delta, ((0, 0), (0, sq_p - delta.shape[1])))[:, None]
+        stats = [lse_p, delta_p]
+
+    # ---- dq ---------------------------------------------------------------
     kv_idx = (_causal_kv_index(block_q, block_k, n_k) if causal
               else (lambda h, i, j: (h, j, 0)))
+    q_spec = pl.BlockSpec((hb, block_q, d), lambda h, i, j: (h, i, 0))
+    stat_spec = pl.BlockSpec((hb, 1, block_q), lambda h, i, j: (h, 0, i))
+    dq_inputs = [qp, kp, vp]
+    dq_specs = [q_spec, pl.BlockSpec((hb, block_k, d), kv_idx),
+                pl.BlockSpec((hb, block_k, d), kv_idx)]
+    if spec.has_mask:
+        dq_inputs.append(mp)
+        dq_specs.append(pl.BlockSpec(
+            (hb, 1, block_k), _mask_fwd_index(block_q, block_k, n_k, causal)))
+    if spec.has_bias:
+        dq_inputs.append(bp)
+        dq_specs.append(pl.BlockSpec(
+            (hb, block_q, block_k),
+            _bias_fwd_index(block_q, block_k, n_k, n_hb, causal)))
+    dq_inputs.append(dop)
+    dq_specs.append(q_spec)
+    if softmax:
+        dq_inputs += stats
+        dq_specs += [stat_spec, stat_spec]
     dq = pl.pallas_call(
         partial(_bwd_dq_kernel, sk_real=sk, block_k=block_k, causal=causal,
-                sm_scale=sm_scale, n_k=n_k),
+                sm_scale=sm_scale, logit_bias=logit_bias, n_k=n_k, spec=spec),
         grid=(bn // hb, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((hb, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((hb, block_k, d), kv_idx),
-            pl.BlockSpec((hb, block_k, d), kv_idx),
-            pl.BlockSpec((hb, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((hb, 1, block_q), lambda h, i, j: (h, 0, i)),
-            pl.BlockSpec((hb, 1, block_q), lambda h, i, j: (h, 0, i)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((hb, block_q, d), lambda h, i, j: (h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bn, sq_p, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((hb, block_q, d), jnp.float32)],
         compiler_params=_SEMANTICS,
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lse_p, delta_p)[:, :sq]
+    )(*dq_inputs)[:, :sq]
 
+    # ---- dk / dv ----------------------------------------------------------
     q_idx = (_causal_q_index(block_q, block_k) if causal
              else (lambda h, j, i: (h, i, 0)))
     stat_idx = (_causal_q_index(block_q, block_k, lse_layout=True) if causal
                 else (lambda h, j, i: (h, 0, i)))
+    kv_spec = pl.BlockSpec((hb, block_k, d), lambda h, j, i: (h, j, 0))
+    dkv_inputs = [qp, kp, vp]
+    dkv_specs = [pl.BlockSpec((hb, block_q, d), q_idx), kv_spec, kv_spec]
+    if spec.has_mask:
+        dkv_inputs.append(mp)
+        dkv_specs.append(pl.BlockSpec((hb, 1, block_k),
+                                      lambda h, j, i: (h, 0, j)))
+    if spec.has_bias:
+        dkv_inputs.append(bp)
+        dkv_specs.append(pl.BlockSpec(
+            (hb, block_q, block_k),
+            _bias_dkv_index(block_q, block_k, n_hb, causal)))
+    dkv_inputs.append(dop)
+    dkv_specs.append(pl.BlockSpec((hb, block_q, d), q_idx))
+    if softmax:
+        dkv_inputs += stats
+        dkv_specs += [pl.BlockSpec((hb, 1, block_q), stat_idx),
+                      pl.BlockSpec((hb, 1, block_q), stat_idx)]
     dk, dv = pl.pallas_call(
         partial(_bwd_dkv_kernel, sq_real=sq, block_q=block_q, causal=causal,
-                sm_scale=sm_scale, n_q=n_q),
+                sm_scale=sm_scale, logit_bias=logit_bias, n_q=n_q, spec=spec),
         grid=(bn // hb, n_k, n_q),
-        in_specs=[
-            pl.BlockSpec((hb, block_q, d), q_idx),
-            pl.BlockSpec((hb, block_k, d), lambda h, j, i: (h, j, 0)),
-            pl.BlockSpec((hb, block_k, d), lambda h, j, i: (h, j, 0)),
-            pl.BlockSpec((hb, block_q, d), q_idx),
-            pl.BlockSpec((hb, 1, block_q), stat_idx),
-            pl.BlockSpec((hb, 1, block_q), stat_idx),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((hb, block_k, d), lambda h, j, i: (h, j, 0)),
             pl.BlockSpec((hb, block_k, d), lambda h, j, i: (h, j, 0)),
@@ -453,11 +754,62 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do, dlse=None):
         ],
         compiler_params=_SEMANTICS,
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lse_p, delta_p)
-    return dq, dk[:, :sk], dv[:, :sk]
+    )(*dkv_inputs)
+
+    # ---- dbias ------------------------------------------------------------
+    dbias = None
+    if spec.has_bias:
+        n_b = bn // n_heads
+        q_idx4 = lambda h, i, j, b: (b * n_hb + h, i, 0)      # noqa: E731
+        kv_idx4 = lambda h, i, j, b: (b * n_hb + h, j, 0)     # noqa: E731
+        stat_idx4 = lambda h, i, j, b: (b * n_hb + h, 0, i)   # noqa: E731
+        db_inputs = [qp, kp, vp]
+        db_specs = [pl.BlockSpec((hb, block_q, d), q_idx4),
+                    pl.BlockSpec((hb, block_k, d), kv_idx4),
+                    pl.BlockSpec((hb, block_k, d), kv_idx4)]
+        if spec.has_mask:
+            db_inputs.append(mp)
+            db_specs.append(pl.BlockSpec(
+                (hb, 1, block_k), lambda h, i, j, b: (b * n_hb + h, 0, j)))
+        db_inputs.append(bp)
+        db_specs.append(pl.BlockSpec((hb, block_q, block_k),
+                                     lambda h, i, j, b: (h, i, j)))
+        db_inputs.append(dop)
+        db_specs.append(pl.BlockSpec((hb, block_q, d), q_idx4))
+        if softmax:
+            db_inputs += stats
+            db_specs += [pl.BlockSpec((hb, 1, block_q), stat_idx4),
+                         pl.BlockSpec((hb, 1, block_q), stat_idx4)]
+        dbias = pl.pallas_call(
+            partial(_bwd_dbias_kernel, sq_real=sq, sk_real=sk,
+                    block_q=block_q, block_k=block_k, causal=causal,
+                    sm_scale=sm_scale, logit_bias=logit_bias, n_b=n_b,
+                    spec=spec),
+            grid=(n_hb, n_q, n_k, n_b),
+            in_specs=db_specs,
+            out_specs=pl.BlockSpec((hb, block_q, block_k),
+                                   lambda h, i, j, b: (h, i, j)),
+            out_shape=jax.ShapeDtypeStruct((n_heads, sq_p, sk_p),
+                                           jnp.float32),
+            scratch_shapes=[pltpu.VMEM((hb, block_q, block_k), jnp.float32)],
+            compiler_params=_SEMANTICS4,
+            interpret=_interpret(),
+        )(*db_inputs)[:, :sq, :sk]
+
+    # the mask is non-learnable by contract (it is expanded from a boolean
+    # key-padding mask host-side); its zero cotangent dead-ends in the
+    # wrapper's jnp.where over constants
+    dmask = jnp.zeros_like(maskadd) if spec.has_mask else None
+    return dq, dk[:, :sk], dv[:, :sk], dmask, dbias
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+def _flash_vjp_bwd(causal, spec, sm_scale, logit_bias, block_q, block_k,
+                   res, do):
+    return _flash_bwd(causal, spec, sm_scale, logit_bias, block_q, block_k,
+                      res, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_vjp_bwd)
 
 
 def _pick_block(seq: int, requested: int) -> int:
@@ -478,16 +830,19 @@ def _pick_block(seq: int, requested: int) -> int:
     return best[1] if best else _LANES
 
 
-def _resolve_blocks(q, k, v, block_q, block_k):
+def _resolve_blocks(q, k, v, block_q, block_k,
+                    kernel: str = "flash_attention"):
     """Trace-time (host-side) block resolution through the tune cache:
     ``None`` means "tuned value if the persistent cache has one for these
     shapes/dtypes, else the shipped default" — lookup only, never a
     measurement (docs/tuning.md). Explicit ints win, so the tuner's own
-    bench closures cannot recurse."""
+    bench closures cannot recurse. Each family member looks up under its
+    own kernel name (its VMEM footprint, and therefore its feasible and
+    optimal blocks, differ)."""
     if block_q is not None and block_k is not None:
         return int(block_q), int(block_k)
     from jimm_tpu.tune import best_config
-    cfg = best_config("flash_attention", (q.shape, k.shape, v.shape),
+    cfg = best_config(kernel, (q.shape, k.shape, v.shape),
                       (q.dtype, k.dtype, v.dtype),
                       default={"block_q": DEFAULT_BLOCK_Q,
                                "block_k": DEFAULT_BLOCK_K})
@@ -495,17 +850,53 @@ def _resolve_blocks(q, k, v, block_q, block_k):
             int(block_k if block_k is not None else cfg["block_k"]))
 
 
-def _prologue(q, k, v, block_q, block_k):
-    """Shared head-flattening + scale/block selection for both entry points."""
+def _prologue(q, k, v, block_q, block_k, kernel: str = "flash_attention"):
+    """Shared head-flattening + scale/block selection for every entry
+    point. Pads off-tile head dims up (scale still uses the REAL d)."""
     d = q.shape[-1]
     sm_scale = 1.0 / (d ** 0.5)
-    block_q, block_k = _resolve_blocks(q, k, v, block_q, block_k)
+    block_q, block_k = _resolve_blocks(q, k, v, block_q, block_k,
+                                       kernel=kernel)
     block_q = min(_pick_block(q.shape[1], block_q),
                   _ceil_to(q.shape[1], 128))
     block_k = min(_pick_block(k.shape[1], block_k),
                   _ceil_to(k.shape[1], 128))
     q3, k3, v3 = map(_flatten_heads, (q, k, v))
+    dp = _head_pad_target(d)
+    if dp != d:
+        q3, k3, v3 = (_pad_last(x, dp) for x in (q3, k3, v3))
     return q3, k3, v3, sm_scale, block_q, block_k
+
+
+def _canon_mask(mask: jax.Array, b: int, sk: int) -> jax.Array:
+    """Accept ``(B, Sk)`` or the dispatch convention ``(B, 1, 1, Sk)``
+    (bool/int, True = attend); return ``(B, Sk)`` bool."""
+    if mask.ndim == 4:
+        if mask.shape[1] != 1 or mask.shape[2] != 1:
+            raise ValueError(
+                "masked flash attention supports KEY-PADDING masks only "
+                f"((B, Sk) or (B, 1, 1, Sk)); got {mask.shape} — arbitrary "
+                "(B, N, Sq, Sk) masks need impl='xla'")
+        mask = mask[:, 0, 0, :]
+    if mask.shape != (b, sk):
+        raise ValueError(f"key-padding mask shape {mask.shape} does not "
+                         f"match (B, Sk)=({b}, {sk})")
+    return mask != 0
+
+
+def _expand_mask(mask: jax.Array, n: int) -> jax.Array:
+    """(B, Sk) bool -> (B*N, 1, Sk) additive f32 rows (0 keep / NEG_INF
+    drop), replicated per head in `_flatten_heads` row order."""
+    b, sk = mask.shape
+    add = jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
+    return jnp.broadcast_to(add[:, None, None, :],
+                            (b, n, 1, sk)).reshape(b * n, 1, sk)
+
+
+def _canon_bias(bias: jax.Array, n: int, sq: int, sk: int) -> jax.Array:
+    """Broadcast an additive bias to per-head ``(N, Sq, Sk)`` f32 (grads
+    flow back through the broadcast to the caller's shape)."""
+    return jnp.broadcast_to(bias.astype(jnp.float32), (n, sq, sk))
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -516,11 +907,81 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     `jax.nn.dot_product_attention`. Runs the Pallas interpreter off-TPU so
     CPU tests exercise the same code path. Block sizes default to the tune
     cache's answer for these shapes (falling back to ``DEFAULT_BLOCK_*``)."""
-    b, _, n, _ = q.shape
+    b, _, n, d = q.shape
     q3, k3, v3, sm_scale, block_q, block_k = _prologue(q, k, v, block_q,
                                                        block_k)
-    o = _flash(q3, k3, v3, is_causal, sm_scale, block_q, block_k)
-    return _unflatten_heads(o, b, n)
+    o = _flash(q3, k3, v3, None, None, is_causal, _SOFTMAX, sm_scale, 0.0,
+               block_q, block_k)
+    return _unflatten_heads(o, b, n)[..., :d]
+
+
+def flash_attention_masked(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mask: jax.Array, *,
+                           is_causal: bool = False,
+                           block_q: int | None = None,
+                           block_k: int | None = None) -> jax.Array:
+    """Flash attention with a per-sample key-padding mask (the NaFlex /
+    MAP-pooling case): ``mask`` is ``(B, Sk)`` or ``(B, 1, 1, Sk)``
+    bool/int, True = attend. Masked keys receive exactly zero attention
+    and zero gradient. Rows with NO valid key produce finite garbage (see
+    module docstring) — mask them downstream, as NaFlex pooling does."""
+    b, _, n, d = q.shape
+    sk = k.shape[1]
+    maskadd = _expand_mask(_canon_mask(mask, b, sk), n)
+    q3, k3, v3, sm_scale, block_q, block_k = _prologue(
+        q, k, v, block_q, block_k, kernel="flash_attention_masked")
+    spec = VariantSpec(kind="softmax", has_mask=True)
+    o = _flash(q3, k3, v3, maskadd, None, is_causal, spec, sm_scale, 0.0,
+               block_q, block_k)
+    return _unflatten_heads(o, b, n)[..., :d]
+
+
+def flash_attention_bias(q: jax.Array, k: jax.Array, v: jax.Array,
+                         bias: jax.Array, *,
+                         is_causal: bool = False,
+                         block_q: int | None = None,
+                         block_k: int | None = None) -> jax.Array:
+    """Flash attention with an additive logits bias broadcastable to
+    ``(N, Sq, Sk)`` (relative-position style; shared across the batch).
+    Differentiable in ``bias`` — the backward runs a dedicated
+    batch-innermost accumulation kernel, never materializing
+    ``(B, N, Sq, Sk)``."""
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    bias3 = _canon_bias(bias, n, sq, sk)
+    q3, k3, v3, sm_scale, block_q, block_k = _prologue(
+        q, k, v, block_q, block_k, kernel="flash_attention_bias")
+    spec = VariantSpec(kind="softmax", has_bias=True)
+    o = _flash(q3, k3, v3, None, bias3, is_causal, spec, sm_scale, 0.0,
+               block_q, block_k)
+    return _unflatten_heads(o, b, n)[..., :d]
+
+
+def sigmoid_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      is_causal: bool = False,
+                      mask: jax.Array | None = None,
+                      logit_bias: float | None = None,
+                      block_q: int | None = None,
+                      block_k: int | None = None) -> jax.Array:
+    """Sigmoid attention: ``o = sigmoid(q k^T / sqrt(D) + logit_bias) v``
+    — no row normalizer, so the online loop keeps no statistics and the
+    backward needs no lse/delta. ``logit_bias`` defaults to ``-log(Sk)``
+    (the sigmoid-attention paper's initialization, which matches softmax's
+    1/Sk row mass at init). Optional key-padding ``mask`` as in
+    `flash_attention_masked`; masked (and fully-masked) rows are exactly
+    zero here — sigmoid(NEG_INF) underflows to 0, no garbage rows."""
+    b, _, n, d = q.shape
+    sk = k.shape[1]
+    if logit_bias is None:
+        logit_bias = -math.log(max(sk, 1))
+    spec = VariantSpec(kind="sigmoid", has_mask=mask is not None)
+    maskadd = (_expand_mask(_canon_mask(mask, b, sk), n)
+               if mask is not None else None)
+    q3, k3, v3, sm_scale, block_q, block_k = _prologue(
+        q, k, v, block_q, block_k, kernel="sigmoid_attention")
+    o = _flash(q3, k3, v3, maskadd, None, is_causal, spec, sm_scale,
+               float(logit_bias), block_q, block_k)
+    return _unflatten_heads(o, b, n)[..., :d]
 
 
 # ---------------------------------------------------------------------------
@@ -529,14 +990,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_lse(q3, k3, v3, causal, sm_scale, block_q, block_k):
-    o, (_, _, _, _, lse) = _flash_fwd_impl(q3, k3, v3, causal, sm_scale,
-                                           block_q, block_k)
-    return o, lse
+    o, res = _flash_fwd_impl(q3, k3, v3, None, None, causal, _SOFTMAX,
+                             sm_scale, 0.0, block_q, block_k)
+    return o, res[6]
 
 
 def _flash_lse_fwd(q3, k3, v3, causal, sm_scale, block_q, block_k):
-    o, res = _flash_fwd_impl(q3, k3, v3, causal, sm_scale, block_q, block_k)
-    return (o, res[4]), res
+    o, res = _flash_fwd_impl(q3, k3, v3, None, None, causal, _SOFTMAX,
+                             sm_scale, 0.0, block_q, block_k)
+    return (o, res[6]), res
 
 
 def _flash_lse_bwd(causal, sm_scale, block_q, block_k, res, cts):
@@ -544,7 +1006,9 @@ def _flash_lse_bwd(causal, sm_scale, block_q, block_k, res, cts):
     # The lse cotangent is exact and free: it folds into the delta term of
     # the standard flash backward (see _flash_bwd) — no extra passes, no
     # materialized attention matrix.
-    return _flash_bwd(causal, sm_scale, block_q, block_k, res, do, dlse)
+    dq, dk, dv, _, _ = _flash_bwd(causal, _SOFTMAX, sm_scale, 0.0, block_q,
+                                  block_k, res, do, dlse)
+    return dq, dk, dv
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -558,8 +1022,8 @@ def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Like `flash_attention` but also returns the per-row logsumexp
     ``(B, N, S)`` so partial results over kv chunks can be merged exactly
     (the ring-attention combine)."""
-    b, sq, n, _ = q.shape
+    b, sq, n, d = q.shape
     q3, k3, v3, sm_scale, block_q, block_k = _prologue(q, k, v, block_q,
                                                        block_k)
     o3, lse3 = _flash_lse(q3, k3, v3, is_causal, sm_scale, block_q, block_k)
-    return _unflatten_heads(o3, b, n), lse3.reshape(b, n, sq)
+    return _unflatten_heads(o3, b, n)[..., :d], lse3.reshape(b, n, sq)

@@ -26,6 +26,16 @@ DEFAULT_BATCH_BUCKETS: tuple[int, ...] = (1, 2, 4, 8)
 #: inference bench tracks (256 is BASELINE's inference batch).
 TPU_BATCH_BUCKETS: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
+#: NaFlex token-sequence buckets: variable-resolution batches pad their
+#: patch sequences to the nearest bucket, so the NaFlex forward compiles
+#: one program per (batch bucket, seq bucket) pair instead of one per
+#: traffic-dependent grid. 256 = a 16x16 patch grid, 576 = 24x24 (the
+#: SigLIP2 NaFlex training default), 1024 = 32x32. Padding is carried by
+#: the key mask, which the attention dispatch runs on the masked flash
+#: variant — mask CONTENTS are runtime data, so every real-token count
+#: shares the bucket's one executable.
+DEFAULT_NAFLEX_SEQ_BUCKETS: tuple[int, ...] = (256, 576, 1024)
+
 #: precisions a serving stack can declare. The dtype names the precision
 #: the warm-compiled forwards COMPUTE in — batch assembly stays fp32
 #: images; "int8" means quantized weights + dynamic int8 activations

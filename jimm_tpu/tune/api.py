@@ -26,9 +26,10 @@ from typing import Any, Callable, Mapping, Sequence
 from jimm_tpu import obs
 from jimm_tpu.tune.cache import TuneCache, TuneKey, tune_key
 from jimm_tpu.tune.measure import measure
-from jimm_tpu.tune.space import (flash_space, int8_flash_space,
-                                 int8_matmul_space, ln_space,
-                                 retrieval_space)
+from jimm_tpu.tune.space import (bias_flash_space, flash_space,
+                                 int8_flash_space, int8_matmul_space,
+                                 ln_space, masked_flash_space,
+                                 retrieval_space, sigmoid_space)
 
 __all__ = ["KERNELS", "KernelSpec", "best_config", "configure", "get_cache",
            "tune_kernel"]
@@ -64,6 +65,82 @@ def _flash_bench(shapes: Shapes, dtypes: Dtypes,
 
     def loss(q, k, v):
         o = flash_attention(q, k, v, block_q=bq, block_k=bk)
+        return jnp.sum(o.astype(jnp.float32))
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return lambda: step(q, k, v)
+
+
+def _attn_qkv(shapes: Shapes, dtypes: Dtypes):
+    import jax
+    import jax.numpy as jnp
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    dt = jnp.dtype(dtypes[0]) if dtypes else jnp.float32
+    return (jax.random.normal(kq, tuple(shapes[0]), dt),
+            jax.random.normal(kk, tuple(shapes[1]), dt),
+            jax.random.normal(kv, tuple(shapes[2]), dt))
+
+
+def _masked_flash_bench(shapes: Shapes, dtypes: Dtypes,
+                        config: Mapping[str, int]) -> Callable[[], Any]:
+    """Timed closure: masked flash fwd+bwd with a NaFlex-shaped key-padding
+    mask (~25% padded keys, every row keeps its first key). Explicit block
+    kwargs bypass the tuner — no recursion."""
+    import jax
+    import jax.numpy as jnp
+
+    from jimm_tpu.ops.flash_attention import flash_attention_masked
+    q, k, v = _attn_qkv(shapes, dtypes)
+    b, sk = q.shape[0], k.shape[1]
+    mask = (jax.random.uniform(jax.random.PRNGKey(1), (b, sk)) > 0.25)
+    mask = mask.at[:, 0].set(True)
+    bq, bk = int(config["block_q"]), int(config["block_k"])
+
+    def loss(q, k, v):
+        o = flash_attention_masked(q, k, v, mask, block_q=bq, block_k=bk)
+        return jnp.sum(o.astype(jnp.float32))
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return lambda: step(q, k, v)
+
+
+def _bias_flash_bench(shapes: Shapes, dtypes: Dtypes,
+                      config: Mapping[str, int]) -> Callable[[], Any]:
+    """Timed closure: bias flash fwd+bwd including the dbias accumulation
+    kernel (the variant's distinguishing cost). Explicit block kwargs
+    bypass the tuner — no recursion."""
+    import jax
+    import jax.numpy as jnp
+
+    from jimm_tpu.ops.flash_attention import flash_attention_bias
+    q, k, v = _attn_qkv(shapes, dtypes)
+    sq, sk, n = q.shape[1], k.shape[1], q.shape[2]
+    bias = jax.random.normal(jax.random.PRNGKey(1), (n, sq, sk),
+                             jnp.float32)
+    bq, bk = int(config["block_q"]), int(config["block_k"])
+
+    def loss(q, k, v, bias):
+        o = flash_attention_bias(q, k, v, bias, block_q=bq, block_k=bk)
+        return jnp.sum(o.astype(jnp.float32))
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))
+    return lambda: step(q, k, v, bias)
+
+
+def _sigmoid_bench(shapes: Shapes, dtypes: Dtypes,
+                   config: Mapping[str, int]) -> Callable[[], Any]:
+    """Timed closure: sigmoid attention fwd+bwd (training is the consumer
+    — the variant exists for SigLIP-style towers). Explicit block kwargs
+    bypass the tuner — no recursion."""
+    import jax
+    import jax.numpy as jnp
+
+    from jimm_tpu.ops.flash_attention import sigmoid_attention
+    q, k, v = _attn_qkv(shapes, dtypes)
+    bq, bk = int(config["block_q"]), int(config["block_k"])
+
+    def loss(q, k, v):
+        o = sigmoid_attention(q, k, v, block_q=bq, block_k=bk)
         return jnp.sum(o.astype(jnp.float32))
 
     step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
@@ -198,6 +275,16 @@ KERNELS: dict[str, KernelSpec] = {
     "flash_attention": KernelSpec(version=1, space=flash_space,
                                   default=_flash_default,
                                   bench=_flash_bench),
+    "flash_attention_masked": KernelSpec(version=1,
+                                         space=masked_flash_space,
+                                         default=_flash_default,
+                                         bench=_masked_flash_bench),
+    "flash_attention_bias": KernelSpec(version=1, space=bias_flash_space,
+                                       default=_flash_default,
+                                       bench=_bias_flash_bench),
+    "sigmoid_attention": KernelSpec(version=1, space=sigmoid_space,
+                                    default=_flash_default,
+                                    bench=_sigmoid_bench),
     "layer_norm": KernelSpec(version=1, space=ln_space,
                              default=_ln_default, bench=_ln_bench),
     "retrieval_topk": KernelSpec(version=1, space=retrieval_space,
@@ -285,7 +372,7 @@ def tune_kernel(kernel: str, shapes: Shapes, dtypes: Dtypes, *,
     for config in cands:
         fn = spec.bench(shapes, dtypes, config)
         trials.append({"config": dict(config),
-                       "time_s": measure(fn, reps=reps)})
+                       "time_s": measure(fn, reps=reps, kernel=kernel)})
     best = min(trials, key=lambda t: t["time_s"])
     fingerprint = cache.put(key, best["config"],
                             metrics={"time_s": best["time_s"],

@@ -17,8 +17,9 @@ tier-1 CPU tests (and `scripts/tune_smoke.py`) exercise the full
 measure → persist → lookup cycle.
 
 Every measurement increments ``jimm_tune_measure_total`` and runs under a
-``tune_measure`` span: the CI smoke asserts a warm cache re-run keeps the
-counter at zero.
+``tune_measure`` span (plus a per-kernel ``tune_measure_{kernel}`` span
+when the caller names the kernel — one row per attention-family variant):
+the CI smoke asserts a warm cache re-run keeps the counter at zero.
 """
 
 from __future__ import annotations
@@ -45,20 +46,24 @@ def trimmed_median(samples: Sequence[float]) -> float:
 
 
 def measure(fn: Callable[[], object], *, reps: int | None = None,
-            warmup: int = 1) -> float:
+            warmup: int = 1, kernel: str | None = None) -> float:
     """Trimmed-median wall-clock seconds of ``fn()`` (see module docstring).
 
     ``fn`` should return the computation's output (a jax array or pytree)
-    so ``block_until_ready`` has something to wait on.
+    so ``block_until_ready`` has something to wait on. ``kernel`` adds a
+    per-kernel ``tune_measure_{kernel}`` span alongside the aggregate, so
+    a dump attributes sweep time to the kernel family member that spent it.
     """
     import jax
+    from contextlib import nullcontext
 
     if reps is None:
         # interpret-mode short-circuit: off-TPU the number is not a kernel
         # timing, one rep keeps the full path testable without the cost
         reps = 7 if jax.default_backend() == "tpu" else 1
     registry = obs.get_registry("jimm_tune")
-    with obs.span("tune_measure"):
+    per_kernel = obs.span(f"tune_measure_{kernel}") if kernel else nullcontext()
+    with obs.span("tune_measure"), per_kernel:
         for _ in range(max(1, warmup)):
             jax.block_until_ready(fn())
         samples = []

@@ -14,10 +14,13 @@ from typing import Any, Sequence
 
 __all__ = ["FLASH_BLOCKS", "INT8_FLASH_BLOCKS", "INT8_MATMUL_BLOCK_M",
            "INT8_MATMUL_BLOCK_N", "LN_BLOCK_ROWS", "RETRIEVAL_BLOCK_N",
-           "VMEM_BUDGET", "flash_space", "flash_vmem_bytes",
-           "int8_flash_space", "int8_flash_vmem_bytes", "int8_matmul_space",
+           "VMEM_BUDGET", "bias_flash_space", "bias_flash_vmem_bytes",
+           "flash_space", "flash_vmem_bytes", "int8_flash_space",
+           "int8_flash_vmem_bytes", "int8_matmul_space",
            "int8_matmul_vmem_bytes", "kernel_space", "ln_space",
-           "ln_vmem_bytes", "retrieval_space", "retrieval_vmem_bytes"]
+           "ln_vmem_bytes", "masked_flash_space", "masked_flash_vmem_bytes",
+           "retrieval_space", "retrieval_vmem_bytes", "sigmoid_space",
+           "sigmoid_vmem_bytes"]
 
 _LANES = 128
 _SUBLANES = 8
@@ -56,10 +59,9 @@ def flash_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
         + block_q * block_k * 6)
 
 
-def flash_space(shapes: Sequence[Sequence[int]],
-                dtypes: Sequence[Any] = ()) -> list[dict]:
-    """Feasible ``{"block_q", "block_k"}`` candidates for q/k/v shapes
-    ``(B, S, N, D)`` (or head-flattened ``(BN, S, D)``)."""
+def _attn_space(shapes: Sequence[Sequence[int]], vmem_fn) -> list[dict]:
+    """Shared ``{"block_q", "block_k"}`` pruning for the attention family:
+    same lane-aligned candidates, variant-specific VMEM formula."""
     q, k = shapes[0], shapes[1]
     sq, sk, d = int(q[-3]), int(k[-3]), int(q[-1])
     out = []
@@ -69,10 +71,60 @@ def flash_space(shapes: Sequence[Sequence[int]],
         for bk in FLASH_BLOCKS:
             if bk > _ceil_to(sk, _LANES):
                 continue
-            if flash_vmem_bytes(bq, bk, d) > VMEM_BUDGET:
+            if vmem_fn(bq, bk, d) > VMEM_BUDGET:
                 continue
             out.append({"block_q": bq, "block_k": bk})
     return out or [{"block_q": FLASH_BLOCKS[0], "block_k": FLASH_BLOCKS[0]}]
+
+
+def flash_space(shapes: Sequence[Sequence[int]],
+                dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Feasible ``{"block_q", "block_k"}`` candidates for q/k/v shapes
+    ``(B, S, N, D)`` (or head-flattened ``(BN, S, D)``)."""
+    return _attn_space(shapes, flash_vmem_bytes)
+
+
+def masked_flash_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
+    """Softmax flash + the additive key-padding row: one f32 ``(1, bk)``
+    mask tile per grid cell (mirrors ``has_mask`` in
+    ``_per_head_vmem_bytes``)."""
+    return flash_vmem_bytes(block_q, block_k, d) + block_k * 4
+
+
+def masked_flash_space(shapes: Sequence[Sequence[int]],
+                       dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Candidates for key-padding-mask flash (NaFlex / MAP pooling)."""
+    return _attn_space(shapes, masked_flash_vmem_bytes)
+
+
+def bias_flash_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
+    """Softmax flash + two f32 ``(bq, bk)`` tiles: the resident bias
+    in-tile and the dbias scratch/out tile of the backward's accumulation
+    kernel (mirrors ``has_bias`` in ``_per_head_vmem_bytes``)."""
+    return flash_vmem_bytes(block_q, block_k, d) + 2 * block_q * block_k * 4
+
+
+def bias_flash_space(shapes: Sequence[Sequence[int]],
+                     dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Candidates for additive-bias flash (relative-position style)."""
+    return _attn_space(shapes, bias_flash_vmem_bytes)
+
+
+def sigmoid_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
+    """Sigmoid attention keeps no online m/l statistics (no row
+    normalizer), dropping the two ``(bq, 128)`` f32 stat tiles; the
+    optional key-padding row stays in the budget because serving routes
+    padded batches through it (mirrors ``kind='sigmoid', has_mask=True``
+    in ``_per_head_vmem_bytes``)."""
+    return (flash_vmem_bytes(block_q, block_k, d)
+            - 2 * block_q * _LANES * 4
+            + block_k * 4)
+
+
+def sigmoid_space(shapes: Sequence[Sequence[int]],
+                  dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Candidates for sigmoid attention (no-normalizer online loop)."""
+    return _attn_space(shapes, sigmoid_vmem_bytes)
 
 
 def ln_vmem_bytes(block_rows: int, features: int) -> int:
@@ -202,7 +254,11 @@ def int8_flash_space(shapes: Sequence[Sequence[int]],
                     "block_k": INT8_FLASH_BLOCKS[0]}]
 
 
-_SPACES = {"flash_attention": flash_space, "layer_norm": ln_space,
+_SPACES = {"flash_attention": flash_space,
+           "flash_attention_masked": masked_flash_space,
+           "flash_attention_bias": bias_flash_space,
+           "sigmoid_attention": sigmoid_space,
+           "layer_norm": ln_space,
            "retrieval_topk": retrieval_space,
            "int8_matmul": int8_matmul_space,
            "flash_attention_int8": int8_flash_space}

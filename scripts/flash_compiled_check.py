@@ -7,8 +7,9 @@ manifests solely under Mosaic's real pipelining would pass every test in the
 repo. This script runs forward + backward parity vs the fp32 einsum oracle
 (`ops/attention.reference_attention`) for causal and non-causal attention,
 at the shipped block sizes, for both an MXU-aligned and a ViT-unaligned
-sequence length, plus the `flash_attention_lse` ring building block — all
-compiled on the TPU.
+sequence length, plus the `flash_attention_lse` ring building block and the
+attention-variant family (masked / bias / sigmoid, each with its own
+kernels and its own metric) — all compiled on the TPU.
 
 Emits one JSON line per case (for MEASUREMENTS.jsonl via the watcher) and a
 final summary line; exits nonzero if any case fails, so the watcher retries.
@@ -40,6 +41,7 @@ def proven_cases() -> set[tuple[str, str]]:
     return {(r["metric"], str(r.get("case")))
             for r in read_records()
             if r.get("metric") in ("flash_compiled_parity",
+                                   "flash_variant_compiled_parity",
                                    "ln_compiled_parity")
             and r.get("case") and r.get("value") == 1.0
             and "tpu" in str(r.get("device", "")).lower()}
@@ -156,6 +158,84 @@ def main() -> int:
             "device": jax.devices()[0].device_kind,
         }), flush=True)
 
+    # Attention-variant family (masked / bias / sigmoid): each runs its own
+    # Pallas kernels (mask rows, bias tiles + the dbias accumulation grid,
+    # no-normalizer online loop) that the softmax cases above never touch.
+    # Variant cases keep their own metric and counter — like the LN block
+    # below, they must NOT be appended into `cases` (different key shape).
+    from jimm_tpu.ops.attention import reference_sigmoid_attention
+    from jimm_tpu.ops.flash_attention import (flash_attention_bias,
+                                              flash_attention_masked,
+                                              sigmoid_attention)
+    n_var = 0
+    for variant in ("masked", "bias", "sigmoid"):
+        for seq, dtype in ((512, "f32"), (512, "bf16"), (577, "bf16")):
+            case = f"{variant}_seq{seq}_{dtype}"
+            if ("flash_variant_compiled_parity", case) in done:
+                print(json.dumps({"metric": "flash_variant_compiled_parity",
+                                  "case": case,
+                                  "skipped": "already proven"}),
+                      flush=True)
+                n_var += 1
+                continue
+            q, k, v = qkv(seq, dtype)
+            mask = jnp.asarray(rng.rand(2, seq) > 0.25)
+            mask = mask.at[:, 0].set(True)
+            bias = jnp.asarray(rng.randn(4, seq, seq)
+                               .astype(np.float32) * 0.3)
+            if variant == "masked":
+                def fn(q, k, v):
+                    return flash_attention_masked(q, k, v, mask)
+
+                def oracle(q, k, v):
+                    return reference_attention(
+                        q, k, v, mask=mask[:, None, None, :])
+            elif variant == "bias":
+                def fn(q, k, v):
+                    return flash_attention_bias(q, k, v, bias)
+
+                def oracle(q, k, v):
+                    return reference_attention(q, k, v, bias=bias[None])
+            else:
+                def fn(q, k, v):
+                    return sigmoid_attention(q, k, v)
+
+                oracle = reference_sigmoid_attention
+            atol_f = 2e-5 if dtype == "f32" else 2e-2
+            atol_b = 5e-4 if dtype == "f32" else 5e-2
+            guard = _watchdog(300, f"variant {case}",
+                              metric="flash_variant_compiled_parity")
+            t0 = time.monotonic()
+
+            def loss_var(q, k, v):
+                return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+            def loss_var_ref(q, k, v):
+                return jnp.sum(oracle(q, k, v).astype(jnp.float32) ** 2)
+
+            fwd_err = float(np.abs(
+                np.asarray(fn(q, k, v), np.float32)
+                - np.asarray(oracle(q, k, v), np.float32)).max())
+            gf = jax.grad(loss_var, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(loss_var_ref, argnums=(0, 1, 2))(q, k, v)
+            bwd_err = max(float(np.abs(np.asarray(a, np.float32)
+                                       - np.asarray(b, np.float32)).max())
+                          for a, b in zip(gf, gr))
+            guard()
+            ok = fwd_err <= atol_f and bwd_err <= atol_b
+            failures += not ok
+            print(json.dumps({
+                "metric": "flash_variant_compiled_parity",
+                "case": case,
+                "value": 1.0 if ok else 0.0,
+                "fwd_max_abs_err": fwd_err,
+                "bwd_max_abs_err": bwd_err,
+                "atol_fwd": atol_f, "atol_bwd": atol_b,
+                "elapsed_s": round(time.monotonic() - t0, 1),
+                "device": jax.devices()[0].device_kind,
+            }), flush=True)
+            n_var += 1
+
     # Fused LayerNorm kernel: same interpret-only risk as flash. Row counts
     # cover one partial block (300 -> pad to 512, 2 grid steps) and many
     # grid steps (2048 -> 8), i.e. the multi-block dscale/dbias
@@ -227,7 +307,7 @@ def main() -> int:
     print(json.dumps({
         "metric": "flash_compiled_parity_summary",
         "value": 1.0 if failures == 0 else 0.0,
-        "cases": len(cases) + n_ln, "failures": failures,
+        "cases": len(cases) + n_var + n_ln, "failures": failures,
         "device": jax.devices()[0].device_kind,
     }), flush=True)
     return 1 if failures else 0

@@ -4,7 +4,10 @@ Three invariants, asserted end to end on CPU (interpret-mode Pallas):
 
 1. **Cold tune**: ``jimm-tpu tune run`` core (`tune_kernel`) measures the
    layer_norm candidate space at a small shape and persists the winner in
-   a tmp cache — at least one measurement, a config on disk.
+   a tmp cache — at least one measurement, a config on disk. The same
+   cold→warm pair then covers one attention-family variant
+   (``flash_attention_masked``, fwd+bwd through its own kernels) end to
+   end, so a variant registration that breaks keying or benching fails CI.
 2. **Warm process**: a SECOND subprocess resolves the same (kernel, shape,
    dtype) through ``best_config`` against that cache and must report a pure
    hit — ``jimm_tune_hit_total == 1`` and ``jimm_tune_measure_total == 0``
@@ -29,6 +32,11 @@ import tempfile
 SHAPES = ((32, 128),)
 DTYPES = ("float32",)
 
+#: small enough that interpret-mode fwd+bwd benching of the one feasible
+#: candidate (seq 64 -> a single 128 block) stays a few seconds
+MASKED_SHAPES = ((1, 64, 2, 64),) * 3
+MASKED_DTYPES = ("float32",) * 3
+
 
 def fail(msg: str) -> int:
     print(json.dumps({"metric": "tune_smoke", "value": 0.0, "error": msg}),
@@ -45,29 +53,36 @@ def run(code: str, root: str) -> dict:
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
-COLD = """
+COLD_TMPL = """
 import json
 from jimm_tpu import obs
 from jimm_tpu.tune import tune_kernel
-report = tune_kernel("layer_norm", %r, %r)
+report = tune_kernel(%r, %r, %r)
 snap = obs.get_registry("jimm_tune").snapshot()
 print(json.dumps({"config": report["config"],
                   "candidates": report["candidates"],
                   "fingerprint": report["fingerprint"],
                   "measures": snap.get("measure_total", 0)}))
-""" % (SHAPES, DTYPES)
+"""
 
-WARM = """
+WARM_TMPL = """
 import json
 from jimm_tpu import obs
 from jimm_tpu.tune import best_config
-cfg = best_config("layer_norm", %r, %r)
+cfg = best_config(%r, %r, %r)
 snap = obs.get_registry("jimm_tune").snapshot()
 print(json.dumps({"config": cfg,
                   "hits": snap.get("hit_total", 0),
                   "misses": snap.get("miss_total", 0),
                   "measures": snap.get("measure_total", 0)}))
-""" % (SHAPES, DTYPES)
+"""
+
+COLD = COLD_TMPL % ("layer_norm", SHAPES, DTYPES)
+WARM = WARM_TMPL % ("layer_norm", SHAPES, DTYPES)
+COLD_MASKED = COLD_TMPL % ("flash_attention_masked", MASKED_SHAPES,
+                           MASKED_DTYPES)
+WARM_MASKED = WARM_TMPL % ("flash_attention_masked", MASKED_SHAPES,
+                           MASKED_DTYPES)
 
 LS = """
 import json, sys
@@ -98,6 +113,22 @@ def main() -> int:
             return fail(f"warm lookup re-measured {warm['measures']} "
                         f"times; the hot path must be lookup-only")
 
+        # --- attention-variant kernel: cold tune -> warm pure hit ---------
+        vcold = run(COLD_MASKED, root)
+        if vcold["measures"] < 1 or vcold["candidates"] < 1:
+            return fail(f"masked-flash cold tune measured nothing: {vcold}")
+        if "block_q" not in vcold["config"] \
+                or "block_k" not in vcold["config"]:
+            return fail(f"masked-flash tune returned no blocks: {vcold}")
+        vwarm = run(WARM_MASKED, root)
+        if vwarm["config"] != vcold["config"]:
+            return fail(f"masked-flash warm config {vwarm['config']} != "
+                        f"tuned {vcold['config']}")
+        if vwarm["hits"] != 1 or vwarm["misses"] != 0 \
+                or vwarm["measures"] != 0:
+            return fail(f"masked-flash warm lookup was not a pure hit: "
+                        f"{vwarm}")
+
         # --- tune ls stays jax-free ---------------------------------------
         ls = run(LS, root)
         if ls["rc"] != 0:
@@ -109,7 +140,10 @@ def main() -> int:
                           "config": cold["config"],
                           "candidates": cold["candidates"],
                           "cold_measures": cold["measures"],
-                          "warm_measures": warm["measures"]}), flush=True)
+                          "warm_measures": warm["measures"],
+                          "variant_config": vcold["config"],
+                          "variant_warm_measures": vwarm["measures"]}),
+              flush=True)
     return 0
 
 

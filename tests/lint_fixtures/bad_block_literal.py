@@ -1,8 +1,8 @@
-"""JL009 fixture: hardcoded block-size kwargs at call sites (lines 8, 12),
-a suppressed deliberate pin (line 16), and non-literal kwargs that are fine
-(lines 20, 24)."""
+"""JL009 fixture: hardcoded block kwargs (lines 8, 12, 27-28; the rule keys
+on the kwarg name, covering every attention-family variant), a suppressed
+deliberate pin (line 16), and non-literal kwargs (fine: lines 20, 24)."""
 
-from jimm_tpu.ops import flash_attention, layer_norm
+from jimm_tpu.ops import flash_attention, flash_attention_masked, layer_norm
 
 
 out = flash_attention(q, k, v, block_q=128,  # line 8: JL009
@@ -22,3 +22,7 @@ tuned = flash_attention(q, k, v, block_q=BLOCK)  # named constant: no finding
 
 def wrapper(block_rows=256):  # def-site default: no finding
     return layer_norm(x, g, b, block_rows=None)  # None: no finding
+
+
+w = flash_attention_masked(q, k, v, m, block_q=128,  # line 27: JL009
+                           block_k=128)  # line 28: JL009

@@ -320,6 +320,31 @@ class TestWarmStartE2E:
         assert forward2.prepare_bucket(1) == "aot"
         assert forward2.trace_count() == 0
 
+    def test_warmup_naflex_compiles_one_program_per_bucket_pair(self):
+        """NaFlex serve warmup: one compile per (batch, seq) bucket pair,
+        and a padded batch with different mask CONTENTS reuses the warm
+        executable (the mask is runtime data, not a compile shape)."""
+        from flax import nnx
+
+        from jimm_tpu import SigLIP
+        from jimm_tpu.aot.warmup import warmup_naflex
+        from jimm_tpu.configs import SigLIPConfig, TextConfig, VisionConfig
+        cfg = SigLIPConfig(
+            vision=VisionConfig(image_size=16, patch_size=8, width=32,
+                                depth=2, num_heads=2, mlp_dim=64,
+                                act="gelu_tanh", pooling="map"),
+            text=TextConfig(vocab_size=64, context_length=8, width=32,
+                            depth=2, num_heads=2, mlp_dim=64,
+                            act="gelu_tanh", causal=False, pooling="last",
+                            proj_bias=True),
+            projection_dim=32)
+        model = SigLIP(cfg, rngs=nnx.Rngs(0))
+        report = warmup_naflex(model, batch_buckets=(1, 2),
+                               seq_buckets=(8,))
+        assert set(report) == {(1, 8), (2, 8)}
+        assert all(r["traces"] == 1 for r in report.values())
+        assert all(r["seconds"] >= 0 for r in report.values())
+
     def test_enable_persistent_cache(self, tmp_path):
         import jax
 

@@ -1,0 +1,454 @@
+"""The templated flash-attention family vs XLA einsum oracles.
+
+Three variants share the softmax kernel's tiling/online-normalizer/
+custom-vjp scaffolding (`jimm_tpu/ops/flash_attention.py`):
+
+- masked  — per-sample ``(B, Sk)`` key-padding masks (NaFlex, MAP pooling)
+- bias    — additive ``(N, Sq, Sk)`` logits bias, differentiable in bias
+- sigmoid — no row normalizer (per the sigmoid-attention paper)
+
+Parity runs in Pallas interpret mode on CPU at the ISSUE's seq matrix
+(1 / 5 / 257 / 577, f32 + bf16); the TPU cross-lowering tests mirror the
+LayerNorm odd-shapes matrix. Block sizes resolve through
+``tune.best_config`` on every call here (no explicit block kwargs)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jimm_tpu.ops.attention import (dot_product_attention,
+                                    reference_attention,
+                                    reference_sigmoid_attention)
+from jimm_tpu.ops.flash_attention import (flash_attention_bias,
+                                          flash_attention_masked,
+                                          sigmoid_attention)
+
+#: the ISSUE's parity matrix: degenerate single-token, tiny odd, and the
+#: ViT-shaped odd lengths that need sequence padding (257 = 16x16 + cls,
+#: 577 = 24x24 + cls)
+SEQ_LENS = (1, 5, 257, 577)
+
+slow = pytest.mark.slow
+
+#: interpret-mode Pallas is slow on CPU, and tier-1 shares an 870 s budget
+#: with the whole suite — so tier-1 keeps one representative of every
+#: distinct code path (f32 allclose at tiny/odd/padded lengths, bf16
+#: cosine at the padded multi-block lengths) and the redundant corners of
+#: the matrix run under ``-m slow``.
+FWD_CASES = [
+    pytest.param(np.float32, 1, marks=slow),
+    (np.float32, 5),
+    pytest.param(np.float32, 257, marks=slow),
+    (np.float32, 577),
+    pytest.param(jnp.bfloat16, 1, marks=slow),
+    pytest.param(jnp.bfloat16, 5, marks=slow),
+    pytest.param(jnp.bfloat16, 257, marks=slow),
+    (jnp.bfloat16, 577),
+]
+
+#: 257 is the strongest backward case (odd length -> padded q/k blocks,
+#: multi-block online accumulation); the rest of the lengths re-prove the
+#: same padding logic the forward matrix already covers
+GRAD_SEQS = [pytest.param(1, marks=slow), pytest.param(5, marks=slow),
+             257, pytest.param(577, marks=slow)]
+
+
+def qkv(rng, b=2, s=256, n=2, d=64, dtype=np.float32):
+    return tuple(jnp.asarray(rng.randn(b, s, n, d).astype(np.float32) * 0.5,
+                             dtype) for _ in range(3))
+
+
+def key_mask(rng, b, s):
+    """Random key-padding mask with >= 1 valid key per sample (an all-
+    masked row's forward output is garbage by contract — see the kernel
+    module docstring — and is exercised separately below)."""
+    m = rng.rand(b, s) > 0.3
+    m[:, 0] = True
+    return jnp.asarray(m)
+
+
+def cosine(a, b):
+    a = np.asarray(a, np.float64).ravel()
+    b = np.asarray(b, np.float64).ravel()
+    denom = np.linalg.norm(a) * np.linalg.norm(b)
+    return float(a @ b / denom) if denom else 1.0
+
+
+def ref_bias_attention(q, k, v, bias, *, is_causal=False):
+    return reference_attention(q, k, v, is_causal=is_causal,
+                               bias=bias[None])
+
+
+# ---------------------------------------------------------------------------
+# forward parity: f32 allclose, bf16 cosine (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype,s", FWD_CASES)
+def test_masked_forward_parity(rng, s, dtype):
+    q, k, v = qkv(rng, s=s, dtype=dtype)
+    mask = key_mask(rng, 2, s)
+    out = flash_attention_masked(q, k, v, mask)
+    ref = reference_attention(q, k, v, mask=mask[:, None, None, :])
+    assert out.dtype == q.dtype
+    if dtype == np.float32:
+        np.testing.assert_allclose(out, ref, atol=3e-5)
+    else:
+        assert cosine(out, ref) >= 0.999
+
+
+@pytest.mark.parametrize("dtype,s", FWD_CASES)
+def test_bias_forward_parity(rng, s, dtype):
+    q, k, v = qkv(rng, s=s, dtype=dtype)
+    bias = jnp.asarray(rng.randn(2, s, s).astype(np.float32) * 0.3)
+    out = flash_attention_bias(q, k, v, bias)
+    ref = ref_bias_attention(q, k, v, bias)
+    if dtype == np.float32:
+        np.testing.assert_allclose(out, ref, atol=3e-5)
+    else:
+        assert cosine(out, ref) >= 0.999
+
+
+@pytest.mark.parametrize("dtype,s", FWD_CASES)
+def test_sigmoid_forward_parity(rng, s, dtype):
+    q, k, v = qkv(rng, s=s, dtype=dtype)
+    out = sigmoid_attention(q, k, v)
+    ref = reference_sigmoid_attention(q, k, v)
+    if dtype == np.float32:
+        np.testing.assert_allclose(out, ref, atol=3e-5)
+    else:
+        assert cosine(out, ref) >= 0.999
+
+
+@pytest.mark.parametrize("s", [pytest.param(5, marks=slow), 257])
+def test_masked_causal_forward(rng, s):
+    q, k, v = qkv(rng, s=s)
+    mask = key_mask(rng, 2, s)
+    out = flash_attention_masked(q, k, v, mask, is_causal=True)
+    ref = reference_attention(q, k, v, is_causal=True,
+                              mask=mask[:, None, None, :])
+    np.testing.assert_allclose(out, ref, atol=3e-5)
+
+
+@pytest.mark.parametrize("s", [pytest.param(5, marks=slow), 257])
+def test_sigmoid_masked_causal_forward(rng, s):
+    q, k, v = qkv(rng, s=s)
+    mask = key_mask(rng, 2, s)
+    out = sigmoid_attention(q, k, v, mask=mask, is_causal=True)
+    ref = reference_sigmoid_attention(q, k, v, mask=mask, is_causal=True)
+    np.testing.assert_allclose(out, ref, atol=3e-5)
+
+
+def test_sigmoid_default_logit_bias_is_log_sk(rng):
+    """The paper's init: logit_bias = -log(Sk) matches softmax's 1/Sk row
+    mass at uniform scores."""
+    q, k, v = qkv(rng, s=64)
+    np.testing.assert_allclose(
+        sigmoid_attention(q, k, v),
+        np.asarray(reference_sigmoid_attention(
+            q, k, v, logit_bias=-math.log(64))), atol=3e-5)
+
+
+def test_sigmoid_fully_masked_rows_are_exactly_zero(rng):
+    """sigmoid(NEG_INF) underflows to 0 — unlike softmax-masked, a row with
+    no valid key yields exactly zero output, no garbage."""
+    q, k, v = qkv(rng, s=16)
+    mask = np.ones((2, 16), bool)
+    mask[1, :] = False
+    out = np.asarray(sigmoid_attention(q, k, v, mask=jnp.asarray(mask)))
+    assert np.all(out[1] == 0.0)
+    assert np.any(out[0] != 0.0)
+
+
+def test_masked_fully_masked_rows_zero_grad_when_downstream_masks(rng):
+    """The NaFlex contract: garbage rows are fine iff downstream masking
+    zeroes their cotangent — then NO gradient flows through them."""
+    q, k, v = qkv(rng, s=8)
+    mask = np.ones((2, 8), bool)
+    mask[1, 4:] = False  # sample 1: keys 4..7 padded
+
+    def loss(q, k, v):
+        o = flash_attention_masked(q, k, v, jnp.asarray(mask))
+        # downstream masking, as MAP pooling / NaFlex do
+        return jnp.sum((o * jnp.asarray(mask)[:, :, None, None]) ** 2)
+
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    # padded queries get zero dq; padded keys get zero dk/dv
+    assert np.all(np.asarray(dq)[1, 4:] == 0.0)
+    assert np.all(np.asarray(dk)[1, 4:] == 0.0)
+    assert np.all(np.asarray(dv)[1, 4:] == 0.0)
+    assert np.any(np.asarray(dq)[0] != 0.0)
+
+
+# ---------------------------------------------------------------------------
+# backward parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("s", GRAD_SEQS)
+def test_masked_grad_parity(rng, s):
+    q, k, v = qkv(rng, s=s)
+    mask = key_mask(rng, 2, s)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(flash_attention_masked(q, k, v, mask) ** 2)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(reference_attention(
+            q, k, v, mask=mask[:, None, None, :]) ** 2)
+
+    gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("s", GRAD_SEQS)
+def test_bias_grad_parity(rng, s):
+    """dbias runs the dedicated batch-innermost accumulation kernel — the
+    variant's whole point is differentiability in the bias without a dense
+    (B, N, Sq, Sk) tensor."""
+    q, k, v = qkv(rng, s=s)
+    bias = jnp.asarray(rng.randn(2, s, s).astype(np.float32) * 0.3)
+
+    def flash_loss(q, k, v, bias):
+        return jnp.sum(flash_attention_bias(q, k, v, bias) ** 2)
+
+    def ref_loss(q, k, v, bias):
+        return jnp.sum(ref_bias_attention(q, k, v, bias) ** 2)
+
+    gf = jax.grad(flash_loss, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b, name in zip(gf, gr, ("dq", "dk", "dv", "dbias")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("s", GRAD_SEQS)
+def test_sigmoid_grad_parity(rng, s):
+    q, k, v = qkv(rng, s=s)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(sigmoid_attention(q, k, v) ** 2)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(reference_sigmoid_attention(q, k, v) ** 2)
+
+    gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, err_msg=name)
+
+
+def test_bias_grad_flows_through_broadcast(rng):
+    """A (Sq, Sk) bias (head-shared) must receive the head-summed
+    gradient — grads flow back through the broadcast."""
+    q, k, v = qkv(rng, s=8)
+    bias2 = jnp.asarray(rng.randn(8, 8).astype(np.float32) * 0.3)
+
+    def flash_loss(bias):
+        return jnp.sum(flash_attention_bias(q, k, v, bias) ** 2)
+
+    def ref_loss(bias):
+        return jnp.sum(reference_attention(
+            q, k, v, bias=bias[None, None]) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(flash_loss)(bias2)),
+        np.asarray(jax.grad(ref_loss)(bias2)), atol=5e-4)
+
+
+# ---------------------------------------------------------------------------
+# dispatch (ops/attention.py)
+# ---------------------------------------------------------------------------
+
+class TestDispatch:
+    def test_flash_impl_routes_key_padding_mask(self, rng):
+        """impl='flash' + key-padding mask runs the masked variant instead
+        of raising (the old hard rejection)."""
+        q, k, v = qkv(rng, s=64)
+        mask = key_mask(rng, 2, 64)
+        out = dot_product_attention(q, k, v, mask=mask[:, None, None, :],
+                                    impl="flash")
+        ref = reference_attention(q, k, v, mask=mask[:, None, None, :])
+        np.testing.assert_allclose(out, ref, atol=3e-5)
+
+    @slow
+    def test_flash_masked_impl(self, rng):
+        """Same route as test_flash_impl_routes_key_padding_mask, spelled
+        explicitly."""
+        q, k, v = qkv(rng, s=64)
+        mask = key_mask(rng, 2, 64)
+        out = dot_product_attention(q, k, v, mask=mask[:, None, None, :],
+                                    impl="flash_masked")
+        ref = reference_attention(q, k, v, mask=mask[:, None, None, :])
+        np.testing.assert_allclose(out, ref, atol=3e-5)
+
+    def test_flash_bias_impl(self, rng):
+        q, k, v = qkv(rng, s=64)
+        bias = jnp.asarray(rng.randn(2, 64, 64).astype(np.float32) * 0.3)
+        out = dot_product_attention(q, k, v, bias=bias, impl="flash_bias")
+        np.testing.assert_allclose(out, ref_bias_attention(q, k, v, bias),
+                                   atol=3e-5)
+
+    def test_sigmoid_impl(self, rng):
+        q, k, v = qkv(rng, s=64)
+        out = dot_product_attention(q, k, v, impl="sigmoid")
+        np.testing.assert_allclose(out, reference_sigmoid_attention(q, k, v),
+                                   atol=3e-5)
+
+    def test_xla_accepts_bias(self, rng):
+        q, k, v = qkv(rng, s=32)
+        bias = jnp.asarray(rng.randn(2, 32, 32).astype(np.float32) * 0.3)
+        out = dot_product_attention(q, k, v, bias=bias[None], impl="xla")
+        np.testing.assert_allclose(out, ref_bias_attention(q, k, v, bias),
+                                   atol=2e-5)
+
+    def test_arbitrary_mask_on_flash_names_xla(self, rng):
+        q, k, v = qkv(rng, s=16)
+        full = jnp.ones((2, 2, 16, 16), bool)
+        with pytest.raises(ValueError, match="key-padding masks only"):
+            dot_product_attention(q, k, v, mask=full, impl="flash")
+
+    def test_ring_mask_rejection_names_masked_flash(self, rng):
+        q, k, v = qkv(rng, s=16)
+        mask = jnp.ones((2, 1, 1, 16), bool)
+        with pytest.raises(ValueError, match="flash_masked"):
+            dot_product_attention(q, k, v, mask=mask, impl="ring")
+
+    def test_flash_masked_requires_mask(self, rng):
+        q, k, v = qkv(rng, s=16)
+        with pytest.raises(ValueError, match="requires a key-padding"):
+            dot_product_attention(q, k, v, impl="flash_masked")
+
+    def test_flash_bias_requires_bias(self, rng):
+        q, k, v = qkv(rng, s=16)
+        with pytest.raises(ValueError, match="requires a bias"):
+            dot_product_attention(q, k, v, impl="flash_bias")
+
+
+# ---------------------------------------------------------------------------
+# TPU cross-lowering (mirrors the LayerNorm odd-shapes matrix)
+# ---------------------------------------------------------------------------
+
+#: (dtype, batch, seq, heads, head_dim) — odd seq lengths that need block
+#: padding, plus off-tile head dims the wrapper lane-pads (80 -> 128);
+#: tier-1 keeps the multi-block f32 case and the padded-head-dim bf16 case
+#: per variant, the rest of the matrix runs under ``-m slow``
+LOWER_CASES = [
+    pytest.param("float32", 1, 5, 2, 64, marks=slow),
+    ("float32", 2, 257, 2, 64),
+    pytest.param("float32", 2, 577, 2, 80, marks=slow),
+    pytest.param("bfloat16", 1, 5, 2, 64, marks=slow),
+    pytest.param("bfloat16", 2, 257, 2, 64, marks=slow),
+    ("bfloat16", 2, 577, 2, 80),
+]
+
+
+def _lower_grad_for_tpu(flash_loss, *args):
+    fn = jax.jit(jax.grad(flash_loss, argnums=tuple(range(len(args)))))
+    fn.trace(*args).lower(lowering_platforms=("tpu",))  # must not raise
+
+
+@pytest.mark.parametrize("dtype,b,s,n,d", LOWER_CASES)
+def test_masked_lowers_for_tpu(b, s, n, d, dtype):
+    dt = jnp.dtype(dtype)
+    qs = jax.ShapeDtypeStruct((b, s, n, d), dt)
+    mask = jnp.ones((b, s), bool)
+
+    def loss(q, k, v):
+        o = flash_attention_masked(q, k, v, mask)
+        return jnp.sum(o.astype(jnp.float32))
+
+    _lower_grad_for_tpu(loss, qs, qs, qs)
+
+
+@pytest.mark.parametrize("dtype,b,s,n,d", LOWER_CASES)
+def test_bias_lowers_for_tpu(b, s, n, d, dtype):
+    dt = jnp.dtype(dtype)
+    qs = jax.ShapeDtypeStruct((b, s, n, d), dt)
+    bs = jax.ShapeDtypeStruct((n, s, s), jnp.float32)
+
+    def loss(q, k, v, bias):
+        o = flash_attention_bias(q, k, v, bias)
+        return jnp.sum(o.astype(jnp.float32))
+
+    _lower_grad_for_tpu(loss, qs, qs, qs, bs)
+
+
+@pytest.mark.parametrize("dtype,b,s,n,d", LOWER_CASES)
+def test_sigmoid_lowers_for_tpu(b, s, n, d, dtype):
+    dt = jnp.dtype(dtype)
+    qs = jax.ShapeDtypeStruct((b, s, n, d), dt)
+
+    def loss(q, k, v):
+        o = sigmoid_attention(q, k, v)
+        return jnp.sum(o.astype(jnp.float32))
+
+    _lower_grad_for_tpu(loss, qs, qs, qs)
+
+
+# ---------------------------------------------------------------------------
+# NaFlex acceptance: flash-masked forward with zero dense score tensors
+# ---------------------------------------------------------------------------
+
+def _tiny_naflex_tower(attn_impl):
+    from flax import nnx
+
+    from jimm_tpu.configs import VisionConfig
+    from jimm_tpu.nn.vision import VisionTower
+    cfg = VisionConfig(image_size=16, patch_size=4, width=16, depth=2,
+                       num_heads=2, mlp_dim=32, pooling="map",
+                       pre_norm=False, attn_impl=attn_impl)
+    return VisionTower(cfg, nnx.Rngs(0))
+
+
+def test_forward_naflex_flash_masked_no_dense_scores():
+    """The acceptance criterion: forward_naflex on the masked flash variant
+    lowers for TPU with NO dense (B, N, S, S) score materialization — the
+    lowered program must not contain an SxS-shaped tensor."""
+    tower = _tiny_naflex_tower("flash_masked")
+    S = 347  # distinctive odd length: "347x347" can't appear by accident
+    patches = jax.ShapeDtypeStruct((2, S, 4 * 4 * 3), jnp.float32)
+    shapes = jnp.asarray([[13, 17], [9, 11]], jnp.int32)
+    mask = np.zeros((2, S), bool)
+    mask[0, :13 * 17] = True
+    mask[1, :9 * 11] = True
+    mask = jnp.asarray(mask)
+
+    from flax import nnx
+    graphdef, state = nnx.split(tower)
+
+    @jax.jit
+    def fwd(state, p):
+        return nnx.merge(graphdef, state).forward_naflex(p, shapes, mask)
+
+    lowered = fwd.trace(state, patches).lower(lowering_platforms=("tpu",))
+    txt = lowered.as_text()
+    assert f"{S}x{S}" not in txt, \
+        "dense (.., S, S) attention scores were materialized"
+
+
+def test_forward_naflex_flash_masked_matches_dense(rng):
+    """Flash-vs-dense oracle on an odd-grid NaFlex batch (padded rows
+    all-masked): two towers built from the same seed are weight-identical,
+    so the only difference is the attention kernel."""
+    dense = _tiny_naflex_tower("xla")
+    flash = _tiny_naflex_tower("flash_masked")
+    S = 36
+    patches = np.zeros((2, S, 4 * 4 * 3), np.float32)
+    patches[0, :5 * 7] = rng.randn(35, 48).astype(np.float32)
+    patches[1, :3 * 11] = rng.randn(33, 48).astype(np.float32)
+    shapes = jnp.asarray([[5, 7], [3, 11]], jnp.int32)
+    mask = np.zeros((2, S), bool)
+    mask[0, :35] = True
+    mask[1, :33] = True
+    out_dense = dense.forward_naflex(jnp.asarray(patches), shapes,
+                                     jnp.asarray(mask))
+    out_flash = flash.forward_naflex(jnp.asarray(patches), shapes,
+                                     jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(out_flash),
+                               np.asarray(out_dense), atol=2e-4)

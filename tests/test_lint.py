@@ -157,6 +157,8 @@ class TestRuleFixtures:
             ("JL009", 8),   # block_q=128
             ("JL009", 9),   # block_k=256
             ("JL009", 12),  # block_rows=64
+            ("JL009", 27),  # flash_attention_masked block_q=128 — the rule
+            ("JL009", 28),  # keys on kwarg names, so variants are covered
         }
         assert all(f.severity == ERROR for f in findings)
         assert any("best_config" in f.message for f in findings)

@@ -283,6 +283,46 @@ class TestSpace:
             assert KERNELS[name].version >= 1
             assert callable(KERNELS[name].bench)
 
+    def test_attention_variant_vmem_formulas_match_ops(self):
+        # one formula per family member: the pruner's model must BE the
+        # kernel guard's model with that variant's spec flags
+        from jimm_tpu.ops import flash_attention as fa
+        from jimm_tpu.tune.space import (bias_flash_vmem_bytes,
+                                         masked_flash_vmem_bytes,
+                                         sigmoid_vmem_bytes)
+        for bq in (128, 256, 512):
+            for bk in (128, 256, 512):
+                for d in (64, 128):
+                    assert masked_flash_vmem_bytes(bq, bk, d) == \
+                        fa._per_head_vmem_bytes(bq, bk, d, has_mask=True)
+                    assert bias_flash_vmem_bytes(bq, bk, d) == \
+                        fa._per_head_vmem_bytes(bq, bk, d, has_bias=True)
+                    assert sigmoid_vmem_bytes(bq, bk, d) == \
+                        fa._per_head_vmem_bytes(bq, bk, d, kind="sigmoid",
+                                                has_mask=True)
+
+    def test_attention_variant_spaces_and_kernels_registered(self):
+        for name in ("flash_attention_masked", "flash_attention_bias",
+                     "sigmoid_attention"):
+            assert name in KERNELS
+            assert KERNELS[name].version >= 1
+            assert callable(KERNELS[name].bench)
+            cands = kernel_space(name, FLASH_SHAPES, ("float32",) * 3)
+            assert cands
+            # seq len 128 -> no point in blocks beyond its 128-multiple
+            assert all(c["block_q"] <= 128 and c["block_k"] <= 128
+                       for c in cands)
+
+    def test_bias_space_is_subset_of_flash_space(self):
+        # the bias variant's extra (bq, bk) f32 tiles can only shrink the
+        # feasible set, never grow it
+        shapes = ((2, 1024, 4, 128),) * 3
+        flash = {tuple(sorted(c.items()))
+                 for c in kernel_space("flash_attention", shapes)}
+        bias = {tuple(sorted(c.items()))
+                for c in kernel_space("flash_attention_bias", shapes)}
+        assert bias <= flash
+
 
 class TestMeasure:
     def test_trimmed_median_drops_extremes(self):
@@ -322,6 +362,35 @@ class TestOpsIntegration:
             x.var(-1, keepdims=True) + 1e-6)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5)
+
+    def test_masked_flash_resolves_tuned_block(self, tmp_path):
+        """The variant looks up under its OWN kernel name — a tuned masked
+        config must be honored by flash_attention_masked (and produce the
+        oracle's numbers at the tuned blocks)."""
+        import jax.numpy as jnp
+
+        from jimm_tpu.ops.attention import reference_attention
+        from jimm_tpu.ops.flash_attention import flash_attention_masked
+        from jimm_tpu.tune import api as tune_api
+        shapes = ((1, 128, 2, 64),) * 3
+        cache = tune_api.configure(tmp_path / "c")
+        cache.put(tune_key("flash_attention_masked", shapes=shapes,
+                           dtypes=("float32",) * 3,
+                           kernel_version=KERNELS[
+                               "flash_attention_masked"].version),
+                  {"block_q": 128, "block_k": 128})
+        rng = np.random.RandomState(0)
+        q, k, v = (jnp.asarray(rng.randn(1, 128, 2, 64).astype(np.float32))
+                   for _ in range(3))
+        mask = jnp.asarray(rng.rand(1, 128) > 0.3).at[:, 0].set(True)
+        before = counters()
+        out = flash_attention_masked(q, k, v, mask)
+        after = counters()
+        assert delta(before, after, "hit_total") >= 1
+        assert delta(before, after, "measure_total") == 0
+        ref = reference_attention(q, k, v, mask=mask[:, None, None, :])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5)
 
     def test_flash_explicit_blocks_skip_cache(self):
         import jax
