@@ -291,6 +291,23 @@ def cmd_presets(args: argparse.Namespace) -> int:
     return 0
 
 
+def _batch_fingerprint(batch) -> int:
+    """48-bit content hash of a batch's host bytes.
+
+    Small enough to round-trip float64 metrics paths (JSONL, registry)
+    exactly — equal fingerprints at equal steps between a resumed run and
+    an uninterrupted control is the zero-replay/zero-skip resume proof.
+    Pulls the batch to host, so it is opt-in (--batch-fingerprint)."""
+    import hashlib
+
+    import jax
+    import numpy as np
+    h = hashlib.sha1()
+    for leaf in jax.tree.leaves(batch):
+        h.update(np.asarray(leaf).tobytes())
+    return int(h.hexdigest()[:12], 16)
+
+
 def cmd_train(args: argparse.Namespace) -> int:
     _configure_backend(args)
     if args.compilation_cache_dir:
@@ -459,6 +476,24 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     import jax
 
+    # deterministic fault drill: --fake-failure-at-step N is historical
+    # sugar for the crash@N entry of the general --inject-faults plan
+    fault_spec = args.inject_faults or ""
+    if args.fake_failure_at_step is not None:
+        crash = f"crash@{args.fake_failure_at_step}"
+        fault_spec = f"{fault_spec},{crash}" if fault_spec else crash
+    fault_plan = None
+    if fault_spec:
+        from jimm_tpu.resilience import FaultPlan
+        try:
+            fault_plan = FaultPlan.parse(fault_spec)
+        except ValueError as e:
+            raise SystemExit(f"--inject-faults: {e}")
+        if fault_plan.needs("corrupt") and not args.ckpt_dir:
+            raise SystemExit("--inject-faults: corrupt@STEP needs --ckpt-dir")
+    if args.preemption_save and not args.ckpt_dir:
+        raise SystemExit("--preemption-save needs --ckpt-dir")
+
     ckpt = CheckpointManager(args.ckpt_dir, save_interval_steps=args.save_every) \
         if args.ckpt_dir else None
     start_step = 0
@@ -599,6 +634,17 @@ def cmd_train(args: argparse.Namespace) -> int:
     acct = obs.GoodputAccounter()
     profiler_ctx = None
 
+    # preemption guard: SIGTERM sets a flag the loop polls; the handler
+    # turns it into a grace-window async save + resumable PreemptedError
+    guard = None
+    preempt = None
+    if args.preemption_save:
+        from jimm_tpu.resilience import PreemptionGuard, PreemptionHandler
+        guard = PreemptionGuard().install()
+        preempt = PreemptionHandler(guard, ckpt,
+                                    grace_steps=args.grace_steps,
+                                    accounter=acct)
+
     def place(batch):
         if mesh is None:
             # tree-map: a NaFlex batch nests the image triple inside
@@ -627,6 +673,9 @@ def cmd_train(args: argparse.Namespace) -> int:
                     profiler_ctx.__enter__()
                 with acct.measure("data_wait"):
                     batch = next(data)
+                # hash before step_fn runs: donated buffers die with the step
+                fp = (_batch_fingerprint(batch)
+                      if args.batch_fingerprint else None)
                 # the first step traces + compiles under the same call; it
                 # lands in the "compile" bucket, steady-state in "step"
                 # (timer.stop's device_get sync keeps device time in-bucket)
@@ -640,27 +689,34 @@ def cmd_train(args: argparse.Namespace) -> int:
                     profiler_ctx = None
                     print(f"profile trace written to {args.profile_dir}")
                 with acct.measure("host_sync"):
-                    logger.log(step, step_time_s=dt,
-                               **{k: float(v) for k, v in metrics.items()})
-                if ckpt is not None:
-                    extra = None
-                    if grain_stream is not None:
-                        import base64
-                        extra = {"grain_state": base64.b64encode(
-                            grain_stream.consumed_state).decode("ascii")}
+                    host_metrics = {k: float(v) for k, v in metrics.items()}
+                    if fp is not None:
+                        host_metrics["batch_fingerprint"] = fp
+                    logger.log(step, step_time_s=dt, **host_metrics)
+                extra = None
+                if ckpt is not None and grain_stream is not None:
+                    import base64
+                    extra = {"grain_state": base64.b64encode(
+                        grain_stream.consumed_state).decode("ascii")}
+                saved_now = False
+                if ckpt is not None and (preempt is None
+                                         or not preempt.draining):
+                    # while the grace save drains, later per-step saves are
+                    # pointless — nothing after it survives the restart
                     with acct.measure("checkpoint"):
-                        ckpt.save(step, model, optimizer, extra=extra)
-                if args.fake_failure_at_step is not None \
-                        and step == args.fake_failure_at_step:
-                    # failure-injection drill (SURVEY §5 failure-detection
-                    # row): simulate a mid-run crash AFTER the checkpoint
-                    # write so a --resume rerun must restore and continue
-                    if ckpt is not None:
-                        ckpt.wait()
-                    raise RuntimeError(
-                        f"injected failure at step {step} "
-                        "(--fake-failure-at-step drill; rerun with --resume)")
+                        saved_now = ckpt.save(step, model, optimizer,
+                                              extra=extra)
+                if fault_plan is not None:
+                    # drill events for this step (stall/corrupt/preempt/
+                    # crash); a preempt's SIGTERM lands before the guard
+                    # check below, same as a real maintenance signal
+                    fault_plan.fire(step, ckpt=ckpt)
+                if preempt is not None:
+                    preempt.after_step(step, model, optimizer, extra=extra,
+                                       already_saved=saved_now)
     finally:
+        if guard is not None:
+            guard.uninstall()
         if profiler_ctx is not None:
             # crash mid-profile: still flush what was captured
             profiler_ctx.__exit__(None, None, None)
@@ -678,6 +734,60 @@ def cmd_train(args: argparse.Namespace) -> int:
                     else _mfu(train_step_flops(cfg, args.batch_size), dt))
     print("goodput: " + _json.dumps(acct.report(mfu=achieved_mfu)))
     return 0
+
+
+def cmd_supervise(args: argparse.Namespace) -> int:
+    """Run ``train`` as restartable attempts.
+
+    A preemption (PreemptedError out of the grace-window save) or worker
+    death restarts the command with ``--resume`` after a bounded jittered
+    backoff, up to ``--max-restarts`` times, then gives up loudly.
+    In-process — one interpreter, one metric registry — so
+    ``jimm_train_restarts_total`` and the lost-work goodput bucket
+    accumulate across attempts; ``launch.py --restarts`` applies the same
+    policy at process-group granularity."""
+    from jimm_tpu.resilience import BackoffPolicy, GiveUpError, Supervisor
+    cmd = list(args.train_args or [])
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd or cmd[0] != "train":
+        raise SystemExit("supervise wraps the train subcommand: "
+                         "jimm-tpu supervise [options] -- train ...")
+    if "--ckpt-dir" not in cmd:
+        raise SystemExit("supervise needs --ckpt-dir in the train command "
+                         "(restarts resume from checkpoints)")
+    if "--preemption-save" not in cmd:
+        cmd.append("--preemption-save")
+    sup = Supervisor(max_restarts=args.max_restarts,
+                     backoff=BackoffPolicy(base_s=args.backoff_base_s,
+                                           max_s=args.backoff_max_s,
+                                           jitter=0.5, seed=args.seed))
+
+    def attempt(i: int, resume: bool) -> int:
+        argv = list(cmd)
+        if resume and "--resume" not in argv:
+            argv.append("--resume")
+        ns = build_parser().parse_args(argv)
+        return ns.fn(ns)
+
+    try:
+        rc = sup.run(attempt)
+    except GiveUpError as e:
+        print(f"supervise: {e}", file=sys.stderr)
+        return 1
+    # one parseable line with the resilience counters, so external drills
+    # (scripts/resilience_smoke.py, CI) can assert on them cross-process
+    import json as _json
+
+    from jimm_tpu import obs
+    snap = obs.snapshot()
+    keys = ("jimm_train_restarts_total", "jimm_train_preemptions_total",
+            "jimm_train_checkpoint_quarantined_total",
+            "jimm_train_goodput_lost_work_seconds_total",
+            "jimm_train_goodput_preemption_save_seconds_total")
+    print("resilience: "
+          + _json.dumps({k: snap.get(k, 0.0) for k in keys}))
+    return rc
 
 
 def cmd_evaluate(args: argparse.Namespace) -> int:
@@ -1523,7 +1633,26 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--resume", action="store_true")
     sp.add_argument("--fake-failure-at-step", type=int, default=None,
                     help="failure drill: crash after checkpointing this step "
-                         "(recover with --resume)")
+                         "(recover with --resume); sugar for "
+                         "--inject-faults crash@STEP")
+    sp.add_argument("--inject-faults", default=None,
+                    help="deterministic fault drill plan: comma-separated "
+                         "kind@STEP entries — preempt@N (SIGTERM to self), "
+                         "crash@N (hard failure after N's checkpoint), "
+                         "stall@N:SECONDS (slow-host sleep), corrupt@N "
+                         "(garbage the newest committed checkpoint)")
+    sp.add_argument("--preemption-save", action="store_true",
+                    help="catch SIGTERM and spend the grace window on an "
+                         "async checkpoint save overlapping the next "
+                         "--grace-steps steps, then exit resumable "
+                         "(needs --ckpt-dir)")
+    sp.add_argument("--grace-steps", type=int, default=1,
+                    help="training steps to overlap with the preemption "
+                         "save before exiting (0 = save and exit at once)")
+    sp.add_argument("--batch-fingerprint", action="store_true",
+                    help="log a content hash of every consumed batch to the "
+                         "metrics stream (proves zero-replay/zero-skip "
+                         "resume; pulls each batch to host)")
     sp.add_argument("--save-every", type=int, default=50)
     sp.add_argument("--log-every", type=int, default=10)
     sp.add_argument("--metrics-file", default=None,
@@ -1534,6 +1663,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="capture a jax.profiler trace of steps 2-4 here")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_train)
+
+    sp = sub.add_parser("supervise",
+                        help="run train as restartable attempts "
+                             "(preemption/crash -> backoff -> --resume)")
+    sp.add_argument("--max-restarts", type=int, default=3,
+                    help="restarts before giving up")
+    sp.add_argument("--backoff-base-s", type=float, default=1.0)
+    sp.add_argument("--backoff-max-s", type=float, default=30.0)
+    sp.add_argument("--seed", type=int, default=None,
+                    help="seed the restart-backoff jitter "
+                         "(reproducible drills)")
+    sp.add_argument("train_args", nargs=argparse.REMAINDER,
+                    help="-- train --preset ... --ckpt-dir ...")
+    sp.set_defaults(fn=cmd_supervise)
 
     sp = sub.add_parser("evaluate",
                         help="accuracy / retrieval metrics over a dataset")
@@ -1775,7 +1918,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    return args.fn(args)
+    from jimm_tpu.resilience import PreemptedError
+    try:
+        return args.fn(args)
+    except PreemptedError as e:
+        # bare `train` hit by SIGTERM: state is saved; exit clean and
+        # resumable instead of with a traceback (75 = EX_TEMPFAIL)
+        print(str(e), file=sys.stderr)
+        return 75
 
 
 if __name__ == "__main__":

@@ -73,6 +73,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="JIMM_PLATFORM for children (e.g. cpu)")
     p.add_argument("--host-devices", type=int, default=None,
                    help="virtual CPU devices per process (JIMM_HOST_DEVICES)")
+    p.add_argument("--restarts", type=int, default=0,
+                   help="relaunch the whole group up to N times after a "
+                        "failure (preemption, crash); the command should "
+                        "be resumable — e.g. include --ckpt-dir and "
+                        "--resume, which cold-starts cleanly on the first "
+                        "attempt")
+    p.add_argument("--restart-backoff-s", type=float, default=1.0,
+                   help="base of the jittered exponential backoff between "
+                        "group relaunches")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="command to run in every process, after `--`")
     args = p.parse_args(argv)
@@ -86,12 +95,39 @@ def main(argv: list[str] | None = None) -> int:
         p.error("--coordinator host:port is required with --nnodes > 1")
     if args.nproc < 1:
         p.error("--nproc must be >= 1")
+    if args.restarts < 0:
+        p.error("--restarts must be >= 0")
     world = args.nnodes * args.nproc
     if world < 2:
         p.error("a 1-process world needs no launcher; run the command "
                 "directly")
-    coordinator = args.coordinator or f"127.0.0.1:{_free_port()}"
 
+    from jimm_tpu.resilience import BackoffPolicy
+    backoff = BackoffPolicy(base_s=args.restart_backoff_s, max_s=60.0,
+                            jitter=0.5)
+    import time
+
+    rc = 0
+    for attempt in range(args.restarts + 1):
+        # a fresh auto-coordinator port per attempt: the previous group's
+        # listener may still be in TIME_WAIT
+        coordinator = args.coordinator or f"127.0.0.1:{_free_port()}"
+        rc = _run_group(args, cmd, coordinator)
+        if rc == 0 or rc == 130:  # success, or operator stop — don't retry
+            break
+        if attempt < args.restarts:
+            delay = backoff.delay(attempt)
+            print(f"[launch] group failed (rc {rc}); restart "
+                  f"{attempt + 1}/{args.restarts} in {delay:.1f}s",
+                  file=sys.stderr)
+            time.sleep(delay)
+    return rc
+
+
+def _run_group(args, cmd: list[str], coordinator: str) -> int:
+    """Spawn one process group, wait it out, and return its exit code
+    (first failure wins; 130 = interrupted by the operator)."""
+    world = args.nnodes * args.nproc
     procs: list[subprocess.Popen] = []
     pumps: list[threading.Thread] = []
     for local in range(args.nproc):

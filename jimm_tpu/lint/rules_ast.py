@@ -60,6 +60,15 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   tiles in VMEM and silently hands the MXU a f32 matmul. Rescales live
   in ``_dequant``-style helpers (docs/quantization.md); deliberate
   upcasts carry a ``# jaxlint: disable=JL012`` justification.
+- **JL013** broad exception swallowed silently (``except Exception:
+  pass``, bare ``except:``, or ``except BaseException:`` with a
+  pass-only body) in ``serve/``, ``train/``, or ``resilience/`` library
+  code — these are the paths whose failures the supervisor, the replica
+  watchdog, and the preemption handler exist to SEE; a silent swallow
+  turns worker death into a hang and a corrupt checkpoint into a cold
+  start. Handle it, log it, or narrow the except; deliberate best-effort
+  swallows carry a ``# jaxlint: disable=JL013`` justification. Tests are
+  exempt.
 """
 
 from __future__ import annotations
@@ -941,6 +950,44 @@ def check_quant_upcast(tree: ast.AST, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL013 — silently swallowed broad exception in resilience-critical paths
+# ---------------------------------------------------------------------------
+
+def _path_is_resilient(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return bool({"serve", "train", "resilience"} & set(parts))
+
+
+def check_swallowed_exception(tree: ast.AST, path: str) -> list[Finding]:
+    """JL013: a broad except with a pass-only body in serve/train/
+    resilience library code. The whole resilience design rests on failures
+    being *observable* — the supervisor restarts on worker death, the
+    replica watchdog fences a failing lane, the checkpoint fallback
+    quarantines corrupt steps — and every one of those signals dies at an
+    ``except Exception: pass``. Narrow excepts (``except OSError: pass``
+    around a close()) stay legal: the rule targets the handlers broad
+    enough to eat the failures the machinery above must see."""
+    if not _path_is_resilient(path) or _path_is_test(path):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException"))
+        if broad and all(isinstance(s, ast.Pass) for s in node.body):
+            findings.append(Finding(
+                "JL013", ERROR, path, node.lineno,
+                "broad exception swallowed silently — in serve/train/"
+                "resilience paths this hides worker death from the "
+                "supervisor and the watchdog; handle, log, or narrow it "
+                "(deliberate best-effort swallows carry a "
+                "# jaxlint: disable=JL013 justification)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -958,4 +1005,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_device_put_placement(tree, path)
     findings += check_host_sort(tree, path)
     findings += check_quant_upcast(tree, path)
+    findings += check_swallowed_exception(tree, path)
     return findings

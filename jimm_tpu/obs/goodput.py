@@ -16,6 +16,10 @@ Buckets (the fixed vocabulary the docs and CI smoke assert on):
                    realizes the loss on host
 - ``checkpoint`` — orbax save/restore
 - ``host_sync``  — metric logging, console/JSONL writes
+- ``preemption_save`` — SIGTERM grace-window save (initiate + final flush)
+- ``lost_work``  — wall time a preemption/restart discarded (grace-window
+                   steps whose results are thrown away, work since the
+                   last committed checkpoint on a crash)
 - ``other``      — residual wall time not covered by a measure() region
 
 MFU-adjusted goodput = goodput × MFU: the fraction of *peak hardware* FLOPs
@@ -33,7 +37,8 @@ from jimm_tpu.obs.registry import MetricRegistry, enabled, get_registry
 
 __all__ = ["BUCKETS", "GoodputAccounter"]
 
-BUCKETS = ("compile", "data_wait", "step", "checkpoint", "host_sync")
+BUCKETS = ("compile", "data_wait", "step", "checkpoint", "host_sync",
+           "preemption_save", "lost_work")
 
 
 class GoodputAccounter:

@@ -1,0 +1,46 @@
+"""Preemption-tolerant elastic training.
+
+TPU capacity is revocable: maintenance events and spot reclaims SIGTERM a
+worker and give it a short grace window. The reference stack ships no fault
+tolerance at all — a preemption loses the run. This package treats failure
+as a scheduled event instead:
+
+- :class:`Supervisor` runs training as a restartable attempt — it catches
+  worker death and preemption, restarts with bounded jittered backoff
+  (:class:`BackoffPolicy`), and resumes through ``train/checkpoint.py``'s
+  resharding-on-restore.
+- :class:`PreemptionGuard` / :class:`PreemptionHandler` turn the SIGTERM
+  grace window into an async orbax save that overlaps the next training
+  steps, then exit resumable (:class:`PreemptedError`).
+- :class:`FaultPlan` is the seeded fault-injection harness behind
+  ``--inject-faults`` (preemption signals, hard crashes, slow-host stalls,
+  checkpoint corruption at configured steps) — the drill that
+  ``tests/test_resilience.py`` and ``scripts/resilience_smoke.py`` run.
+
+Everything here is host-only (no jax import), so the supervisor can run on
+a coordinator box with no accelerator stack. Restarts, lost work, and
+grace saves all land in ``jimm_tpu.obs`` (``jimm_train_restarts_total``,
+the ``preemption_save`` span, lost-work seconds in the goodput breakdown),
+so resilience is measured, not assumed.
+"""
+
+from jimm_tpu.resilience.backoff import BackoffPolicy
+from jimm_tpu.resilience.faults import (Fault, FaultPlan,
+                                        corrupt_latest_checkpoint)
+from jimm_tpu.resilience.preemption import (PreemptedError, PreemptionGuard,
+                                            PreemptionHandler)
+from jimm_tpu.resilience.supervisor import (GiveUpError, Supervisor,
+                                            note_checkpoint_completed)
+
+__all__ = [
+    "BackoffPolicy",
+    "Fault",
+    "FaultPlan",
+    "GiveUpError",
+    "PreemptedError",
+    "PreemptionGuard",
+    "PreemptionHandler",
+    "Supervisor",
+    "corrupt_latest_checkpoint",
+    "note_checkpoint_completed",
+]

@@ -1,0 +1,157 @@
+"""Preemption-aware checkpointing: signal guard + grace-window async save.
+
+TPU maintenance events deliver SIGTERM and then give the process a short
+grace window before the hard kill. The guard turns that signal into a flag
+the train loop polls; the handler turns the flag into an *async* orbax save
+that overlaps the next ``grace_steps`` training steps (the save's d2h copy
+happens up front, the write streams in the background), then flushes the
+checkpoint's completion marker and exits resumable via
+:class:`PreemptedError`. The supervisor catches that error, backs off, and
+restarts with ``--resume``.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+__all__ = ["PreemptedError", "PreemptionGuard", "PreemptionHandler"]
+
+
+class PreemptedError(RuntimeError):
+    """The run was preempted and its state committed at ``step``; a
+    ``--resume`` rerun continues at ``step + 1``. ``lost_seconds`` is the
+    wall time spent on grace-window steps whose results the restart
+    discards (plus the final save flush) — the goodput ``lost_work``
+    bucket carries the same number."""
+
+    def __init__(self, step: int, *, grace_steps: int = 0,
+                 lost_seconds: float = 0.0):
+        super().__init__(f"preempted: state saved at step {step}; "
+                         f"resume with --resume")
+        self.step = step
+        self.grace_steps = grace_steps
+        self.lost_seconds = lost_seconds
+
+
+class PreemptionGuard:
+    """Installs handlers for maintenance signals (default SIGTERM) that
+    only set a flag — the train loop decides when to act on it, so the
+    signal never interrupts a step or an in-flight orbax write mid-way.
+
+    ``install`` snapshots and ``uninstall`` restores the previous handlers.
+    Off the main thread (where ``signal.signal`` is unavailable) the guard
+    degrades to :meth:`trigger`-only operation."""
+
+    def __init__(self, signals: tuple[int, ...] = (signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self._previous: dict[int, object] = {}
+
+    def install(self) -> "PreemptionGuard":
+        try:
+            for sig in self.signals:
+                self._previous[sig] = signal.signal(sig, self._on_signal)
+        except ValueError:  # not the main thread: trigger()-only mode
+            self._previous.clear()
+        return self
+
+    def uninstall(self) -> None:
+        for sig, previous in self._previous.items():
+            signal.signal(sig, previous)
+        self._previous.clear()
+
+    def _on_signal(self, signum, frame) -> None:
+        self.trigger()
+
+    def trigger(self) -> None:
+        """Mark the process preempted (signal handler / fault drill)."""
+        self._event.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._event.is_set()
+
+
+class PreemptionHandler:
+    """Drives the grace-window save from the train loop.
+
+    Call :meth:`after_step` once per step, after the normal checkpoint
+    block. On the first preempted step it starts a forced async save (or
+    adopts the step's normal save when one just ran), keeps the loop
+    training for ``grace_steps`` more steps while the write drains, then
+    waits the save out, closes the manager (flushing the completion
+    marker), and raises :class:`PreemptedError`. While draining,
+    :attr:`draining` is True — the loop suppresses its normal per-step
+    saves, since nothing after the grace save will be kept.
+    """
+
+    def __init__(self, guard: PreemptionGuard, ckpt, *, grace_steps: int = 1,
+                 accounter=None, registry=None):
+        if ckpt is None:
+            raise ValueError("preemption saves need a CheckpointManager")
+        self.guard = guard
+        self.ckpt = ckpt
+        self.grace_steps = max(0, grace_steps)
+        self.accounter = accounter
+        if registry is None:
+            from jimm_tpu.obs import get_registry
+            registry = get_registry("jimm_train")
+        self.registry = registry
+        self.save_step: int | None = None
+        self._steps_after = 0
+        self._t_detected: float | None = None
+
+    @property
+    def draining(self) -> bool:
+        """True once the grace save started — normal saves are pointless."""
+        return self.save_step is not None
+
+    def after_step(self, step: int, model, optimizer=None, *,
+                   extra: dict | None = None,
+                   already_saved: bool = False) -> None:
+        """React to a pending preemption at the end of step ``step``.
+
+        ``already_saved``: the loop's normal checkpoint block saved this
+        exact step — its async write IS the grace save, skip the forced
+        duplicate (orbax rejects a second save of the same step)."""
+        if not self.guard.preempted:
+            return
+        if self.save_step is None:
+            self._t_detected = time.monotonic()
+            self.save_step = step
+            self.registry.counter("preemptions_total").inc()
+            self._timed_save(step, model, optimizer, extra, already_saved)
+            if self.grace_steps > 0:
+                return  # overlap the async write with the next steps
+        else:
+            self._steps_after += 1
+            if self._steps_after < self.grace_steps:
+                return
+        self._finish()
+
+    def _timed_save(self, step, model, optimizer, extra,
+                    already_saved) -> None:
+        from jimm_tpu.obs import span
+        t0 = time.perf_counter()
+        with span("preemption_save"):
+            if not already_saved:
+                self.ckpt.save(step, model, optimizer, extra=extra,
+                               force=True)
+        if self.accounter is not None:
+            self.accounter.add("preemption_save", time.perf_counter() - t0)
+
+    def _finish(self) -> None:
+        from jimm_tpu.obs import span
+        t0 = time.perf_counter()
+        with span("preemption_save"):
+            self.ckpt.wait()
+        if self.accounter is not None:
+            self.accounter.add("preemption_save", time.perf_counter() - t0)
+        self.ckpt.close()  # flushes the completion marker
+        lost = time.monotonic() - self._t_detected
+        if self.accounter is not None:
+            self.accounter.add("lost_work", lost)
+        raise PreemptedError(self.save_step, grace_steps=self._steps_after,
+                             lost_seconds=lost)

@@ -1,0 +1,110 @@
+"""The restartable-attempt supervisor.
+
+Runs training as a sequence of attempts: a preemption
+(:class:`~jimm_tpu.resilience.preemption.PreemptedError`), a crash, or a
+nonzero exit restarts the attempt with ``--resume`` after a bounded
+jittered backoff, up to ``max_restarts`` times; then it gives up with a
+clear :class:`GiveUpError`. ``launch.py`` applies the same policy at
+process-group granularity; ``jimm-tpu supervise`` applies this one
+in-process around ``cmd_train``.
+
+Every restart increments ``jimm_train_restarts_total`` and adds the lost
+wall time (work since the last committed checkpoint, or the grace-window
+loss a :class:`PreemptedError` reports) to the goodput ``lost_work``
+bucket — resilience shows up in the same breakdown as compile and
+data-wait time.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from jimm_tpu.resilience.backoff import BackoffPolicy
+from jimm_tpu.resilience.preemption import PreemptedError
+
+__all__ = ["GiveUpError", "Supervisor", "note_checkpoint_completed"]
+
+#: monotonic time of the last committed checkpoint in this process —
+#: train/checkpoint.py calls note_checkpoint_completed() when a step's
+#: completion marker lands, so the supervisor can bound how much work a
+#: crash actually lost.
+_last_checkpoint_t: float | None = None
+
+
+def note_checkpoint_completed() -> None:
+    global _last_checkpoint_t
+    _last_checkpoint_t = time.monotonic()
+
+
+class GiveUpError(RuntimeError):
+    """The supervisor exhausted its restart budget."""
+
+
+class Supervisor:
+    """Run ``attempt_fn(attempt, resume)`` until it returns 0 or the
+    restart budget runs out.
+
+    ``attempt_fn`` is called with the 0-based attempt index and a resume
+    flag (False on the first attempt, True on every restart) and returns a
+    process-style exit code; raising is treated like a crash. ``sleep`` is
+    injectable so tests and drills replay instantly.
+    """
+
+    def __init__(self, *, max_restarts: int = 3,
+                 backoff: BackoffPolicy | None = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 registry=None):
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        self.max_restarts = max_restarts
+        self.backoff = backoff if backoff is not None \
+            else BackoffPolicy(base_s=1.0, max_s=30.0, jitter=0.5)
+        self._sleep = sleep
+        if registry is None:
+            from jimm_tpu.obs import get_registry
+            registry = get_registry("jimm_train")
+        self.registry = registry
+        self.restarts = 0
+        #: one entry per failed attempt, oldest first
+        self.history: list[str] = []
+
+    def run(self, attempt_fn: Callable[[int, bool], int]) -> int:
+        for attempt in range(self.max_restarts + 1):
+            t0 = time.monotonic()
+            lost: float | None = None
+            try:
+                rc = attempt_fn(attempt, attempt > 0)
+            except PreemptedError as e:
+                failure = str(e)
+                lost = 0.0  # the grace window already booked its lost work
+            except KeyboardInterrupt:
+                raise  # operator stop is not a failure to retry
+            except Exception as e:  # worker death: restartable by design
+                failure = f"{type(e).__name__}: {e}"
+            else:
+                if rc == 0:
+                    return 0
+                failure = f"exit code {rc}"
+            if lost is None:
+                # crash path: everything since the last committed
+                # checkpoint (or the attempt start) is gone
+                since = _last_checkpoint_t
+                base = since if since is not None and since >= t0 else t0
+                lost = time.monotonic() - base
+            self.history.append(failure)
+            if attempt >= self.max_restarts:
+                raise GiveUpError(
+                    f"giving up after {self.max_restarts} restarts "
+                    f"({attempt + 1} attempts); last failure: {failure}")
+            self.restarts += 1
+            self.registry.counter("restarts_total").inc()
+            if lost > 0:
+                self.registry.counter(
+                    "goodput_lost_work_seconds_total").inc(lost)
+            delay = self.backoff.delay(attempt)
+            print(  # jaxlint: disable=JL007 — operator-facing restart narration
+                f"[supervise] attempt {attempt + 1} failed ({failure}); "
+                f"restarting in {delay:.2f}s")
+            self._sleep(delay)
+        raise AssertionError("unreachable")

@@ -197,7 +197,7 @@ class ServeMetrics:
         for name, fn in self._gauges.items():
             try:
                 out[name] = float(fn())
-            except Exception:  # noqa: BLE001 — a gauge must not kill /metrics
+            except Exception:  # jaxlint: disable=JL013 — a bound gauge callback must not kill /metrics  # noqa: BLE001
                 pass
         return out
 

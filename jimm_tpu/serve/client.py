@@ -13,6 +13,9 @@ import base64
 import http.client
 import json
 import threading
+import time
+
+from jimm_tpu.resilience.backoff import BackoffPolicy  # stdlib-only module
 
 
 class ServeClientError(Exception):
@@ -46,14 +49,26 @@ class ServeClient:
     across threads: a 64-thread load generator holds 64 sockets, same as
     64 clients, but makes thousands of requests on them. A dead or stale
     socket (server restart, idle timeout) is dropped and the request
-    retried once on a fresh connection.
+    retried immediately on a fresh connection; a fresh connection failing
+    (server restarting, briefly unreachable) is retried up to ``retries``
+    times with bounded jittered backoff — the same
+    :class:`~jimm_tpu.resilience.backoff.BackoffPolicy` the hub-download
+    and training-supervisor retry loops use. A request deadline
+    (``timeout_s=`` on the call) bounds the whole retry budget: the client
+    never sleeps past it.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8000,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, retries: int = 2,
+                 backoff_base_s: float = 0.05,
+                 backoff_seed: int | None = None):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
+        self._backoff = BackoffPolicy(retries=retries, base_s=backoff_base_s,
+                                      max_s=2.0, jitter=0.5,
+                                      seed=backoff_seed)
+        self._sleep = time.sleep  # injectable for tests
         self._local = threading.local()
 
     # -- transport --------------------------------------------------------
@@ -81,9 +96,13 @@ class ServeClient:
         """
         self._drop_connection()
 
-    def _request(self, method: str, path: str, payload: dict | None = None):
+    def _request(self, method: str, path: str, payload: dict | None = None,
+                 *, deadline_s: float | None = None):
         body = None if payload is None else json.dumps(payload).encode()
         headers = {"Content-Type": "application/json"} if body else {}
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        fresh_failures = 0
         while True:
             reused = getattr(self._local, "conn", None) is not None
             conn = self._connection()
@@ -98,10 +117,23 @@ class ServeClient:
                 raise
             except (http.client.HTTPException, OSError):
                 self._drop_connection()
-                if not reused:
-                    raise  # fresh connection failing is a real error
-                # reused socket went stale (server restart, idle close)
-                # before the response started: retry once, fresh
+                if reused:
+                    # reused socket went stale (server restart, idle close)
+                    # before the response started: retry at once, fresh —
+                    # this costs nothing and is almost always the fix
+                    continue
+                # a FRESH connection failing means the server is down or
+                # restarting: back off (jittered, so a client herd doesn't
+                # reconnect in lockstep), bounded by retries and by the
+                # request's own deadline
+                if fresh_failures >= self._backoff.retries:
+                    raise
+                delay = self._backoff.delay(fresh_failures)
+                fresh_failures += 1
+                if (deadline is not None
+                        and time.monotonic() + delay >= deadline):
+                    raise  # honoring the deadline beats one more attempt
+                self._sleep(delay)
         if resp.getheader("Connection", "").lower() == "close":
             self._drop_connection()
         content_type = resp.getheader("Content-Type") or ""
@@ -129,7 +161,8 @@ class ServeClient:
         payload = encode_image_payload(image)
         if timeout_s is not None:
             payload["timeout_s"] = timeout_s
-        return self._request("POST", "/v1/embed", payload)["features"]
+        return self._request("POST", "/v1/embed", payload,
+                             deadline_s=timeout_s)["features"]
 
     def embed_many(self, images, timeout_s: float | None = None) -> list:
         """Bulk embed: one request, one ``features`` row per image. The
@@ -138,7 +171,8 @@ class ServeClient:
         payload = {"images": [encode_image_payload(img) for img in images]}
         if timeout_s is not None:
             payload["timeout_s"] = timeout_s
-        return self._request("POST", "/v1/embed", payload)["features"]
+        return self._request("POST", "/v1/embed", payload,
+                             deadline_s=timeout_s)["features"]
 
     def classify(self, image, tokens: dict,
                  timeout_s: float | None = None) -> dict:
@@ -149,7 +183,8 @@ class ServeClient:
         payload["tokens"] = tokens
         if timeout_s is not None:
             payload["timeout_s"] = timeout_s
-        return self._request("POST", "/v1/classify", payload)
+        return self._request("POST", "/v1/classify", payload,
+                             deadline_s=timeout_s)
 
     def search(self, *, vector=None, image=None, k: int | None = None,
                timeout_s: float | None = None) -> dict:
@@ -169,4 +204,5 @@ class ServeClient:
             payload["k"] = int(k)
         if timeout_s is not None:
             payload["timeout_s"] = timeout_s
-        return self._request("POST", "/v1/search", payload)
+        return self._request("POST", "/v1/search", payload,
+                             deadline_s=timeout_s)

@@ -83,19 +83,24 @@ class _Request:
 class _Replica:
     """One compute lane: a forward, its single-thread executor, and its
     load counters. ``inflight`` is the replica's queue depth (batches
-    assigned but not finished) — the quantity dispatch balances on."""
+    assigned but not finished) — the quantity dispatch balances on.
+    ``restarts``/``dead`` belong to the engine's watchdog: a failing lane
+    gets one fresh executor, then is fenced off."""
 
-    __slots__ = ("index", "forward", "pool", "inflight", "dispatched",
-                 "device_s")
+    __slots__ = ("index", "forward", "name", "pool", "inflight",
+                 "dispatched", "device_s", "restarts", "dead")
 
     def __init__(self, index: int, forward: Callable, name: str):
         self.index = index
         self.forward = forward
+        self.name = name
         self.pool = ThreadPoolExecutor(max_workers=1,
                                        thread_name_prefix=name)
         self.inflight = 0
         self.dispatched = 0
         self.device_s = 0.0
+        self.restarts = 0
+        self.dead = False
 
 
 class InferenceEngine:
@@ -201,16 +206,46 @@ class InferenceEngine:
         smoke's balance check)."""
         return [{"replica": r.index, "dispatched": r.dispatched,
                  "inflight": r.inflight,
-                 "device_seconds": round(r.device_s, 6)}
+                 "device_seconds": round(r.device_s, 6),
+                 "restarts": r.restarts, "dead": r.dead}
                 for r in self._replicas]
 
+    def dead_replicas(self) -> list[int]:
+        """Indices of replicas the watchdog fenced off (healthz surfaces
+        these as a ``degraded`` status)."""
+        return [r.index for r in self._replicas if r.dead]
+
+    def _note_replica_failure(self, replica: _Replica) -> None:
+        """Watchdog: a replica whose forward raised gets ONE fresh executor
+        (its worker thread may be wedged on a dead device handle); a
+        replica that fails again after its restart is fenced off — unless
+        it is the last live lane, which keeps serving (and erroring
+        loudly) rather than leaving the engine with nothing to pick."""
+        if replica.restarts == 0:
+            replica.pool.shutdown(wait=False)
+            replica.pool = ThreadPoolExecutor(max_workers=1,
+                                              thread_name_prefix=replica.name)
+            replica.restarts += 1
+            if self._multi:
+                self.metrics.inc(f"replica_{replica.index}_restarts_total")
+            return
+        live = [r for r in self._replicas if not r.dead]
+        if len(live) > 1:
+            replica.dead = True
+            if self._multi:
+                self.metrics.inc(f"replica_{replica.index}_dead_total")
+
     def _pick_replica(self) -> _Replica:
-        """Least-loaded replica by inflight batch count; ties break
-        round-robin from the cursor so equal-depth replicas alternate."""
+        """Least-loaded live replica by inflight batch count; ties break
+        round-robin from the cursor so equal-depth replicas alternate.
+        Dead (watchdog-fenced) replicas are skipped; at least one replica
+        is always live by construction (see _note_replica_failure)."""
         n = len(self._replicas)
         best = None
         for off in range(n):
             r = self._replicas[(self._rr + off) % n]
+            if r.dead:
+                continue
             if best is None or r.inflight < best.inflight:
                 best = r
         self._rr = (best.index + 1) % n
@@ -429,6 +464,7 @@ class InferenceEngine:
                 replica.pool, self._forward_blocking_timed, padded, replica)
         except Exception as e:  # noqa: BLE001 — surface to every waiter
             self.metrics.inc("errors_total")
+            self._note_replica_failure(replica)
             for req in live:
                 if not req.future.done():
                     req.future.set_exception(e)

@@ -424,6 +424,12 @@ class ServingServer:
         # replica cold/stuck?" is answerable from a health probe
         if getattr(self.engine, "_multi", False):
             out["replicas"] = self.engine.replica_stats()
+        # a watchdog-fenced replica downgrades the whole probe: the server
+        # still answers, but capacity is reduced and an operator should act
+        dead = getattr(self.engine, "dead_replicas", lambda: [])()
+        if dead:
+            out["status"] = "degraded"
+            out["dead_replicas"] = dead
         if self.retrieval is not None:
             out["retrieval"] = self.retrieval.describe()
         return out

@@ -9,6 +9,8 @@ lives in `jimm_tpu/weights/export.py`.
 
 from __future__ import annotations
 
+import os
+import warnings
 from pathlib import Path
 from typing import Any
 
@@ -101,15 +103,25 @@ class CheckpointManager:
 
     def __init__(self, directory: str | Path, *, max_to_keep: int = 3,
                  save_interval_steps: int = 1):
+        self._dir = Path(directory).absolute()
         self._mgr = ocp.CheckpointManager(
-            Path(directory).absolute(),
+            self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
                 create=True))
+        # orbax's step scan ignores hidden dirs, so the marker and
+        # quarantine sidecars can live inside the checkpoint root
+        self._markers = self._dir / ".jimm_markers"
+        #: steps whose async save was initiated but not yet known committed
+        self._pending: list[int] = []
         #: user-supplied ``extra`` metadata of the last restored step
         #: (e.g. the grain data-iterator state) — populated by `restore`
         self.last_restored_extra: dict[str, Any] = {}
+
+    @property
+    def directory(self) -> Path:
+        return self._dir
 
     def save(self, step: int, model: nnx.Module,
              optimizer: nnx.Optimizer | None = None, *,
@@ -131,8 +143,100 @@ class CheckpointManager:
             saved = self._mgr.save(step, args=ocp.args.Composite(**items),
                                    force=force)
         if saved:
+            # entering an actual save waits out the previous async write
+            # (orbax serializes them), so every earlier pending step is
+            # committed by now — the new step stays pending until the next
+            # save/wait/close proves its own write finished
+            self._flush_markers()
+            self._pending.append(step)
             get_registry("jimm_train").counter("checkpoint_saves_total").inc()
         return saved
+
+    # -- completion markers -------------------------------------------------
+    # orbax's latest_step()/all_steps() scan bare step directories, so a
+    # partially-written dir left by a mid-save kill looks identical to a
+    # committed checkpoint and silently wins the "latest" race. A marker
+    # file is dropped (atomic tmp + rename) only once a step's async write
+    # is known finished; restore trusts markers, not directory listings.
+
+    def _write_marker(self, step: int) -> None:
+        self._markers.mkdir(exist_ok=True)
+        tmp = self._markers / f".{step}.tmp"
+        tmp.write_text("complete\n")
+        os.replace(tmp, self._markers / str(step))
+
+    def _flush_markers(self) -> None:
+        if not self._pending:
+            return
+        for step in self._pending:
+            self._write_marker(step)
+        self._pending.clear()
+        from jimm_tpu.resilience.supervisor import note_checkpoint_completed
+        note_checkpoint_completed()
+
+    def _marked_steps(self) -> set[int] | None:
+        """Steps with a completion marker, or None when this checkpoint
+        tree predates markers entirely (then orbax's listing is all we
+        have, the historical behavior)."""
+        if not self._markers.is_dir():
+            return None
+        marked = {int(p.name) for p in self._markers.iterdir()
+                  if p.name.isdigit()}
+        return marked or None
+
+    def _steps_on_disk(self) -> set[int]:
+        # a direct listing, not self._mgr.all_steps(): orbax caches its
+        # step scan at manager creation, which would miss dirs that appear
+        # or vanish (quarantine) while this process runs
+        if not self._dir.is_dir():
+            return set()
+        return {int(p.name) for p in self._dir.iterdir()
+                if p.is_dir() and p.name.isdigit()}
+
+    def completed_steps(self) -> list[int]:
+        """Ascending steps that are both on disk and marked complete."""
+        existing = self._steps_on_disk()
+        marked = self._marked_steps()
+        if marked is None:
+            return sorted(existing)
+        return sorted(existing & marked)
+
+    def quarantine_step(self, step: int, reason: str) -> Path | None:
+        """Move a bad step directory into ``.quarantine/`` — never delete,
+        so the bytes stay available for a post-mortem. Returns the new
+        location, or None when the move lost a race."""
+        from jimm_tpu.obs import get_registry
+        src = self._dir / str(step)
+        qdir = self._dir / ".quarantine"
+        try:
+            qdir.mkdir(exist_ok=True)
+            dest = qdir / str(step)
+            n = 0
+            while dest.exists():
+                n += 1
+                dest = qdir / f"{step}-{n}"
+            os.replace(src, dest)
+            (dest / ".jimm_quarantine_reason.txt").write_text(reason + "\n")
+        except OSError:
+            return None
+        (self._markers / str(step)).unlink(missing_ok=True)
+        get_registry("jimm_train").counter(
+            "checkpoint_quarantined_total").inc()
+        self._mgr.reload()  # drop the manager's cached view of the tree
+        return dest
+
+    def _sweep_partial_dirs(self, *, newer_than: int) -> None:
+        """Quarantine unmarked step dirs newer than the newest completed
+        step — the torso a mid-save kill leaves behind — so orbax's own
+        step scan can never resurrect them."""
+        marked = self._marked_steps()
+        if marked is None:
+            return
+        for step in self._steps_on_disk():
+            if (step > newer_than and step not in marked
+                    and step not in self._pending):
+                self.quarantine_step(
+                    step, "partial write (no completion marker)")
 
     def restore(self, model: nnx.Module,
                 optimizer: nnx.Optimizer | None = None,
@@ -140,16 +244,42 @@ class CheckpointManager:
         """Restore in place (onto each param's current sharding); returns the
         restored step.
 
+        With ``step=None`` the newest *completed* checkpoint is used —
+        partial step dirs (no completion marker) are swept aside, and a
+        step whose restore fails (corrupted bytes) is quarantined, never
+        deleted, before falling back to the previous good step. An explicit
+        ``step`` restores exactly that step and propagates its errors.
+
         Baked pipeline placement (`nn/transformer.py` pp_stages) stores
         layer rows in circular schedule order. When the checkpoint's layout
         differs from the model's, the stacked layer arrays are re-permuted
         through canonical order (saved-storage -> canonical -> current-
         storage), so a pipelined run can be evaluated or fine-tuned with any
         other placement — including none."""
-        from jimm_tpu.obs import get_registry, span
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
+        if step is not None:
+            return self._restore_step(step, model, optimizer)
+        candidates = self.completed_steps()
+        if not candidates:
             raise FileNotFoundError("no checkpoint found")
+        self._sweep_partial_dirs(newer_than=candidates[-1])
+        for cand in reversed(candidates):
+            try:
+                return self._restore_step(cand, model, optimizer)
+            except Exception as e:
+                dest = self.quarantine_step(
+                    cand, f"restore failed: {type(e).__name__}: {e}")
+                warnings.warn(
+                    f"checkpoint step {cand} failed to restore "
+                    f"({type(e).__name__}: {e}); quarantined to {dest}, "
+                    f"falling back to the previous good step",
+                    RuntimeWarning, stacklevel=2)
+        raise FileNotFoundError(
+            f"no restorable checkpoint: all {len(candidates)} candidate "
+            f"step(s) failed and were quarantined")
+
+    def _restore_step(self, step: int, model: nnx.Module,
+                      optimizer: nnx.Optimizer | None = None) -> int:
+        from jimm_tpu.obs import get_registry, span
         get_registry("jimm_train").counter("checkpoint_restores_total").inc()
         with span("checkpoint_restore"):
             model_state = nnx.state(model, nnx.Param)
@@ -188,10 +318,15 @@ class CheckpointManager:
         return step
 
     def latest_step(self) -> int | None:
-        return self._mgr.latest_step()
+        """Newest *completed* step (marker-verified) — unlike raw orbax,
+        a partially-written step directory can never be "latest"."""
+        steps = self.completed_steps()
+        return steps[-1] if steps else None
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
+        self._flush_markers()
 
     def close(self) -> None:
-        self._mgr.close()
+        self._mgr.close()  # waits out in-flight async saves
+        self._flush_markers()

@@ -146,7 +146,7 @@ class MetricsLogger:
             else:
                 try:
                     reg.gauge(k).set(value)
-                except Exception:  # noqa: BLE001 — name clash with a counter
+                except Exception:  # jaxlint: disable=JL013 — best-effort mirror; a name clash with a counter must not fail the log call  # noqa: BLE001
                     pass
 
     def _tb_log(self, step: int, metrics: dict[str, Any]) -> None:

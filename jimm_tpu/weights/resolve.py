@@ -113,21 +113,25 @@ def _hub_download_with_retry(hf_hub_download, repo_id: str, filename: str,
     or a train start whose weights are already on disk.
     """
     import time as _time
+
+    from jimm_tpu.resilience import BackoffPolicy
     if retries is None:
         retries = int(os.environ.get("JIMM_HUB_RETRIES", "3"))
     if backoff_s is None:
         backoff_s = float(os.environ.get("JIMM_HUB_BACKOFF_S", "0.5"))
     sleep = sleep or _time.sleep
+    # jitter=0: the historical exact exponential delays (base * 2**attempt)
+    backoff = BackoffPolicy(retries=max(1, retries), base_s=backoff_s)
     last: BaseException | None = None
-    for attempt in range(max(1, retries)):
+    for attempt in range(backoff.retries):
         try:
             return hf_hub_download(repo_id, filename)
         except Exception as e:
             if not _retryable(e):
                 raise
             last = e
-            if attempt + 1 < max(1, retries):
-                sleep(backoff_s * (2 ** attempt))
+            if attempt + 1 < backoff.retries:
+                sleep(backoff.delay(attempt))
     try:
         return hf_hub_download(repo_id, filename, local_files_only=True)
     except Exception:

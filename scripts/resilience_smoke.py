@@ -1,0 +1,162 @@
+"""CI fault drill for the resilience stack (supervisor + preemption saves).
+
+One process, three runs of the same tiny training job through the shipped
+CLI:
+
+1. **control** — uninterrupted, logging per-step losses and batch
+   fingerprints;
+2. **preemption drill** — ``supervise -- train ... --inject-faults
+   preempt@2``: SIGTERM fires at step 2, the grace-window save commits, the
+   supervisor restarts the attempt with ``--resume``, and the run finishes;
+3. **corruption drill** — ``corrupt@2,crash@2`` garbages the newest
+   committed checkpoint then crashes; the bare ``--resume`` rerun must
+   quarantine the corrupt step (never delete it) and fall back to the
+   previous good one.
+
+The assertions are the ISSUE's acceptance criteria: resumed losses match
+the control step-for-step (rtol 2e-4), batch fingerprints prove the data
+pipeline replayed and skipped nothing, ``jimm_train_restarts_total >= 1``,
+and the lost-work / preemption-save goodput buckets are nonzero. Exits
+nonzero with a JSON error line on any violation.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m scripts.resilience_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+RTOL = 2e-4
+
+
+def fail(msg: str) -> int:
+    print(json.dumps({"metric": "resilience_smoke", "value": 0.0,
+                      "error": msg}), flush=True)
+    return 1
+
+
+def read_metrics(path: Path) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def by_step(records: list[dict]) -> dict[int, dict]:
+    # later rows win duplicate steps: a grace-window step's row is
+    # superseded by its resumed re-run
+    return {rec["step"]: rec for rec in records}
+
+
+def check_against_control(ctl: dict[int, dict], got: dict[int, dict],
+                          steps, what: str) -> str | None:
+    for step in steps:
+        if step not in got:
+            return f"{what}: step {step} missing from resumed metrics"
+        if abs(got[step]["loss"] - ctl[step]["loss"]) > \
+                RTOL * abs(ctl[step]["loss"]):
+            return (f"{what}: loss diverged at step {step}: "
+                    f"{got[step]['loss']} vs control {ctl[step]['loss']}")
+        if got[step].get("batch_fingerprint") != \
+                ctl[step].get("batch_fingerprint"):
+            return (f"{what}: batch fingerprint mismatch at step {step} — "
+                    f"the data pipeline replayed or skipped batches")
+    return None
+
+
+def main() -> int:
+    from jimm_tpu import cli, obs
+
+    tmp = Path(tempfile.mkdtemp(prefix="resilience_smoke_"))
+    common = ["train", "--preset", "vit-tiny-patch16-224", "--tiny",
+              "--batch-size", "4", "--steps", "6", "--save-every", "1",
+              "--log-every", "0", "--seed", "7", "--batch-fingerprint"]
+
+    # --- control: the uninterrupted oracle --------------------------------
+    control_file = tmp / "control.jsonl"
+    rc = cli.main(common + ["--metrics-file", str(control_file)])
+    if rc:
+        return fail(f"control train exited {rc}")
+    ctl = by_step(read_metrics(control_file))
+    if set(ctl) != set(range(6)):
+        return fail(f"control logged steps {sorted(ctl)}, expected 0..5")
+
+    # --- drill 1: preempt at step 2, supervisor restarts ------------------
+    drill_file = tmp / "preempt.jsonl"
+    rc = cli.main(["supervise", "--max-restarts", "2",
+                   "--backoff-base-s", "0.01", "--seed", "0", "--"]
+                  + common + ["--ckpt-dir", str(tmp / "ckpt_preempt"),
+                              "--metrics-file", str(drill_file),
+                              "--inject-faults", "preempt@2"])
+    if rc:
+        return fail(f"supervised preemption drill exited {rc}")
+    err = check_against_control(ctl, by_step(read_metrics(drill_file)),
+                                range(6), "preemption drill")
+    if err:
+        return fail(err)
+
+    snap = obs.snapshot()
+    if snap.get("jimm_train_restarts_total", 0) < 1:
+        return fail("jimm_train_restarts_total is 0 after a preemption")
+    if snap.get("jimm_train_preemptions_total", 0) < 1:
+        return fail("jimm_train_preemptions_total is 0 after SIGTERM")
+    lost = snap.get("jimm_train_goodput_lost_work_seconds_total", 0.0)
+    grace = snap.get("jimm_train_goodput_preemption_save_seconds_total", 0.0)
+    if lost <= 0:
+        return fail("goodput lost_work bucket is empty after a restart")
+    if grace <= 0:
+        return fail("goodput preemption_save bucket is empty after a "
+                    "grace-window save")
+
+    # --- drill 2: corrupt the newest checkpoint, crash, resume ------------
+    ckpt_dir = tmp / "ckpt_corrupt"
+    try:
+        cli.main(common + ["--ckpt-dir", str(ckpt_dir),
+                           "--metrics-file", str(tmp / "crashed.jsonl"),
+                           "--inject-faults", "corrupt@2,crash@2"])
+        return fail("corrupt@2,crash@2 drill did not crash")
+    except RuntimeError as e:
+        if "injected failure at step 2" not in str(e):
+            return fail(f"unexpected crash from corruption drill: {e}")
+
+    import warnings
+    resumed_file = tmp / "resumed.jsonl"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)  # quarantine notice
+        rc = cli.main(common + ["--ckpt-dir", str(ckpt_dir), "--resume",
+                                "--metrics-file", str(resumed_file)])
+    if rc:
+        return fail(f"resume after corruption exited {rc}")
+    quarantined = ckpt_dir / ".quarantine" / "2"
+    if not quarantined.is_dir():
+        return fail("corrupt checkpoint step 2 was not quarantined "
+                    f"(contents of {ckpt_dir}: "
+                    f"{sorted(p.name for p in ckpt_dir.iterdir())})")
+    reason = quarantined / ".jimm_quarantine_reason.txt"
+    if not reason.exists() or "restore failed" not in reason.read_text():
+        return fail("quarantined step carries no restore-failure reason")
+    # corrupted step 2 -> fall back to step 1 -> re-train 2..5
+    err = check_against_control(ctl, by_step(read_metrics(resumed_file)),
+                                range(2, 6), "corruption drill")
+    if err:
+        return fail(err)
+    if snap := obs.snapshot():
+        if snap.get("jimm_train_checkpoint_quarantined_total", 0) < 1:
+            return fail("quarantine counter never incremented")
+
+    print(json.dumps({
+        "metric": "resilience_smoke", "value": 1.0,
+        "restarts_total": snap.get("jimm_train_restarts_total"),
+        "preemptions_total": snap.get("jimm_train_preemptions_total"),
+        "quarantined_total": snap.get(
+            "jimm_train_checkpoint_quarantined_total"),
+        "lost_work_s": round(lost, 3),
+        "preemption_save_s": round(grace, 3),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""JL013 fixture: silently swallowed broad exceptions in serving code."""
+
+
+def dispatch(replica, batch):
+    try:
+        return replica.forward(batch)
+    except Exception:                         # JL013: watchdog never sees it
+        pass
+
+
+def drain(conn):
+    try:
+        conn.close()
+    except:                                   # JL013: bare except, same hole
+        pass
+
+
+def close_quietly(sock):
+    # ok: narrow except — a best-effort close is allowed to ignore OSError
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def snapshot_gauge(fn):
+    # ok: broad but justified best-effort swallow
+    try:
+        return float(fn())
+    except Exception:  # jaxlint: disable=JL013 — a gauge must not kill the scrape
+        pass
+
+
+def report(err, metrics):
+    # ok: broad except that HANDLES the failure instead of eating it
+    try:
+        metrics.flush()
+    except Exception as e:
+        metrics.inc("errors_total")
+        raise RuntimeError("flush failed") from e

@@ -263,6 +263,40 @@ class TestRuleFixtures:
         assert check_quant_upcast(tree, "jimm_tpu/train/loop.py") == []
         assert check_quant_upcast(tree, "tests/test_int8_ops.py") == []
 
+    def test_jl013_swallowed_exception(self):
+        findings = findings_for("serve/bad_swallow.py")
+        assert rules_and_lines(findings) == {
+            ("JL013", 7),   # except Exception: pass
+            ("JL013", 14),  # bare except: pass
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert any("supervisor" in f.message for f in findings)
+        # the narrow OSError swallow, the justified suppression, and the
+        # handler that acts on the failure (lines 18-39) stay clean
+
+    def test_jl013_scoped_to_resilience_critical_paths(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_swallowed_exception
+        src = "try:\n    f()\nexcept Exception:\n    pass\n"
+        tree = ast.parse(src)
+        assert check_swallowed_exception(
+            tree, "jimm_tpu/serve/engine.py") != []
+        assert check_swallowed_exception(
+            tree, "jimm_tpu/train/checkpoint.py") != []
+        assert check_swallowed_exception(
+            tree, "jimm_tpu/resilience/supervisor.py") != []
+        # the rest of the tree (and all tests) may use best-effort
+        # swallows without a justification comment
+        assert check_swallowed_exception(
+            tree, "jimm_tpu/weights/resolve.py") == []
+        assert check_swallowed_exception(
+            tree, "jimm_tpu/obs/registry.py") == []
+        assert check_swallowed_exception(
+            tree, "tests/test_serve.py") == []
+        assert check_swallowed_exception(
+            tree, "jimm_tpu/serve/test_helpers.py") == []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings
