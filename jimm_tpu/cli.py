@@ -1376,6 +1376,24 @@ def cmd_bench_forward(args: argparse.Namespace) -> int:
     return 0
 
 
+def _parse_pool_model(spec: str) -> tuple[str, str, str]:
+    """Parse one ``--pool-model NAME=PRESET[@DTYPE]`` spec into
+    ``(name, preset, dtype)``. DTYPE defaults to f32."""
+    name, sep, rest = spec.partition("=")
+    if not sep or not name or not rest:
+        raise SystemExit(f"--pool-model {spec!r}: expected "
+                         "NAME=PRESET[@DTYPE]")
+    if name == "default":
+        raise SystemExit("--pool-model: 'default' names the primary model; "
+                         "pick another name")
+    preset_name, _, dtype = rest.partition("@")
+    dtype = dtype or "f32"
+    if dtype not in ("f32", "bf16", "int8"):
+        raise SystemExit(f"--pool-model {spec!r}: dtype must be "
+                         "f32|bf16|int8")
+    return name, preset_name, dtype
+
+
 def cmd_serve(args: argparse.Namespace) -> int:
     """HTTP micro-batching inference server (see docs/serving.md).
 
@@ -1447,33 +1465,96 @@ def cmd_serve(args: argparse.Namespace) -> int:
         store = ArtifactStore(args.aot_store)
     from jimm_tpu.serve.topology import build_replica_forwards, plan_topology
     plan = plan_topology(args.replicas, args.model_parallel)
-    if not plan.is_trivial:
-        # multi-chip serving: N replica groups of (data=1, model=k)
-        # submeshes, each with its own sharded param copy + warm forward,
-        # load-balanced behind the one admission queue
-        forward, trace_count = build_replica_forwards(
-            model, plan, method=method, item_shape=(size, size, 3),
-            store=store, label=model_key)
-    elif store is not None:
-        from jimm_tpu.aot.warmup import AotForward
-        forward = AotForward(model, method=method,
-                             item_shape=(size, size, 3),
-                             store=store, label=model_key)
-        trace_count = forward.trace_count
-    else:
-        forward, trace_count = counting_forward(model, method)
-    bucket_dtype = {"f32": "float32", "bf16": "bfloat16",
-                    "int8": "int8"}[serve_dtype]
+
+    def _build_forward(mdl, mdl_method, mdl_size, key):
+        if not plan.is_trivial:
+            # multi-chip serving: N replica groups of (data=1, model=k)
+            # submeshes, each with its own sharded param copy + warm
+            # forward, load-balanced behind the one admission queue
+            return build_replica_forwards(
+                mdl, plan, method=mdl_method,
+                item_shape=(mdl_size, mdl_size, 3), store=store, label=key)
+        if store is not None:
+            from jimm_tpu.aot.warmup import AotForward
+            fwd = AotForward(mdl, method=mdl_method,
+                             item_shape=(mdl_size, mdl_size, 3),
+                             store=store, label=key)
+            return fwd, fwd.trace_count
+        return counting_forward(mdl, mdl_method)
+
+    forward, trace_count = _build_forward(model, method, size, model_key)
+    _bucket_dtypes = {"f32": "float32", "bf16": "bfloat16", "int8": "int8"}
+    bucket_dtype = _bucket_dtypes[serve_dtype]
     buckets = (BucketTable(tuple(int(s) for s in args.buckets.split(",")),
                            dtype=bucket_dtype)
                if args.buckets else default_buckets(dtype=bucket_dtype))
     policy = AdmissionPolicy(max_queue=args.queue_size,
                              default_timeout_s=args.timeout_s,
                              shed_fraction=args.shed_fraction)
+    qos = None
+    if args.qos_policy:
+        # tenant-aware admission + weighted-fair scheduling; without the
+        # flag `qos` stays None and every serve path below is byte-
+        # identical to the policy-free server
+        from jimm_tpu.serve.qos import QosScheduler, load_policy
+        qos = QosScheduler(load_policy(args.qos_policy))
     engine = InferenceEngine(forward, item_shape=(size, size, 3),
                              buckets=buckets,
                              max_delay_ms=args.max_delay_ms, policy=policy,
-                             trace_count=trace_count)
+                             trace_count=trace_count, qos=qos)
+    pool = None
+    pool_traces = []
+    if args.pool_model:
+        # multi-model residency: each extra model gets its own warm engine
+        # (own buckets + own AOT fingerprint via its model_key, so the
+        # f32/int8 twins never adopt each other's executables) behind the
+        # same metrics surface and QoS scheduler; requests route with the
+        # `model=` field / X-Jimm-Model header
+        from jimm_tpu.serve.qos import ModelPool
+        engines = {"default": engine}
+        for spec in args.pool_model:
+            pname, ppreset, pdtype = _parse_pool_model(spec)
+            if pname in engines:
+                raise SystemExit(f"--pool-model: duplicate name {pname!r}")
+            pfam = _family(ppreset)
+            pcfg = preset(ppreset)
+            if args.tiny:
+                pcfg = _tiny_override(pcfg)
+            pjdtype = jnp.bfloat16 if pdtype == "bf16" else jnp.float32
+            pmodel = _model_cls(pfam)(pcfg, rngs=nnx.Rngs(0), dtype=pjdtype,
+                                      param_dtype=pjdtype)
+            pkey = (f"{pfam}:{ppreset}" + (":tiny" if args.tiny else "")
+                    + ":" + pdtype)
+            if pdtype == "int8":
+                if args.model_parallel > 1:
+                    raise SystemExit(
+                        f"--pool-model {pname}: int8 does not support "
+                        "--model-parallel > 1 (same constraint as --dtype "
+                        "int8); use data replicas")
+                from jimm_tpu.quant import quantize_model
+                quantize_model(pmodel)
+            pmethod = ("encode_image" if pfam in ("clip", "siglip")
+                       else "__call__")
+            psize = pmodel.config.vision.image_size
+            pforward, ptrace = _build_forward(pmodel, pmethod, psize, pkey)
+            pengine = InferenceEngine(
+                pforward, item_shape=(psize, psize, 3),
+                buckets=BucketTable(buckets.sizes,
+                                    dtype=_bucket_dtypes[pdtype]),
+                max_delay_ms=args.max_delay_ms, policy=policy,
+                metrics=engine.metrics, qos=qos)
+            # per-model compile gauge (the bare `compile_count` gauge stays
+            # the default model's, bound above via trace_count=)
+            engine.metrics.bind_gauge(f"model_{pname}_compile_count", ptrace)
+            pool_traces.append(ptrace)
+            engines[pname] = pengine
+        pool = ModelPool(engines, default="default")
+        # every extra engine's __init__ re-bound queue_depth_now to its own
+        # queue (latest wins); restore it to the default model's
+        engine.metrics.bind_gauge(
+            "queue_depth_now",
+            lambda e=engine: (float(e._queue.qsize())
+                              if e._queue is not None else 0.0))
     zero_shot = (ZeroShotService(model, model_key=model_key)
                  if fam in ("clip", "siglip") else None)
     retrieval = None
@@ -1498,14 +1579,21 @@ def cmd_serve(args: argparse.Namespace) -> int:
     server = ServingServer(engine, zero_shot=zero_shot,
                            retrieval=retrieval, host=args.host,
                            port=args.port, metrics_logger=logger,
-                           metrics_log_every_s=args.metrics_every_s)
+                           metrics_log_every_s=args.metrics_every_s,
+                           pool=pool)
     t0 = time.monotonic()
     server.start()
     ready = {"status": "serving", "host": args.host,
              "port": server.port, "model": model_key,
              "buckets": list(buckets.sizes), "dtype": buckets.dtype,
              "warmup_s": round(time.monotonic() - t0, 3),
-             "compile_count": trace_count()}
+             "compile_count": trace_count() + sum(t() for t in pool_traces)}
+    if qos is not None:
+        ready["qos"] = {"policy": args.qos_policy,
+                        "classes": list(qos.registry.class_order),
+                        "tenants": sorted(qos.registry.tenants)}
+    if pool is not None:
+        ready["models"] = pool.describe()
     if not plan.is_trivial:
         ready["topology"] = plan.describe()
     if args.aot_store:
@@ -1885,6 +1973,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--search-k", type=int, default=10,
                     help="compiled top-k carry width; /v1/search requests "
                          "may ask for any k up to this")
+    sp.add_argument("--qos-policy", default=None, metavar="FILE",
+                    help="tenant QoS policy (JSON/TOML): priority classes, "
+                         "per-tenant token-bucket rate limits, and queue "
+                         "quotas; enables weighted-fair scheduling and "
+                         "class-ordered shedding (docs/qos.md). Without it "
+                         "serving is byte-identical to the policy-free "
+                         "server")
+    sp.add_argument("--pool-model", action="append", default=None,
+                    metavar="NAME=PRESET[@DTYPE]",
+                    help="additional resident model (repeatable): random-"
+                         "init PRESET at DTYPE (f32|bf16|int8, default "
+                         "f32), warm its own engine + AOT fingerprint, and "
+                         "route requests naming model=NAME to it; inherits "
+                         "--tiny/--buckets/--aot-store")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_serve)
 
@@ -1912,6 +2014,10 @@ def build_parser() -> argparse.ArgumentParser:
     # jimm-tpu index {build,add,ls,verify,compact} — retrieval stores (no jax)
     from jimm_tpu.retrieval.cli import add_index_parser
     add_index_parser(sub)
+
+    # jimm-tpu qos {ls,validate} — tenant QoS policy tooling (no jax)
+    from jimm_tpu.serve.qos.cli import add_qos_parser
+    add_qos_parser(sub)
 
     return p
 

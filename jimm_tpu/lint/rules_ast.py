@@ -69,6 +69,17 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   start. Handle it, log it, or narrow the except; deliberate best-effort
   swallows carry a ``# jaxlint: disable=JL013`` justification. Tests are
   exempt.
+- **JL014** unbounded request-keyed table growth in ``serve/`` library
+  code: a ``self.<table>[<param>] = ...`` (or ``.setdefault(<param>,
+  ...)``) where the key comes from a caller-supplied parameter and the
+  class never evicts from that table (``.pop``/``.popitem``/``.clear``/
+  ``del``). A per-tenant/per-model dict keyed by whatever clients send is
+  a memory leak an adversary controls — one request per invented name
+  grows the table forever. Key runtime state by *configuration* (the
+  policy file's tenant names, the pool's operator-built model table) and
+  map unknown ids onto one shared default slot, or give the table an
+  eviction path; deliberate bounded tables carry a
+  ``# jaxlint: disable=JL014`` justification. Tests are exempt.
 """
 
 from __future__ import annotations
@@ -988,6 +999,101 @@ def check_swallowed_exception(tree: ast.AST, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL014 — unbounded request-keyed table growth in serving state
+# ---------------------------------------------------------------------------
+
+_EVICTION_METHODS = frozenset({"pop", "popitem", "popleft", "clear"})
+
+
+def _self_attr_name(node: ast.AST) -> str | None:
+    """``self.<attr>`` -> attr name (None for anything else)."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _evicted_attrs(cls: ast.ClassDef) -> set[str]:
+    """Attributes of ``cls`` that have SOME eviction path anywhere in the
+    class body: ``self.x.pop/popitem/popleft/clear(...)`` or
+    ``del self.x[...]``. Presence of any eviction op is the evidence the
+    table is managed, so every write to it stays legal."""
+    evicted: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _EVICTION_METHODS:
+            attr = _self_attr_name(node.func.value)
+            if attr is not None:
+                evicted.add(attr)
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript):
+                    attr = _self_attr_name(target.value)
+                    if attr is not None:
+                        evicted.add(attr)
+    return evicted
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def check_unbounded_tenant_table(tree: ast.AST, path: str) -> list[Finding]:
+    """JL014: serving state keyed by caller-supplied identifiers with no
+    eviction. The QoS discipline is that runtime tables are bounded by
+    *configuration* (policy-file tenants, the operator's model pool), not
+    by traffic: anonymous/unknown ids share one default slot. This rule
+    catches the regression where a handler quietly grows
+    ``self.per_tenant[tenant_id]`` per request — unbounded memory an
+    adversary can drive by inventing names."""
+    if not _path_is_serve(path) or _path_is_test(path):
+        return []
+    findings = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        evicted = _evicted_attrs(cls)
+        for fn in ast.walk(cls):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                      + fn.args.kwonlyargs)} - {"self"}
+            if not params:
+                continue
+            for node in ast.walk(fn):
+                attr = key = None
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for target in targets:
+                        if isinstance(target, ast.Subscript):
+                            a = _self_attr_name(target.value)
+                            if a is not None:
+                                attr, key = a, target.slice
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "setdefault" and node.args:
+                    a = _self_attr_name(node.func.value)
+                    if a is not None:
+                        attr, key = a, node.args[0]
+                if attr is None or attr in evicted \
+                        or not (_names_in(key) & params):
+                    continue
+                findings.append(Finding(
+                    "JL014", ERROR, path, node.lineno,
+                    f"self.{attr} grows per caller-supplied key with no "
+                    f"eviction anywhere in {cls.name} — a request-keyed "
+                    f"table is memory an adversary controls (one invented "
+                    f"tenant/model name per request, forever). Key state "
+                    f"by configuration and map unknown ids to a shared "
+                    f"default slot, add an eviction path, or justify with "
+                    f"# jaxlint: disable=JL014"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -1006,4 +1112,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_host_sort(tree, path)
     findings += check_quant_upcast(tree, path)
     findings += check_swallowed_exception(tree, path)
+    findings += check_unbounded_tenant_table(tree, path)
     return findings

@@ -12,15 +12,20 @@ and client are stdlib-only. See ``docs/serving.md``.
 from jimm_tpu.serve.admission import (AdmissionController, AdmissionPolicy,
                                       DeadlineExceededError, EngineClosedError,
                                       QueueFullError, RequestError,
-                                      ServeError, ServeMetrics)
+                                      ServeError, ServeMetrics, ShedError,
+                                      ThrottledError)
 from jimm_tpu.serve.buckets import (DEFAULT_BATCH_BUCKETS, SERVE_DTYPES,
                                     TPU_BATCH_BUCKETS, BucketTable,
                                     default_buckets, pad_batch)
 from jimm_tpu.serve.cache import (EmbeddingCache, class_embedding_cache,
                                   prompt_set_key)
 from jimm_tpu.serve.client import (ServeClient, ServeClientError,
+                                   ShedClientError, ThrottledClientError,
                                    encode_image_payload)
 from jimm_tpu.serve.engine import InferenceEngine, counting_forward
+from jimm_tpu.serve.qos import (ModelPool, QosPolicyError, QosScheduler,
+                                TenantRegistry, TenantSpec,
+                                WeightedFairQueue, load_policy)
 from jimm_tpu.serve.server import (ServingServer, ZeroShotService,
                                    decode_image_payload)
 from jimm_tpu.serve.topology import (ReplicaForward, TopologyPlan,
@@ -29,11 +34,15 @@ from jimm_tpu.serve.topology import (ReplicaForward, TopologyPlan,
 __all__ = [
     "AdmissionController", "AdmissionPolicy", "BucketTable",
     "DEFAULT_BATCH_BUCKETS", "DeadlineExceededError", "EmbeddingCache",
-    "EngineClosedError", "InferenceEngine", "QueueFullError", "ReplicaForward",
+    "EngineClosedError", "InferenceEngine", "ModelPool", "QosPolicyError",
+    "QosScheduler", "QueueFullError", "ReplicaForward",
     "RequestError", "ServeClient", "ServeClientError", "ServeError",
-    "SERVE_DTYPES", "ServeMetrics", "ServingServer", "TPU_BATCH_BUCKETS",
-    "TopologyPlan",
+    "SERVE_DTYPES", "ServeMetrics", "ServingServer", "ShedClientError",
+    "ShedError", "TPU_BATCH_BUCKETS", "TenantRegistry", "TenantSpec",
+    "ThrottledClientError", "ThrottledError", "TopologyPlan",
+    "WeightedFairQueue",
     "ZeroShotService", "build_replica_forwards", "class_embedding_cache",
     "counting_forward", "decode_image_payload", "default_buckets",
-    "encode_image_payload", "pad_batch", "plan_topology", "prompt_set_key",
+    "encode_image_payload", "load_policy", "pad_batch", "plan_topology",
+    "prompt_set_key",
 ]

@@ -46,6 +46,33 @@ class QueueFullError(ServeError):
     http_status = 503
 
 
+class ThrottledError(ServeError):
+    """Tenant exceeded its token-bucket rate or queue quota (QoS policy).
+    Distinct from shedding: a throttled request was *never admitted*, and
+    the 429 carries a Retry-After hint from the bucket's refill math."""
+
+    code = "throttled"
+    http_status = 429
+
+    def __init__(self, message: str, *, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class ShedError(ServeError):
+    """Request evicted from the queue under overload to make room for a
+    higher-priority class (class-ordered shedding). 503 like queue-full —
+    the server is saturated — but typed distinctly so clients can tell
+    "I was rate-limited" (429) from "I was sacrificed" (503 shed)."""
+
+    code = "shed"
+    http_status = 503
+
+    def __init__(self, message: str, *, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 class DeadlineExceededError(ServeError):
     code = "deadline_exceeded"
     http_status = 504
@@ -126,7 +153,7 @@ class ServeMetrics:
         with self._lock:
             counter = self._counters.get(name)
             if counter is None:
-                counter = self._counters[name] = self.registry.counter(name)
+                counter = self._counters[name] = self.registry.counter(name)  # jaxlint: disable=JL014 — keys are code-defined metric names, not request data
         counter.inc(by)
 
     def set_queue_depth(self, depth: int) -> None:
@@ -149,12 +176,12 @@ class ServeMetrics:
         hist = self._phases.get(phase)
         if hist is None:
             with self._lock:
-                hist = self._phases.setdefault(
+                hist = self._phases.setdefault(  # jaxlint: disable=JL014 — phase names come from the engine's fixed span set
                     phase, self.registry.histogram(f"span_{phase}_seconds"))
         hist.observe(seconds)
 
     def bind_gauge(self, name: str, fn: Callable[[], float]) -> None:
-        self._gauges[name] = fn
+        self._gauges[name] = fn  # jaxlint: disable=JL014 — gauge names are bound by server/engine code at wiring time
         self.registry.gauge(name, fn)
 
     # -- derived ----------------------------------------------------------

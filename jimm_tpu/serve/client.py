@@ -20,12 +20,36 @@ from jimm_tpu.resilience.backoff import BackoffPolicy  # stdlib-only module
 
 class ServeClientError(Exception):
     """Server-reported error: carries the HTTP status and the typed code
-    (``queue_full``, ``deadline_exceeded``, ``bad_request``, ...)."""
+    (``queue_full``, ``deadline_exceeded``, ``bad_request``, ...), plus
+    the server's ``Retry-After`` hint (seconds) when it sent one."""
 
-    def __init__(self, status: int, code: str, message: str):
+    def __init__(self, status: int, code: str, message: str,
+                 retry_after_s: float | None = None):
         super().__init__(f"{code} (HTTP {status}): {message}")
         self.status = status
         self.code = code
+        self.retry_after_s = retry_after_s
+
+
+class ThrottledClientError(ServeClientError):
+    """429: the QoS policy rate-limited this tenant — the request was
+    never admitted. Waiting ``retry_after_s`` (the token bucket's refill
+    time) before retrying is sufficient, not just polite."""
+
+
+class ShedClientError(ServeClientError):
+    """503 with code ``shed``: the request WAS queued but got evicted
+    under overload in favor of a higher-priority class. The server is
+    saturated; back off harder than for a throttle."""
+
+
+def _typed_error(status: int, code: str, message: str,
+                 retry_after_s: float | None) -> ServeClientError:
+    if status == 429:
+        return ThrottledClientError(status, code, message, retry_after_s)
+    if status == 503 and code == "shed":
+        return ShedClientError(status, code, message, retry_after_s)
+    return ServeClientError(status, code, message, retry_after_s)
 
 
 def encode_image_payload(image) -> dict:
@@ -61,10 +85,20 @@ class ServeClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000,
                  timeout_s: float = 30.0, retries: int = 2,
                  backoff_base_s: float = 0.05,
-                 backoff_seed: int | None = None):
+                 backoff_seed: int | None = None,
+                 tenant: str | None = None, model: str | None = None,
+                 retry_throttled: int = 0):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
+        #: tenant id / model name sent as X-Jimm-Tenant / X-Jimm-Model on
+        #: every request (None sends nothing — the anonymous default path)
+        self.tenant = tenant
+        self.model = model
+        #: how many 429-throttled / 503-shed responses to retry before
+        #: surfacing the typed error. 0 (default) never retries: batch
+        #: drivers opt in, latency-sensitive callers see the error at once.
+        self.retry_throttled = retry_throttled
         self._backoff = BackoffPolicy(retries=retries, base_s=backoff_base_s,
                                       max_s=2.0, jitter=0.5,
                                       seed=backoff_seed)
@@ -100,9 +134,14 @@ class ServeClient:
                  *, deadline_s: float | None = None):
         body = None if payload is None else json.dumps(payload).encode()
         headers = {"Content-Type": "application/json"} if body else {}
+        if self.tenant is not None:
+            headers["X-Jimm-Tenant"] = self.tenant
+        if self.model is not None:
+            headers["X-Jimm-Model"] = self.model
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
         fresh_failures = 0
+        throttle_retries = 0
         while True:
             reused = getattr(self._local, "conn", None) is not None
             conn = self._connection()
@@ -110,7 +149,6 @@ class ServeClient:
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
                 raw = resp.read()
-                break
             except TimeoutError:
                 # a slow server is not a stale socket — surface it
                 self._drop_connection()
@@ -134,20 +172,38 @@ class ServeClient:
                         and time.monotonic() + delay >= deadline):
                     raise  # honoring the deadline beats one more attempt
                 self._sleep(delay)
-        if resp.getheader("Connection", "").lower() == "close":
-            self._drop_connection()
-        content_type = resp.getheader("Content-Type") or ""
-        if not content_type.startswith("application/json"):
-            if resp.status >= 400:
-                raise ServeClientError(resp.status, "http_error",
-                                       raw.decode(errors="replace")[:200])
-            return raw.decode(errors="replace")
-        obj = json.loads(raw)
-        if resp.status >= 400:
-            raise ServeClientError(resp.status,
-                                   obj.get("error", "http_error"),
-                                   obj.get("message", ""))
-        return obj
+                continue
+            if resp.getheader("Connection", "").lower() == "close":
+                self._drop_connection()
+            content_type = resp.getheader("Content-Type") or ""
+            if not content_type.startswith("application/json"):
+                if resp.status >= 400:
+                    raise ServeClientError(resp.status, "http_error",
+                                           raw.decode(errors="replace")[:200])
+                return raw.decode(errors="replace")
+            obj = json.loads(raw)
+            if resp.status < 400:
+                return obj
+            try:
+                retry_after = float(resp.getheader("Retry-After"))
+            except (TypeError, ValueError):
+                retry_after = None
+            err = _typed_error(resp.status, obj.get("error", "http_error"),
+                               obj.get("message", ""), retry_after)
+            if (isinstance(err, (ThrottledClientError, ShedClientError))
+                    and throttle_retries < self.retry_throttled):
+                # honor Retry-After: sleep at least the server's hint,
+                # escalated by the shared jittered BackoffPolicy so a
+                # throttled herd doesn't return in lockstep — still
+                # bounded by the request deadline
+                delay = max(self._backoff.delay(throttle_retries),
+                            retry_after or 0.0)
+                throttle_retries += 1
+                if (deadline is None
+                        or time.monotonic() + delay < deadline):
+                    self._sleep(delay)
+                    continue
+            raise err
 
     # -- API --------------------------------------------------------------
 

@@ -41,7 +41,7 @@ import numpy as np
 from jimm_tpu.obs.spans import new_trace_id, span
 from jimm_tpu.serve.admission import (AdmissionController, AdmissionPolicy,
                                       DeadlineExceededError, EngineClosedError,
-                                      RequestError, ServeMetrics)
+                                      RequestError, ServeMetrics, ShedError)
 from jimm_tpu.serve.buckets import BucketTable, default_buckets, pad_batch
 
 _STOP = object()
@@ -69,15 +69,21 @@ def counting_forward(model, method: str = "encode_image"
 
 
 class _Request:
-    __slots__ = ("item", "future", "deadline", "t0", "rid")
+    # tenant/klass are QoS annotations (the scheduler's tenant state and
+    # priority-class name); both stay None on the policy-free path
+    __slots__ = ("item", "future", "deadline", "t0", "rid", "tenant",
+                 "klass")
 
     def __init__(self, item: np.ndarray, future: asyncio.Future,
-                 deadline: float, t0: float, rid: str):
+                 deadline: float, t0: float, rid: str,
+                 tenant=None, klass: str | None = None):
         self.item = item
         self.future = future
         self.deadline = deadline
         self.t0 = t0
         self.rid = rid
+        self.tenant = tenant
+        self.klass = klass
 
 
 class _Replica:
@@ -127,6 +133,12 @@ class InferenceEngine:
         metrics: shared :class:`ServeMetrics` (one per server).
         trace_count: optional compile-count getter, exported as the
             ``compile_count`` gauge.
+        qos: optional :class:`~jimm_tpu.serve.qos.QosScheduler`. When
+            given, submissions carry tenant identity through token-bucket
+            admission, the FIFO queue becomes the per-class weighted-fair
+            queue, and overload sheds class-ordered. When None (the
+            default) every path below is byte-identical to the policy-free
+            engine.
     """
 
     def __init__(self, forward, *, item_shape: tuple[int, ...],
@@ -134,7 +146,8 @@ class InferenceEngine:
                  max_delay_ms: float = 5.0,
                  policy: AdmissionPolicy | None = None,
                  metrics: ServeMetrics | None = None,
-                 trace_count: Callable[[], int] | None = None):
+                 trace_count: Callable[[], int] | None = None,
+                 qos=None):
         # A list of forwards means explicit replicas (topology-planned
         # serving); a bare callable is the classic single-replica engine.
         # The per-replica jimm_serve_replica_* series exist only in the
@@ -154,6 +167,9 @@ class InferenceEngine:
         self.max_delay_s = max_delay_ms / 1e3
         self.metrics = metrics or ServeMetrics()
         self.admission = AdmissionController(policy, self.metrics)
+        self.qos = qos
+        if qos is not None:
+            qos.bind_metrics(self.metrics)
         self.trace_count = trace_count
         if trace_count is not None:
             self.metrics.bind_gauge("compile_count", trace_count)
@@ -167,7 +183,9 @@ class InferenceEngine:
                                     lambda: float(len(self._replicas)))
             for replica in self._replicas:
                 self._bind_replica_metrics(replica)
-        self._queue: asyncio.Queue | None = None
+        # asyncio.Queue, or a qos.WeightedFairQueue (same surface) when a
+        # policy is configured
+        self._queue = None
         self._task: asyncio.Task | None = None
         self._capacity: asyncio.Semaphore | None = None
         self._dispatch_tasks: set[asyncio.Task] = set()
@@ -291,7 +309,13 @@ class InferenceEngine:
     async def start(self) -> None:
         if self._running:
             return
-        self._queue = asyncio.Queue()
+        if self.qos is not None:
+            # per-class deques + deficit-round-robin drain; same
+            # put/get/qsize surface, so the batcher below is untouched
+            from jimm_tpu.serve.qos.scheduler import WeightedFairQueue
+            self._queue = WeightedFairQueue(self.qos)
+        else:
+            self._queue = asyncio.Queue()
         # one permit per replica: the batcher only forms the next batch
         # when some replica can take it, so admission backpressure still
         # sees every queued request (nothing hides in formed-but-unrunnable
@@ -321,23 +345,45 @@ class InferenceEngine:
 
     async def submit(self, item: np.ndarray,
                      timeout_s: float | None = None,
-                     trace_id: str | None = None) -> np.ndarray:
+                     trace_id: str | None = None,
+                     tenant: str | None = None) -> np.ndarray:
         """One request in, one output row out. Raises
         :class:`QueueFullError` (backpressure), :class:`RequestError`
         (shape mismatch), or :class:`DeadlineExceededError` (deadline hit
         while queued or in flight). ``trace_id`` (admission-assigned, or
         generated here) follows the request into bucket dispatch and keys
-        its phase decomposition in ``recent_traces``."""
+        its phase decomposition in ``recent_traces``.
+
+        With a QoS scheduler configured, ``tenant`` selects the policy
+        applied: token-bucket/quota admission may raise
+        :class:`~jimm_tpu.serve.admission.ThrottledError` (429), the
+        tenant's deadline is inherited when ``timeout_s`` is None, and
+        under overload a lower-class queued request is shed
+        (:class:`~jimm_tpu.serve.admission.ShedError`, 503) to admit a
+        higher-class arrival. Without a scheduler ``tenant`` is ignored
+        and this path is byte-identical to the original engine.
+        """
         if not self._running or self._queue is None:
             raise EngineClosedError("engine is not running; call start()")
         item = self._coerce(item)
         self.metrics.inc("requests_total")
+        tenant_state = klass = None
+        if self.qos is not None:
+            tenant_state = self.qos.resolve(tenant)
+            klass = tenant_state.spec.klass
+            self.qos.admit(tenant_state)
+            timeout_s = self.qos.timeout_for(tenant_state, timeout_s)
+            if self._queue.qsize() >= self.admission.policy.max_queue:
+                self._shed_for(klass)
         self.admission.admit(self._queue.qsize())
         now = time.monotonic()
         deadline = self.admission.deadline_for(timeout_s, now)
         future = asyncio.get_running_loop().create_future()
         self._queue.put_nowait(_Request(item, future, deadline, now,
-                                        trace_id or new_trace_id()))
+                                        trace_id or new_trace_id(),
+                                        tenant_state, klass))
+        if tenant_state is not None:
+            self.qos.on_enqueue(tenant_state)
         self.metrics.set_queue_depth(self._queue.qsize())
         try:
             return await asyncio.wait_for(future, timeout=deadline - now)
@@ -346,6 +392,20 @@ class InferenceEngine:
             raise DeadlineExceededError(
                 f"request deadline ({deadline - now:.3f}s) exceeded") \
                 from None
+
+    def _shed_for(self, klass: str) -> None:
+        """Class-ordered overload shedding: evict the newest queued
+        request of the lowest class strictly below ``klass`` so the
+        arriving higher-class request can be admitted. When every lower
+        class is empty nothing is evicted — the arrival then takes the
+        normal queue-full rejection, so a class never preempts its peers
+        or its betters."""
+        victim = self._queue.shed_lower(self.qos.rank_of(klass))
+        if victim is not None and not victim.future.done():
+            victim.future.set_exception(ShedError(
+                f"shed under overload to admit class {klass!r} traffic; "
+                "retry with backoff",
+                retry_after_s=round(self.max_delay_s * 4, 4)))
 
     def _coerce(self, item) -> np.ndarray:
         """Validate and cast one request payload (host-side, cheap)."""

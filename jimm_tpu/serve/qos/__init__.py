@@ -1,0 +1,27 @@
+"""``jimm_tpu.serve.qos`` — multi-tenant QoS serving control plane.
+
+A policy layer above the engine's replica-dispatch data plane: tenant
+identity with token-bucket rate limits and quotas (:mod:`.policy`),
+per-class weighted-fair (deficit-round-robin) dequeue with class-ordered
+shedding (:mod:`.scheduler`), and multi-model residency on one topology
+(:mod:`.pool`). Everything here is control plane: the hot compiled path —
+buckets, AOT warm starts, replica executors — is untouched, and with no
+policy configured the engine runs its original single-FIFO semantics
+byte-for-byte. See ``docs/qos.md``.
+
+``policy`` and ``cli`` are stdlib-only (no jax, no numpy) so the
+``jimm-tpu qos`` CLI works from any process.
+"""
+
+from jimm_tpu.serve.qos.policy import (ClassSpec, QosPolicyError,
+                                       TenantRegistry, TenantSpec,
+                                       load_policy)
+from jimm_tpu.serve.qos.pool import ModelPool
+from jimm_tpu.serve.qos.scheduler import (QosScheduler, TokenBucket,
+                                          WeightedFairQueue)
+
+__all__ = [
+    "ClassSpec", "ModelPool", "QosPolicyError", "QosScheduler",
+    "TenantRegistry", "TenantSpec", "TokenBucket", "WeightedFairQueue",
+    "load_policy",
+]

@@ -1,0 +1,135 @@
+"""Multi-model residency: several warm engines behind one server.
+
+A :class:`ModelPool` keeps N checkpoints (e.g. the f32 and int8 twins, or
+B/16 next to So400m) resident on one topology, each wrapped in its own
+:class:`~jimm_tpu.serve.engine.InferenceEngine` whose forward carries its
+own AOT fingerprint — the artifact store keys on the aggregated param
+dtype and config, so the twins can never adopt each other's executables
+and every model restarts warm independently. Requests pick a model with
+the ``model=`` field (or ``X-Jimm-Model`` header); absent means the
+default model, so single-model deployments are unchanged.
+
+Weight hot-swap is :meth:`swap`: stage a fresh warmed engine under an
+existing name and the pool atomically re-routes new requests to it,
+returning the old engine for the caller to drain and stop. The pool's
+table is operator-configured and every entry is removable
+(:meth:`remove` is the eviction path JL014 looks for) — request traffic
+can route to models but never create them.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from jimm_tpu.serve.admission import RequestError
+
+__all__ = ["ModelPool"]
+
+
+class ModelPool:
+    """Named engines sharing one server, one metrics surface, one loop.
+
+    Args:
+        engines: ``{name: InferenceEngine}`` — all resident models. Build
+            them with a **shared** :class:`ServeMetrics` so the pool reads
+            as one ``jimm_serve`` namespace; the pool adds per-model
+            dispatch counters on top.
+        default: name routed when a request names no model.
+    """
+
+    def __init__(self, engines: dict, *, default: str):
+        if default not in engines:
+            raise ValueError(f"default model {default!r} not in pool "
+                             f"({sorted(engines)})")
+        self._lock = threading.Lock()
+        self._engines = dict(engines)
+        self.default_name = default
+        metrics = engines[default].metrics
+        for name in engines:
+            metrics.inc(f"model_{name}_requests_total", 0)
+
+    # -- routing ----------------------------------------------------------
+
+    @property
+    def default(self):
+        return self._engines[self.default_name]
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._engines)
+
+    def engines(self) -> list:
+        with self._lock:
+            return list(self._engines.values())
+
+    def get(self, model: str | None):
+        """The engine serving ``model`` (None -> default). Unknown names
+        are a client error, not a server fault."""
+        with self._lock:
+            if model is None:
+                engine = self._engines[self.default_name]
+                name = self.default_name
+            else:
+                engine = self._engines.get(model)
+                name = model
+            if engine is None:
+                raise RequestError(
+                    f"unknown model {model!r} (resident: "
+                    f"{sorted(self._engines)})")
+        engine.metrics.inc(f"model_{name}_requests_total")
+        return engine
+
+    # -- residency management (operator plane) ----------------------------
+
+    def add(self, name: str, engine) -> None:
+        """Make a warmed, started engine resident under a new name."""
+        with self._lock:
+            if name in self._engines:
+                raise ValueError(f"model {name!r} already resident; "
+                                 "use swap()")
+            self._engines[name] = engine
+        engine.metrics.inc(f"model_{name}_requests_total", 0)
+
+    def swap(self, name: str, engine):
+        """Weight hot-swap: atomically route ``name`` to ``engine`` and
+        return the previous engine (caller drains/stops it). The new
+        engine must already be warm — the swap itself never compiles."""
+        with self._lock:
+            if name not in self._engines:
+                raise ValueError(f"model {name!r} not resident; use add()")
+            old = self._engines[name]
+            self._engines[name] = engine
+        return old
+
+    def remove(self, name: str):
+        """Evict a resident model (the default cannot be evicted) and
+        return its engine for the caller to stop."""
+        with self._lock:
+            if name == self.default_name:
+                raise ValueError("cannot remove the default model")
+            if name not in self._engines:
+                raise ValueError(f"model {name!r} not resident")
+            return self._engines.pop(name)
+
+    # -- surfaces ---------------------------------------------------------
+
+    def describe(self) -> dict:
+        """healthz ``models`` block: per-model buckets/dtype/warm-start
+        provenance and dispatch counts."""
+        with self._lock:
+            items = sorted(self._engines.items())
+        out = {}
+        for name, engine in items:
+            row = {"default": name == self.default_name,
+                   "buckets": list(engine.buckets.sizes),
+                   # serving precision rides the bucket table, not the
+                   # engine (whose dtype is batch assembly, always f32)
+                   "dtype": engine.buckets.dtype,
+                   "requests": engine.metrics.count(
+                       f"model_{name}_requests_total")}
+            report = getattr(engine, "warmup_report", None)
+            if report:
+                row["warmup"] = {str(k): v["source"]
+                                 for k, v in sorted(report.items())}
+            out[name] = row
+        return out

@@ -126,20 +126,32 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _send(self, status: int, body: bytes,
-              content_type: str = "application/json") -> None:
+              content_type: str = "application/json",
+              extra_headers: dict | None = None) -> None:
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (extra_headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, status: int, obj) -> None:
-        self._send(status, json.dumps(obj).encode())
+    def _send_json(self, status: int, obj,
+                   extra_headers: dict | None = None) -> None:
+        self._send(status, json.dumps(obj).encode(),
+                   extra_headers=extra_headers)
 
     def _send_error_obj(self, e: Exception) -> None:
         if isinstance(e, ServeError):
-            self._send_json(e.http_status,
-                            {"error": e.code, "message": str(e)})
+            body = {"error": e.code, "message": str(e)}
+            headers = None
+            # throttled (429) and shed (503) responses tell the client
+            # when to come back; ServeClient feeds this into its backoff
+            retry_after = getattr(e, "retry_after_s", None)
+            if retry_after is not None:
+                body["retry_after_s"] = retry_after
+                headers = {"Retry-After": f"{max(retry_after, 0.0):.3f}"}
+            self._send_json(e.http_status, body, extra_headers=headers)
         else:
             self.server.app.metrics.inc("errors_total")
             self._send_json(500, {"error": "internal", "message": str(e)})
@@ -173,6 +185,15 @@ class _Handler(BaseHTTPRequestHandler):
         app = self.server.app
         try:
             payload = self._read_body()
+            # identity/routing headers fold into the payload (an explicit
+            # payload field wins) so app.embed()/classify()/search() have
+            # one spelling whether called over HTTP or in-process
+            tenant = self.headers.get("X-Jimm-Tenant")
+            if tenant is not None:
+                payload.setdefault("tenant", tenant)
+            model = self.headers.get("X-Jimm-Model")
+            if model is not None:
+                payload.setdefault("model", model)
             if self.path == "/v1/embed":
                 self._send_json(200, app.embed(payload))
             elif self.path == "/v1/classify":
@@ -201,10 +222,17 @@ class ServingServer:
 
     def __init__(self, engine: InferenceEngine, *,
                  zero_shot: ZeroShotService | None = None,
-                 retrieval=None,
+                 retrieval=None, pool=None,
                  host: str = "127.0.0.1", port: int = 0,
                  request_timeout_s: float = 30.0, warmup: bool = True,
                  metrics_logger=None, metrics_log_every_s: float = 10.0):
+        #: optional jimm_tpu.serve.qos.ModelPool for multi-model residency;
+        #: ``engine`` must be its default entry (requests naming no model
+        #: route there). All pool engines share this server's loop, warmup,
+        #: and ServeMetrics.
+        self.pool = pool
+        if pool is not None and engine is not pool.default:
+            raise ValueError("engine must be the pool's default entry")
         self.engine = engine
         self.zero_shot = zero_shot
         #: optional jimm_tpu.retrieval.RetrievalService backing /v1/search
@@ -230,11 +258,15 @@ class ServingServer:
 
     # -- lifecycle --------------------------------------------------------
 
+    def _engines(self) -> list[InferenceEngine]:
+        return self.pool.engines() if self.pool is not None else [self.engine]
+
     def start(self) -> None:
         if self._loop is not None:
             return
         if self._warmup:
-            self.engine.warmup_blocking()
+            for engine in self._engines():
+                engine.warmup_blocking()
             if self.retrieval is not None:
                 self.retrieval.warmup()
         loop = asyncio.new_event_loop()
@@ -250,7 +282,9 @@ class ServingServer:
         self._loop_thread.start()
         started.wait()
         self._loop = loop
-        asyncio.run_coroutine_threadsafe(self.engine.start(), loop).result(10)
+        for engine in self._engines():
+            asyncio.run_coroutine_threadsafe(engine.start(),
+                                             loop).result(10)
         self._httpd = _Server((self.host, self._requested_port), _Handler)
         self._httpd.app = self
         self._http_thread = threading.Thread(
@@ -285,8 +319,9 @@ class ServingServer:
             self._httpd.server_close()
             self._httpd = None
         if self._loop is not None:
-            asyncio.run_coroutine_threadsafe(self.engine.stop(),
-                                             self._loop).result(10)
+            for engine in self._engines():
+                asyncio.run_coroutine_threadsafe(engine.stop(),
+                                                 self._loop).result(10)
             self._loop.call_soon_threadsafe(self._loop.stop)
             if self._loop_thread is not None:
                 self._loop_thread.join(timeout=10)
@@ -306,44 +341,60 @@ class ServingServer:
 
     # -- request handling (called from HTTP handler threads) --------------
 
+    def _engine_for(self, model: str | None) -> InferenceEngine:
+        """Route a request's ``model`` field to its resident engine. With
+        no pool the field is ignored (single-model servers predate it)."""
+        if self.pool is None:
+            return self.engine
+        return self.pool.get(model)
+
     def _submit(self, image: np.ndarray, timeout_s: float | None,
-                trace_id: str | None = None) -> np.ndarray:
+                trace_id: str | None = None, *,
+                engine: InferenceEngine | None = None,
+                tenant: str | None = None) -> np.ndarray:
         assert self._loop is not None
+        engine = engine if engine is not None else self.engine
         future = asyncio.run_coroutine_threadsafe(
-            self.engine.submit(image, timeout_s=timeout_s,
-                               trace_id=trace_id), self._loop)
+            engine.submit(image, timeout_s=timeout_s,
+                          trace_id=trace_id, tenant=tenant), self._loop)
         return future.result(timeout=self.request_timeout_s)
 
-    def _submit_many(self, images: list, timeout_s,
-                     trace_id: str) -> list[np.ndarray]:
+    def _submit_many(self, images: list, timeout_s, trace_id: str, *,
+                     engine: InferenceEngine | None = None,
+                     tenant: str | None = None) -> list[np.ndarray]:
         """Submit a burst of single-item requests at once so the engine's
         batcher coalesces them into its warm buckets — the bulk-embed path
         rides the exact same admission/dispatch machinery as singles."""
         assert self._loop is not None
+        engine = engine if engine is not None else self.engine
         futures = [asyncio.run_coroutine_threadsafe(
-            self.engine.submit(image, timeout_s=timeout_s,
-                               trace_id=f"{trace_id}.{i}"), self._loop)
+            engine.submit(image, timeout_s=timeout_s,
+                          trace_id=f"{trace_id}.{i}", tenant=tenant),
+            self._loop)
             for i, image in enumerate(images)]
         return [f.result(timeout=self.request_timeout_s) for f in futures]
 
     def embed(self, payload: dict) -> dict:
         rid = new_trace_id()
+        engine = self._engine_for(payload.get("model"))
+        tenant = payload.get("tenant")
         if "images" in payload:
             raw = payload["images"]
             if not isinstance(raw, list) or not raw:
                 raise RequestError("'images' must be a non-empty list")
             images = [decode_image_payload(
                 item if isinstance(item, dict) else {"image": item},
-                dtype=self.engine.dtype) for item in raw]
+                dtype=engine.dtype) for item in raw]
             features = self._submit_many(images, payload.get("timeout_s"),
-                                         rid)
+                                         rid, engine=engine, tenant=tenant)
             from jimm_tpu.retrieval.api import retrieval_metrics
             retrieval_metrics()[1].inc(len(images))
             return {"features": [np.asarray(f, np.float32).tolist()
                                  for f in features],
                     "count": len(features), "trace_id": rid}
-        image = decode_image_payload(payload, dtype=self.engine.dtype)
-        features = self._submit(image, payload.get("timeout_s"), rid)
+        image = decode_image_payload(payload, dtype=engine.dtype)
+        features = self._submit(image, payload.get("timeout_s"), rid,
+                                engine=engine, tenant=tenant)
         return {"features": np.asarray(features, np.float32).tolist(),
                 "trace_id": rid}
 
@@ -361,9 +412,11 @@ class ServingServer:
             except (TypeError, ValueError) as e:
                 raise RequestError(f"bad 'vector' payload: {e}") from None
         else:
-            image = decode_image_payload(payload, dtype=self.engine.dtype)
+            engine = self._engine_for(payload.get("model"))
+            image = decode_image_payload(payload, dtype=engine.dtype)
             query = np.asarray(
-                self._submit(image, payload.get("timeout_s"), rid),
+                self._submit(image, payload.get("timeout_s"), rid,
+                             engine=engine, tenant=payload.get("tenant")),
                 np.float32)
         values, ids = self.retrieval.search_blocking(query,
                                                      k=payload.get("k"))
@@ -382,8 +435,10 @@ class ServingServer:
             raise RequestError("classify needs 'tokens': {label: [ids]}")
         labels, weights, cached = \
             self.zero_shot.class_weights_blocking(tokens)
-        image = decode_image_payload(payload, dtype=self.engine.dtype)
-        features = self._submit(image, payload.get("timeout_s"), rid)
+        engine = self._engine_for(payload.get("model"))
+        image = decode_image_payload(payload, dtype=engine.dtype)
+        features = self._submit(image, payload.get("timeout_s"), rid,
+                                engine=engine, tenant=payload.get("tenant"))
         scores = self.zero_shot.scores(np.asarray(features), weights)
         return {"scores": {label: round(float(s), 6)
                            for label, s in zip(labels, scores)},
@@ -432,4 +487,11 @@ class ServingServer:
             out["dead_replicas"] = dead
         if self.retrieval is not None:
             out["retrieval"] = self.retrieval.describe()
+        # the qos/models blocks exist ONLY when a policy / pool is
+        # configured: the bare server's healthz shape is byte-compatible
+        # with the pre-QoS one (tested in tests/test_qos.py)
+        if getattr(self.engine, "qos", None) is not None:
+            out["qos"] = self.engine.qos.snapshot()
+        if self.pool is not None:
+            out["models"] = self.pool.describe()
         return out

@@ -1,0 +1,271 @@
+"""CI tier-1 smoke for the multi-tenant QoS serving control plane.
+
+End to end on 8 virtual CPU devices, one process, five properties:
+
+1. **Policy load**: a JSON QoS policy (vip=interactive weight 8,
+   bulk=batch weight 2) loads through the same :func:`load_policy` path
+   ``serve --qos-policy`` uses.
+2. **Two lives over one AOT store**: an f32 model sharded over a 2x2
+   topology (2 replicas x model-parallel 2) plus an int8 twin on a
+   single-device plan, both resident in one :class:`ModelPool`. Life 1
+   populates the store through write-through warmup; life 2 (warm
+   restart) must report every bucket of every model as ``"aot"``-sourced
+   with zero fresh traces.
+3. **Weighted-fair shares**: with both class queues saturated, DRR
+   dispatch shares converge to the configured weights within 10%.
+4. **Interactive isolation**: interactive p99 under full batch
+   saturation stays <= 2x the unloaded interactive p99 (the weighted-
+   fair queue keeps the latency-sensitive class out of the batch
+   backlog).
+5. **Zero post-warmup compiles** across both resident models while
+   mixed-tenant traffic flows.
+
+Prints one JSON result line; exits non-zero on any failed property.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import types
+
+REPLICAS = 2
+MODEL_PARALLEL = 2
+BATCH_CLIENTS = 16
+PROBES = 50          # per latency phase; p99 over 50 samples
+PROBE_GAP_S = 0.002
+WFQ_DRAWS = 200      # dequeues counted for the share check
+MAX_P99_RATIO = 2.0  # loaded interactive p99 vs unloaded
+
+POLICY = {
+    "tenants": {
+        "vip": {"class": "interactive"},
+        "bulk": {"class": "batch"},
+    },
+}
+
+
+def fail(msg: str) -> int:
+    print(json.dumps({"metric": "qos_smoke", "value": 0.0, "error": msg}),
+          flush=True)
+    return 1
+
+
+def p99(samples: list[float]) -> float:
+    ranked = sorted(samples)
+    return ranked[min(len(ranked) - 1, int(round(0.99 * (len(ranked) - 1))))]
+
+
+def main() -> int:
+    # must land before any jax import anywhere in the process
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import asyncio
+
+    import jax
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import CLIP, preset
+    from jimm_tpu.aot import ArtifactStore
+    from jimm_tpu.aot.warmup import AotForward
+    from jimm_tpu.cli import _tiny_override
+    from jimm_tpu.quant import quantize_model
+    from jimm_tpu.serve import (AdmissionPolicy, BucketTable, InferenceEngine,
+                                RequestError, ServeError,
+                                build_replica_forwards, plan_topology)
+    from jimm_tpu.serve.qos import (ModelPool, QosScheduler,
+                                    WeightedFairQueue, load_policy)
+
+    if jax.device_count() < REPLICAS * MODEL_PARALLEL:
+        return fail(f"need {REPLICAS * MODEL_PARALLEL} devices, have "
+                    f"{jax.device_count()} — was XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count=8 set before "
+                    f"another jax import?")
+
+    cfg = _tiny_override(preset("clip-vit-base-patch16"))
+    size = cfg.vision.image_size
+    plan = plan_topology(REPLICAS, MODEL_PARALLEL)
+    # low shed watermark: under batch saturation the coalescing wait is
+    # skipped, so the loaded/unloaded comparison isolates queueing, not
+    # the (deliberate, policy-owned) 15 ms coalescing window
+    policy = AdmissionPolicy(max_queue=64, default_timeout_s=30.0,
+                             shed_fraction=0.05)
+
+    with tempfile.TemporaryDirectory(prefix="jimm-qos-smoke-") as root:
+        policy_path = os.path.join(root, "qos.json")
+        with open(policy_path, "w", encoding="utf-8") as fh:
+            json.dump(POLICY, fh)
+        registry = load_policy(policy_path)
+        if sorted(registry.tenants) != ["bulk", "vip"]:
+            return fail(f"policy load: tenants {sorted(registry.tenants)}")
+        if registry.class_order[0] != "interactive":
+            return fail(f"policy load: class order {registry.class_order}")
+
+        # --- property 3: DRR shares, deterministic, queue-level -----------
+        # both classes kept backlogged for the whole 200-draw window, so
+        # the measured split is the scheduler's, not the workload's
+        wfq = WeightedFairQueue(QosScheduler(registry))
+        for _ in range(WFQ_DRAWS + 10):
+            wfq.put_nowait(types.SimpleNamespace(klass="interactive"))
+            wfq.put_nowait(types.SimpleNamespace(klass="batch"))
+        drawn = [wfq.get_nowait().klass for _ in range(WFQ_DRAWS)]
+        share = drawn.count("interactive") / WFQ_DRAWS
+        w_int = registry.classes["interactive"].weight
+        w_bat = registry.classes["batch"].weight
+        want = w_int / (w_int + w_bat)
+        if abs(share - want) > 0.10 * want:
+            return fail(f"WFQ interactive share {share:.3f} not within 10% "
+                        f"of weight share {want:.3f}")
+
+        store = ArtifactStore(os.path.join(root, "aot"))
+
+        def make_pool(sched):
+            """One f32 sharded engine + one int8 single-device twin,
+            shared metrics, shared QoS scheduler — the `serve
+            --pool-model` wiring, built directly."""
+            model = CLIP(cfg, rngs=nnx.Rngs(0))
+            fwd, traces = build_replica_forwards(
+                model, plan, method="encode_image",
+                item_shape=(size, size, 3), store=store,
+                label="qos_smoke:f32")
+            eng = InferenceEngine(fwd, item_shape=(size, size, 3),
+                                  buckets=BucketTable((1, 2, 4)),
+                                  max_delay_ms=15.0, policy=policy,
+                                  qos=sched, trace_count=traces)
+            qmodel = CLIP(cfg, rngs=nnx.Rngs(0))
+            quantize_model(qmodel)
+            qfwd = AotForward(qmodel, method="encode_image",
+                              item_shape=(size, size, 3), store=store,
+                              label="qos_smoke:int8")
+            qeng = InferenceEngine(qfwd, item_shape=(size, size, 3),
+                                   buckets=BucketTable((1, 2), dtype="int8"),
+                                   max_delay_ms=15.0, policy=policy,
+                                   metrics=eng.metrics, qos=sched)
+            pool = ModelPool({"default": eng, "q8": qeng}, default="default")
+            return pool, (lambda: traces() + qfwd.trace_count())
+
+        # --- life 1: populate the store through write-through warmup ------
+        pool1, traces1 = make_pool(QosScheduler(registry))
+        for eng in pool1.engines():
+            eng.warmup_blocking()
+        if not traces1():
+            return fail("life-1 warmup paid no traces — nothing compiled?")
+        if not store.entries():
+            return fail("life-1 warmup wrote nothing to the store")
+
+        # --- life 2: warm restart must be fully AOT-sourced ---------------
+        sched = QosScheduler(registry)
+        pool, traces = make_pool(sched)
+        for eng in pool.engines():
+            eng.warmup_blocking()
+        if traces():
+            return fail(f"warm restart paid {traces()} fresh traces; "
+                        f"f32/int8 artifacts did not round-trip")
+        bad = {}
+        for name, row in pool.describe().items():
+            report = getattr(pool.get(name), "warmup_report", {})
+            for bucket, r in report.items():
+                if (r.get("source") != "aot"
+                        or any(p.get("source") != "aot"
+                               for p in r.get("replicas", []))):
+                    bad[f"{name}:{bucket}"] = r.get("source")
+        if bad:
+            return fail(f"warm restart buckets not AOT-sourced: {bad}")
+        compiles_before = traces()
+
+        # --- mixed-tenant traffic on life 2 -------------------------------
+        eng = pool.default
+        x = np.random.RandomState(0).rand(size, size, 3).astype(np.float32)
+        bulk_done = 0
+        stop = asyncio.Event()
+
+        async def probe_round():
+            lats = []
+            for _ in range(PROBES):
+                t0 = time.perf_counter()
+                await eng.submit(x, tenant="vip")
+                lats.append(time.perf_counter() - t0)
+                await asyncio.sleep(PROBE_GAP_S)
+            return lats
+
+        async def batch_client():
+            nonlocal bulk_done
+            while not stop.is_set():
+                try:
+                    await eng.submit(x, tenant="bulk")
+                    bulk_done += 1
+                except ServeError:
+                    await asyncio.sleep(0.001)
+
+        async def drive():
+            for e in pool.engines():
+                await e.start()
+            try:
+                unloaded = await probe_round()
+                loaders = [asyncio.create_task(batch_client())
+                           for _ in range(BATCH_CLIENTS)]
+                await asyncio.sleep(0.05)  # let the backlog form
+                loaded = await probe_round()
+                stop.set()
+                await asyncio.gather(*loaders)
+                # multi-model residency: routed requests hit the int8 twin
+                q8_out = [await pool.get("q8").submit(x, tenant="vip")
+                          for _ in range(3)]
+                return unloaded, loaded, q8_out
+            finally:
+                for e in pool.engines():
+                    await e.stop()
+
+        unloaded, loaded, q8_out = asyncio.run(drive())
+        if not bulk_done:
+            return fail("batch tenant fully starved during saturation")
+        for out in q8_out:
+            if not np.all(np.isfinite(np.asarray(out))):
+                return fail("int8 twin returned non-finite output")
+        try:
+            pool.get("nope")
+        except RequestError:
+            pass
+        else:
+            return fail("unknown model name did not raise RequestError")
+
+        compile_delta = traces() - compiles_before
+        if compile_delta:
+            return fail(f"{compile_delta} fresh compile(s) after warmup")
+
+        p99_unloaded, p99_loaded = p99(unloaded), p99(loaded)
+        if p99_loaded > MAX_P99_RATIO * p99_unloaded:
+            return fail(f"interactive p99 under batch saturation "
+                        f"{p99_loaded * 1e3:.1f} ms > {MAX_P99_RATIO}x "
+                        f"unloaded {p99_unloaded * 1e3:.1f} ms")
+
+        snap = sched.snapshot()
+        if not snap["classes"]["batch"]["dispatched"]:
+            return fail("no batch-class dispatches recorded in snapshot")
+        if eng.metrics.count("model_q8_requests_total") < 3:
+            return fail("q8 routing not reflected in model counters")
+
+        print(json.dumps({
+            "metric": "qos_smoke", "value": 1.0,
+            "topology": plan.describe(),
+            "models": pool.names(),
+            "wfq_interactive_share": round(share, 3),
+            "unloaded_p99_ms": round(p99_unloaded * 1e3, 3),
+            "loaded_p99_ms": round(p99_loaded * 1e3, 3),
+            "batch_served_during_saturation": bulk_done,
+            "class_dispatched": {k: row["dispatched"]
+                                 for k, row in snap["classes"].items()},
+            "compile_count_delta": compile_delta,
+            "store_entries": len(store.entries()),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,14 @@ scratch, then again restarted against the AOT artifact store DIR populated
 in between (docs/aot.md) — and the paired result lands in the same ledger
 format, so the warm-start win shows up in the bench trajectory.
 
+``--tenants "vip=interactive:8,bulk=batch:24"`` switches to the mixed-
+tenant QoS workload (docs/qos.md): each spec entry runs N closed-loop
+clients under that tenant through a weighted-fair scheduled engine, and
+the ledger row records per-tenant p50/p99/rps plus **Jain's fairness
+index** over weight-normalized per-tenant throughput — 1.0 means every
+tenant got exactly its configured share; a FIFO queue under the same mix
+lets the batch herd starve the interactive tenant.
+
 ``--search`` switches to the retrieval workload (docs/retrieval.md): the
 same closed loop drives ``search_blocking`` over a synthetic index at each
 ``--corpus-sizes`` entry, recording QPS + client p50/p99 per corpus size.
@@ -39,7 +47,7 @@ import json
 import time
 
 
-def build_engine(args):
+def build_engine(args, qos=None):
     import jax
     import jax.numpy as jnp
     from flax import nnx
@@ -86,7 +94,7 @@ def build_engine(args):
         max_delay_ms=args.max_delay_ms,
         policy=AdmissionPolicy(max_queue=max(4 * args.clients, 64),
                                default_timeout_s=120.0),
-        trace_count=traces)
+        trace_count=traces, qos=qos)
     return engine, traces, size, on_tpu, name, plan
 
 
@@ -138,6 +146,128 @@ def drive_http(server, item, clients: int, per_client: int, latency) -> int:
 
     with concurrent.futures.ThreadPoolExecutor(max_workers=clients) as pool:
         return sum(pool.map(one_client, range(clients)))
+
+
+def parse_tenant_specs(spec: str) -> list[tuple[str, str, int]]:
+    """``"vip=interactive:8,bulk=batch:24"`` -> [(name, class, clients)]."""
+    out = []
+    for part in spec.split(","):
+        name, sep, rest = part.strip().partition("=")
+        klass, _, n = rest.partition(":")
+        if not sep or not name or not klass:
+            raise SystemExit(f"--tenants entry {part!r}: expected "
+                             "NAME=CLASS[:CLIENTS]")
+        out.append((name, klass, int(n) if n else 1))
+    return out
+
+
+def jain_index(xs: list[float]) -> float:
+    """Jain's fairness index: (sum x)^2 / (n * sum x^2); 1.0 = every
+    allocation equal, 1/n = one allocation got everything."""
+    if not xs or not any(xs):
+        return 0.0
+    return (sum(xs) ** 2) / (len(xs) * sum(x * x for x in xs))
+
+
+def bench_tenants(args) -> tuple[dict, str | None]:
+    """Mixed-tenant closed loop through a QoS-scheduled engine. Every
+    tenant's clients run concurrently on one loop; per-tenant latency and
+    throughput land in the row, and the headline value is Jain's index
+    over per-tenant throughput normalized by class weight (1.0 = the
+    weighted-fair queue delivered exactly the configured shares)."""
+    import asyncio
+
+    import jax
+    import numpy as np
+
+    from jimm_tpu.obs import Histogram
+    from jimm_tpu.serve import QosScheduler, ServeError
+    from jimm_tpu.serve.qos.policy import TenantRegistry
+
+    tenants = parse_tenant_specs(args.tenants)
+    registry = TenantRegistry.from_dict({
+        "tenants": {name: {"class": klass} for name, klass, _ in tenants}})
+    sched = QosScheduler(registry)
+    args.clients = sum(n for _, _, n in tenants)  # sizes the queue bound
+    engine, traces, size, on_tpu, name, plan = build_engine(args, qos=sched)
+    per_client = max(1, (args.requests or 16 * args.clients) // args.clients)
+    item = np.random.RandomState(0).rand(size, size, 3).astype(np.float32)
+
+    t_warm = time.monotonic()
+    engine.warmup_blocking()
+    warmup_s = time.monotonic() - t_warm
+    compiles_before = traces()
+
+    hists = {t: Histogram(f"tenant_{t}_latency_seconds",
+                          window=max(per_client * n, 1))
+             for t, _, n in tenants}
+    done = {t: 0 for t, _, _ in tenants}
+    errors = {t: 0 for t, _, _ in tenants}
+
+    async def one_client(tenant):
+        for _ in range(per_client):
+            t0 = time.perf_counter()
+            try:
+                await engine.submit(item, tenant=tenant)
+            except ServeError:
+                errors[tenant] += 1
+                continue
+            hists[tenant].observe(time.perf_counter() - t0)
+            done[tenant] += 1
+
+    async def go():
+        await engine.start()
+        try:
+            await asyncio.gather(*[one_client(t)
+                                   for t, _, n in tenants
+                                   for _ in range(n)])
+        finally:
+            await engine.stop()
+
+    t0 = time.monotonic()
+    asyncio.run(go())
+    dt = time.monotonic() - t0
+
+    weights = {t: registry.classes[k].weight for t, k, _ in tenants}
+    normalized = [done[t] / dt / weights[t] for t, _, _ in tenants]
+    fairness = round(jain_index(normalized), 4)
+    snap = sched.snapshot()
+    rec = {
+        "metric": ("serve_qos_fairness" if on_tpu
+                   else "serve_qos_fairness (cpu smoke)"),
+        "value": fairness,
+        "unit": "jain_index (weight-normalized)",
+        "workload": "qos",
+        "model": name + (":tiny" if (args.tiny or not on_tpu) else ""),
+        "clients": args.clients,
+        "requests": sum(done.values()),
+        "rps": round(sum(done.values()) / dt, 2),
+        "tenants": {t: {"class": k, "clients": n,
+                        "requests": done[t], "errors": errors[t],
+                        "rps": round(done[t] / dt, 2),
+                        "p50_ms": round(hists[t].percentile(50) * 1e3, 3),
+                        "p99_ms": round(hists[t].percentile(99) * 1e3, 3)}
+                    for t, k, n in tenants},
+        "class_dispatched": {k: row["dispatched"]
+                             for k, row in snap["classes"].items()},
+        "shed_requests": sum(row["shed"]
+                             for row in snap["tenants"].values()),
+        "buckets": list(engine.buckets.sizes),
+        "dtype": engine.buckets.dtype,
+        "warmup_s": round(warmup_s, 3),
+        "compile_count_delta": traces() - compiles_before,
+        "n_devices": jax.device_count(),
+        "replicas": plan.replicas,
+        "model_parallel": plan.model_parallel,
+    }
+    error = None
+    if rec["compile_count_delta"]:
+        error = (f"{rec['compile_count_delta']} recompile(s) after warmup "
+                 f"— bucket table does not cover the traffic")
+    elif not all(done.values()):
+        starved = [t for t, n in done.items() if not n]
+        error = f"tenant(s) fully starved: {starved}"
+    return rec, error
 
 
 def bench_cold_start(args) -> dict:
@@ -342,6 +472,13 @@ def main() -> int:
                         "submesh and executor thread)")
     p.add_argument("--model-parallel", type=int, default=1,
                    help="devices per replica the model is sharded over")
+    p.add_argument("--tenants", default=None,
+                   metavar="NAME=CLASS:N,...",
+                   help='mixed-tenant QoS workload, e.g. '
+                        '"vip=interactive:8,bulk=batch:24": run N closed-'
+                        "loop clients per tenant through a weighted-fair "
+                        "scheduled engine and record per-tenant p50/p99 + "
+                        "Jain's fairness index (docs/qos.md)")
     p.add_argument("--http", action="store_true",
                    help="measure through the full HTTP stack instead of "
                         "the in-process engine")
@@ -366,6 +503,20 @@ def main() -> int:
                    help="corpus block size for --search (default: the "
                         "tuner's best_config)")
     args = p.parse_args()
+
+    if args.tenants:
+        rec, error = bench_tenants(args)
+        print(json.dumps(rec), flush=True)
+        if args.record:
+            from scripts._measurements import MEASUREMENTS
+            full = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "phase": "serve_bench", **rec}
+            with open(MEASUREMENTS, "a") as f:
+                f.write(json.dumps(full) + "\n")
+        if error:
+            print(json.dumps({"error": error}), flush=True)
+            return 1
+        return 0
 
     if args.search:
         recs, error = bench_search(args)

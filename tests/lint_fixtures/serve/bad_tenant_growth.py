@@ -1,0 +1,60 @@
+"""JL014 fixture: request-keyed serving tables with no eviction."""
+
+from collections import OrderedDict
+
+
+class TenantTracker:
+    def __init__(self):
+        self.per_tenant = {}
+        self.latencies = {}
+
+    def on_request(self, tenant_id):
+        self.per_tenant[tenant_id] = (                # JL014: grows per name
+            self.per_tenant.get(tenant_id, 0) + 1)
+
+    def on_latency(self, tenant_id, seconds):
+        bucket = self.latencies.setdefault(tenant_id, [])  # JL014: same hole
+        bucket.append(seconds)
+
+
+class ModelRouter:
+    # ok: writes are param-keyed but remove() is the eviction path, so the
+    # operator (not traffic) bounds the table
+    def __init__(self):
+        self.engines = {}
+
+    def add(self, name, engine):
+        self.engines[name] = engine
+
+    def remove(self, name):
+        return self.engines.pop(name)
+
+
+class WarmupLedger:
+    # ok: keyed by the engine's own bucket sizes (a loop over config), not
+    # by anything a caller passed in
+    def __init__(self, sizes):
+        self.report = {}
+        for size in sizes:
+            self.report[size] = "pending"
+
+
+class ResponseCache:
+    # ok: bounded LRU — the popitem eviction keeps every insert legal
+    def __init__(self, capacity=128):
+        self.capacity = capacity
+        self.entries = OrderedDict()
+
+    def put(self, key, value):
+        self.entries[key] = value
+        while len(self.entries) > self.capacity:
+            self.entries.popitem(last=False)
+
+
+class StatsSink:
+    # ok: broad but justified — series names are code-defined constants
+    def __init__(self):
+        self.series = {}
+
+    def record(self, name, value):
+        self.series[name] = value  # jaxlint: disable=JL014 — code-defined metric names

@@ -297,6 +297,42 @@ class TestRuleFixtures:
         assert check_swallowed_exception(
             tree, "jimm_tpu/serve/test_helpers.py") == []
 
+    def test_jl014_unbounded_tenant_table(self):
+        findings = findings_for("serve/bad_tenant_growth.py")
+        assert rules_and_lines(findings) == {
+            ("JL014", 12),  # self.per_tenant[tenant_id] = ..., no eviction
+            ("JL014", 16),  # .setdefault(tenant_id, ...), same hole
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert any("adversary" in f.message for f in findings)
+        # the evicting router, the config-keyed ledger, the bounded LRU,
+        # and the justified suppression (lines 20-59) stay clean
+
+    def test_jl014_scoped_to_serve_library_paths(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import (_annotate_parents,
+                                             check_unbounded_tenant_table)
+        src = ("class T:\n"
+               "    def on_request(self, tenant):\n"
+               "        self.seen[tenant] = 1\n")
+        tree = ast.parse(src)
+        _annotate_parents(tree)
+        assert check_unbounded_tenant_table(
+            tree, "jimm_tpu/serve/qos/scheduler.py") != []
+        assert check_unbounded_tenant_table(
+            tree, "jimm_tpu/serve/server.py") != []
+        # non-serving code tracks what it likes, and tests build ad-hoc
+        # tables on purpose
+        assert check_unbounded_tenant_table(
+            tree, "jimm_tpu/train/loop.py") == []
+        assert check_unbounded_tenant_table(
+            tree, "jimm_tpu/obs/registry.py") == []
+        assert check_unbounded_tenant_table(
+            tree, "tests/test_serve.py") == []
+        assert check_unbounded_tenant_table(
+            tree, "jimm_tpu/serve/test_helpers.py") == []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings

@@ -1,0 +1,689 @@
+"""jimm_tpu.serve.qos: policy, scheduler, WFQ, pool, and the tenant wire.
+
+Property-style coverage of the three QoS guarantees:
+
+- **weighted fairness**: under saturation the deficit-round-robin dequeue
+  shares converge to the configured class weights;
+- **class-ordered shedding**: a queued request is only ever evicted in
+  favor of a strictly higher class, and only while every class below the
+  victim's is empty;
+- **byte-compatibility**: with no policy configured the engine uses a
+  plain ``asyncio.Queue``, healthz carries no ``qos``/``models`` blocks,
+  and the submit path is the pre-QoS one.
+"""
+
+import asyncio
+import json
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from jimm_tpu.serve import (AdmissionPolicy, BucketTable, InferenceEngine,
+                            ModelPool, QosPolicyError, QosScheduler,
+                            QueueFullError, RequestError, ServeClient,
+                            ServeMetrics, ServingServer, ShedClientError,
+                            ShedError, ThrottledClientError, ThrottledError,
+                            WeightedFairQueue)
+from jimm_tpu.serve.qos.policy import (DEFAULT_CLASSES, TenantRegistry,
+                                       load_policy)
+from jimm_tpu.serve.qos.scheduler import TokenBucket
+
+POLICY = {
+    "classes": {"interactive": {"weight": 8}, "batch": {"weight": 2},
+                "background": {"weight": 1}},
+    "tenants": {
+        "vip": {"class": "interactive", "rate": 100, "burst": 200},
+        "bulk": {"class": "batch"},
+        "crawler": {"class": "background", "max_queued": 2},
+    },
+    "default": {"class": "batch"},
+}
+
+
+def _registry(data=None):
+    return TenantRegistry.from_dict(data if data is not None else POLICY)
+
+
+class _Item:
+    """Queue stub carrying the two attributes the WFQ reads."""
+
+    def __init__(self, klass, tag=0):
+        self.klass = klass
+        self.tag = tag
+        self.tenant = None
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+class TestPolicy:
+    def test_parse_and_priority_order(self):
+        reg = _registry()
+        assert reg.class_order == ("interactive", "batch", "background")
+        assert reg.classes["interactive"].weight == 8.0
+        assert reg.rank_of("interactive") == 0
+        assert reg.rank_of("background") == 2
+        assert reg.tenants["vip"].rate == 100.0
+        assert reg.tenants["crawler"].max_queued == 2
+        assert reg.default.klass == "batch"
+
+    def test_missing_sections_get_defaults(self):
+        reg = _registry({})
+        assert reg.class_order == tuple(n for n, _ in DEFAULT_CLASSES)
+        assert reg.tenants == {}
+        # the built-in default tenant rides the highest class, unlimited
+        assert reg.default.klass == "interactive"
+        assert reg.default.rate is None
+
+    def test_unknown_and_anonymous_resolve_to_default(self):
+        reg = _registry()
+        assert reg.resolve_spec(None) is reg.default
+        assert reg.resolve_spec("never-heard-of-you") is reg.default
+        assert reg.resolve_spec("vip").klass == "interactive"
+
+    def test_all_problems_reported_at_once(self):
+        bad = {"classes": {"a": {"weight": -1}},
+               "tenants": {"t1": {"class": "nope", "rate": 0},
+                           "t2": {"burst": 0.5, "frobnicate": 1}},
+               "surprise": {}}
+        with pytest.raises(QosPolicyError) as err:
+            _registry(bad)
+        problems = str(err.value).split("; ")
+        assert len(problems) >= 5
+        assert any("weight" in p for p in problems)
+        assert any("unknown class" in p for p in problems)
+        assert any("rate" in p for p in problems)
+        assert any("burst" in p for p in problems)
+        assert any("frobnicate" in str(p) for p in problems)
+
+    def test_load_json_file(self, tmp_path):
+        path = tmp_path / "policy.json"
+        path.write_text(json.dumps(POLICY))
+        reg = load_policy(str(path))
+        assert sorted(reg.tenants) == ["bulk", "crawler", "vip"]
+
+    def test_load_errors_are_typed(self, tmp_path):
+        with pytest.raises(QosPolicyError):
+            load_policy(str(tmp_path / "missing.json"))
+        bad = tmp_path / "bad.json"
+        bad.write_text("{nope")
+        with pytest.raises(QosPolicyError):
+            load_policy(str(bad))
+
+    def test_describe_is_json_shaped(self):
+        desc = _registry().describe()
+        assert [c["name"] for c in desc["classes"]] == [
+            "interactive", "batch", "background"]
+        assert json.loads(json.dumps(desc)) == desc
+
+
+# ---------------------------------------------------------------------------
+# token bucket + scheduler admission
+# ---------------------------------------------------------------------------
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        bucket = TokenBucket(rate=10.0, burst=2.0, now=0.0)
+        assert bucket.try_take(0.0) == 0.0
+        assert bucket.try_take(0.0) == 0.0
+        wait = bucket.try_take(0.0)
+        assert wait == pytest.approx(0.1)
+        # after the hinted wait a token exists again
+        assert bucket.try_take(wait) == 0.0
+
+    def test_refill_caps_at_burst(self):
+        bucket = TokenBucket(rate=100.0, burst=3.0, now=0.0)
+        bucket.try_take(1000.0)
+        assert bucket.tokens == pytest.approx(2.0)
+
+
+class TestScheduler:
+    def _scheduler(self, t0=0.0):
+        clock = {"now": t0}
+        sched = QosScheduler(_registry(), clock=lambda: clock["now"])
+        return sched, clock
+
+    def test_rate_limit_throttles_with_hint(self):
+        sched, clock = self._scheduler()
+        reg = _registry({"tenants": {"slow": {"rate": 2, "burst": 1}}})
+        sched = QosScheduler(reg, clock=lambda: clock["now"])
+        state = sched.resolve("slow")
+        sched.admit(state)
+        with pytest.raises(ThrottledError) as err:
+            sched.admit(state)
+        assert err.value.http_status == 429
+        assert err.value.retry_after_s == pytest.approx(0.5)
+        clock["now"] += 0.5
+        sched.admit(state)  # the hint was sufficient, not just polite
+
+    def test_max_queued_quota(self):
+        sched, _ = self._scheduler()
+        state = sched.resolve("crawler")
+        sched.admit(state)
+        sched.on_enqueue(state)
+        sched.admit(state)
+        sched.on_enqueue(state)
+        with pytest.raises(ThrottledError):
+            sched.admit(state)
+
+    def test_timeout_inheritance(self):
+        reg = _registry({"tenants": {"t": {"timeout_s": 0.25}}})
+        sched = QosScheduler(reg)
+        state = sched.resolve("t")
+        assert sched.timeout_for(state, None) == 0.25
+        assert sched.timeout_for(state, 1.5) == 1.5  # explicit wins
+        assert sched.timeout_for(sched.resolve(None), None) is None
+
+    def test_tenant_cardinality_is_bounded_by_policy(self):
+        # the JL014 discipline at runtime: traffic cannot grow the table
+        sched, _ = self._scheduler()
+        before = len(sched._states)
+        default = sched.resolve(None)
+        for i in range(100):
+            assert sched.resolve(f"invented-{i}") is default
+        assert len(sched._states) == before
+
+    def test_metrics_precreated_and_snapshot_shape(self):
+        sched, _ = self._scheduler()
+        metrics = ServeMetrics()
+        sched.bind_metrics(metrics)
+        snap = metrics.snapshot()
+        assert snap["tenant_vip_requests_total"] == 0
+        assert snap["class_background_shed_total"] == 0
+        qos = sched.snapshot()
+        assert sorted(qos["tenants"]) == ["bulk", "crawler", "default",
+                                          "vip"]
+        assert qos["classes"]["interactive"]["weight"] == 8.0
+        assert json.loads(json.dumps(qos)) == qos
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair queue
+# ---------------------------------------------------------------------------
+
+class TestWeightedFairQueue:
+    def _wfq(self):
+        return WeightedFairQueue(QosScheduler(_registry()))
+
+    def test_saturated_shares_converge_to_weights(self):
+        q = self._wfq()
+        for i in range(400):
+            for klass in ("background", "batch", "interactive"):
+                q.put_nowait(_Item(klass, i))
+        served = {"interactive": 0, "batch": 0, "background": 0}
+        for _ in range(440):  # every class stays saturated throughout
+            served[q.get_nowait().klass] += 1
+        total = sum(served.values())
+        for klass, weight in (("interactive", 8), ("batch", 2),
+                              ("background", 1)):
+            share = served[klass] / total
+            assert share == pytest.approx(weight / 11, rel=0.10), served
+
+    def test_fifo_within_class_and_idle_classes_cost_nothing(self):
+        q = self._wfq()
+        for i in range(5):
+            q.put_nowait(_Item("batch", i))
+        # no interactive/background traffic: batch drains back-to-back
+        assert [q.get_nowait().tag for t in range(5)] == [0, 1, 2, 3, 4]
+        with pytest.raises(asyncio.QueueEmpty):
+            q.get_nowait()
+
+    def test_control_lane_served_after_work_drains(self):
+        q = self._wfq()
+        stop = object()  # the engine's _STOP sentinel has no klass attr
+        q.put_nowait(_Item("batch", 1))
+        q.put_nowait(stop)
+        q.put_nowait(_Item("interactive", 2))
+        assert q.qsize() == 2  # control items are not queued work
+        # both queued requests drain BEFORE the sentinel (stop-then-drain
+        # would drop in-flight work on shutdown)
+        assert {q.get_nowait().tag, q.get_nowait().tag} == {1, 2}
+        assert q.get_nowait() is stop
+
+    def test_async_get_wakes_on_put(self):
+        async def go():
+            q = self._wfq()
+            getter = asyncio.create_task(q.get())
+            await asyncio.sleep(0.01)
+            assert not getter.done()
+            q.put_nowait(_Item("interactive", 7))
+            return (await getter).tag
+
+        assert asyncio.run(go()) == 7
+
+    def test_shed_only_strictly_lower_class(self):
+        q = self._wfq()
+        q.put_nowait(_Item("interactive", 0))
+        q.put_nowait(_Item("batch", 1))
+        q.put_nowait(_Item("batch", 2))
+        q.put_nowait(_Item("background", 3))
+        # interactive arrival: background is the lowest non-empty victim
+        victim = q.shed_lower(0)
+        assert victim.klass == "background"
+        # background now empty -> batch gives back its NEWEST
+        victim = q.shed_lower(0)
+        assert (victim.klass, victim.tag) == ("batch", 2)
+        # batch arrival cannot touch batch or interactive
+        assert q.shed_lower(1) is None
+        # background arrival (lowest class) can never shed anyone
+        assert q.shed_lower(2) is None
+        q.get_nowait()
+        q.get_nowait()
+        # queue holds nothing below interactive -> its arrivals get None
+        assert q.shed_lower(0) is None
+
+    def test_shed_never_violates_priority_under_churn(self):
+        q = self._wfq()
+        rank = {"interactive": 0, "batch": 1, "background": 2}
+        pattern = ["batch", "background", "interactive", "batch",
+                   "background", "batch", "interactive", "background"]
+        for i, klass in enumerate(pattern * 5):
+            q.put_nowait(_Item(klass, i))
+        queued = {k: sum(1 for n in pattern * 5 if n == k) for k in rank}
+        while True:
+            victim = q.shed_lower(0)
+            if victim is None:
+                break
+            # the victim is the lowest non-empty class below interactive
+            assert rank[victim.klass] > 0
+            lower = [k for k in rank if rank[k] > rank[victim.klass]]
+            assert all(queued[k] == 0 for k in lower), victim.klass
+            queued[victim.klass] -= 1
+        assert queued["batch"] == queued["background"] == 0
+        assert queued["interactive"] == 10  # never touched
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+def _qos_engine(fwd=None, *, max_queue=256, registry=None, **kw):
+    sched = QosScheduler(registry or _registry())
+    kw.setdefault("buckets", BucketTable((1, 2, 4)))
+    kw.setdefault("max_delay_ms", 1.0)
+    engine = InferenceEngine(
+        fwd or (lambda batch: batch * 2.0), item_shape=(3,),
+        policy=AdmissionPolicy(max_queue=max_queue, default_timeout_s=5.0),
+        qos=sched, **kw)
+    return engine, sched
+
+
+class TestEngineQos:
+    def test_tenant_requests_roundtrip_and_count(self):
+        async def go():
+            engine, sched = _qos_engine()
+            await engine.start()
+            out = await engine.submit(np.full(3, 2.0, np.float32),
+                                      tenant="vip")
+            await engine.stop()
+            return out, sched
+
+        out, sched = asyncio.run(go())
+        assert np.allclose(out, 4.0)
+        snap = sched.snapshot()
+        assert snap["tenants"]["vip"]["requests"] == 1
+        assert snap["classes"]["interactive"]["dispatched"] == 1
+
+    def test_rate_limited_tenant_throttled(self):
+        async def go():
+            reg = _registry({"tenants": {"slow": {"rate": 0.1, "burst": 1}}})
+            engine, _ = _qos_engine(registry=reg)
+            await engine.start()
+            item = np.zeros(3, np.float32)
+            await engine.submit(item, tenant="slow")
+            try:
+                with pytest.raises(ThrottledError) as err:
+                    await engine.submit(item, tenant="slow")
+                return err.value
+            finally:
+                await engine.stop()
+
+        err = asyncio.run(go())
+        assert err.retry_after_s and err.retry_after_s > 1.0
+
+    def test_tenant_deadline_inherited(self):
+        def slow(batch):
+            time.sleep(0.3)
+            return batch
+
+        async def go():
+            from jimm_tpu.serve import DeadlineExceededError
+            reg = _registry({"tenants": {"t": {"timeout_s": 0.05}}})
+            engine, _ = _qos_engine(slow, registry=reg)
+            await engine.start()
+            try:
+                with pytest.raises(DeadlineExceededError):
+                    await engine.submit(np.zeros(3, np.float32), tenant="t")
+            finally:
+                await engine.stop()
+
+        asyncio.run(go())
+
+    def test_overload_sheds_lower_class_for_higher(self):
+        def slow(batch):
+            time.sleep(0.25)
+            return batch * 2.0
+
+        async def go():
+            engine, sched = _qos_engine(slow, max_queue=3,
+                                        buckets=BucketTable((1,)))
+            await engine.start()
+            item = np.zeros(3, np.float32)
+            filler = asyncio.create_task(
+                engine.submit(item, tenant="bulk"))
+            await asyncio.sleep(0.1)  # batcher takes it into the slow lane
+            bulk = [asyncio.create_task(engine.submit(item, tenant="bulk"))
+                    for _ in range(3)]
+            await asyncio.sleep(0)  # run each submit's sync admission part
+            # queue is at max_queue: a BATCH arrival has no lower class to
+            # shed, so it takes the plain queue-full rejection
+            with pytest.raises(QueueFullError):
+                await engine.submit(item, tenant="bulk")
+            # an INTERACTIVE arrival evicts the newest bulk request instead
+            vip = await engine.submit(item, tenant="vip")
+            results = await asyncio.gather(filler, *bulk,
+                                           return_exceptions=True)
+            await engine.stop()
+            return vip, results, sched
+
+        vip, results, sched = asyncio.run(go())
+        assert np.allclose(vip, 0.0)
+        shed = [r for r in results if isinstance(r, ShedError)]
+        served = [r for r in results if not isinstance(r, Exception)]
+        assert len(shed) == 1
+        assert shed[0].retry_after_s is not None
+        assert len(served) == 3
+        snap = sched.snapshot()
+        assert snap["tenants"]["bulk"]["shed"] == 1
+        assert snap["classes"]["batch"]["shed"] == 1
+
+    def test_no_policy_path_is_plain_queue(self):
+        async def go():
+            engine = InferenceEngine(lambda b: b, item_shape=(3,),
+                                     buckets=BucketTable((1, 2)))
+            await engine.start()
+            kind = type(engine._queue)
+            # tenant= is accepted and ignored without a scheduler
+            out = await engine.submit(np.zeros(3, np.float32),
+                                      tenant="whoever")
+            await engine.stop()
+            return kind, out, engine
+
+        kind, out, engine = asyncio.run(go())
+        assert kind is asyncio.Queue
+        assert engine.qos is None
+        snap = engine.metrics.snapshot()
+        assert not any(k.startswith(("tenant_", "class_")) for k in snap)
+
+
+# ---------------------------------------------------------------------------
+# model pool
+# ---------------------------------------------------------------------------
+
+def _pool_engine(scale, metrics, qos=None):
+    return InferenceEngine(lambda b, s=scale: b * s, item_shape=(3,),
+                           buckets=BucketTable((1, 2, 4)), max_delay_ms=1.0,
+                           metrics=metrics, qos=qos)
+
+
+class TestModelPool:
+    def test_routing_and_unknown_model(self):
+        metrics = ServeMetrics()
+        a, b = _pool_engine(2.0, metrics), _pool_engine(3.0, metrics)
+        pool = ModelPool({"default": a, "beta": b}, default="default")
+        assert pool.get(None) is a
+        assert pool.get("beta") is b
+        with pytest.raises(RequestError):
+            pool.get("gamma")
+        assert metrics.count("model_beta_requests_total") == 1
+
+    def test_add_swap_remove(self):
+        metrics = ServeMetrics()
+        a, b, c = (_pool_engine(s, metrics) for s in (1.0, 2.0, 3.0))
+        pool = ModelPool({"default": a}, default="default")
+        pool.add("canary", b)
+        with pytest.raises(ValueError):
+            pool.add("canary", c)  # already resident: swap, don't add
+        old = pool.swap("canary", c)
+        assert old is b
+        assert pool.get("canary") is c
+        assert pool.remove("canary") is c
+        with pytest.raises(ValueError):
+            pool.remove("default")  # the default model is not evictable
+        assert pool.names() == ["default"]
+
+    def test_describe_shape(self):
+        metrics = ServeMetrics()
+        pool = ModelPool({"default": _pool_engine(1.0, metrics)},
+                         default="default")
+        desc = pool.describe()
+        assert desc["default"]["default"] is True
+        assert desc["default"]["buckets"] == [1, 2, 4]
+
+
+# ---------------------------------------------------------------------------
+# HTTP end to end: tenant headers, model routing, typed errors, healthz
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def qos_server():
+    registry = _registry({
+        "classes": POLICY["classes"],
+        "tenants": dict(POLICY["tenants"],
+                        slow={"class": "batch", "rate": 0.1, "burst": 1}),
+        "default": {"class": "batch"},
+    })
+    sched = QosScheduler(registry)
+    metrics = ServeMetrics()
+    default = _pool_engine(2.0, metrics, qos=sched)
+    beta = _pool_engine(3.0, metrics, qos=sched)
+    pool = ModelPool({"default": default, "beta": beta}, default="default")
+    server = ServingServer(default, pool=pool, port=0)
+    server.start()
+    try:
+        yield server
+    finally:
+        server.stop()
+
+
+class TestHttpQos:
+    def _item(self):
+        return np.full(3, 1.0, np.float32)
+
+    def test_model_routing_via_header(self, qos_server):
+        base = ServeClient(port=qos_server.port, tenant="vip")
+        beta = ServeClient(port=qos_server.port, tenant="vip", model="beta")
+        assert np.allclose(base.embed(self._item(), timeout_s=5), 2.0)
+        assert np.allclose(beta.embed(self._item(), timeout_s=5), 3.0)
+        from jimm_tpu.serve import ServeClientError
+        bad = ServeClient(port=qos_server.port, model="gamma")
+        with pytest.raises(ServeClientError) as err:
+            bad.embed(self._item(), timeout_s=5)
+        assert err.value.status == 400
+        assert "gamma" in str(err.value)
+
+    def test_throttled_is_typed_with_retry_after(self, qos_server):
+        client = ServeClient(port=qos_server.port, tenant="slow")
+        client.embed(self._item(), timeout_s=5)
+        with pytest.raises(ThrottledClientError) as err:
+            client.embed(self._item(), timeout_s=5)
+        assert err.value.status == 429
+        assert err.value.code == "throttled"
+        assert err.value.retry_after_s and err.value.retry_after_s > 1.0
+
+    def test_healthz_has_qos_and_models_blocks(self, qos_server):
+        health = ServeClient(port=qos_server.port).healthz()
+        assert "vip" in health["qos"]["tenants"]
+        assert health["qos"]["classes"]["interactive"]["weight"] == 8.0
+        assert sorted(health["models"]) == ["beta", "default"]
+        assert health["models"]["default"]["default"] is True
+
+    def test_metrics_expose_tenant_and_class_series(self, qos_server):
+        client = ServeClient(port=qos_server.port, tenant="vip")
+        client.embed(self._item(), timeout_s=5)
+        text = client.metrics_text()
+        assert "jimm_serve_tenant_vip_requests_total" in text
+        assert "jimm_serve_class_interactive_requests_total" in text
+        assert "jimm_serve_model_beta_requests_total" in text
+
+    def test_policy_free_server_healthz_unchanged(self):
+        engine = _pool_engine(2.0, ServeMetrics())
+        server = ServingServer(engine, port=0)
+        server.start()
+        try:
+            health = ServeClient(port=server.port).healthz()
+        finally:
+            server.stop()
+        assert "qos" not in health
+        assert "models" not in health
+
+
+# ---------------------------------------------------------------------------
+# client retry behavior against a stub server
+# ---------------------------------------------------------------------------
+
+class _StubHandler(BaseHTTPRequestHandler):
+    script: list = []  # [(status, body_dict, retry_after or None), ...]
+    seen: list = []
+
+    def log_message(self, fmt, *args):  # noqa: A003 — quiet test output
+        pass
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        type(self).seen.append(dict(self.headers))
+        status, obj, retry_after = (self.script.pop(0) if self.script
+                                    else (200, {"features": [[1.0]]}, None))
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if retry_after is not None:
+            self.send_header("Retry-After", f"{retry_after:.3f}")
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def stub_server():
+    _StubHandler.script = []
+    _StubHandler.seen = []
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StubHandler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield httpd
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+class TestClientRetry:
+    def test_tenant_and_model_headers_sent(self, stub_server):
+        client = ServeClient(port=stub_server.server_port, tenant="alice",
+                             model="beta")
+        client.embed(np.zeros(3, np.float32))
+        headers = _StubHandler.seen[0]
+        assert headers["X-Jimm-Tenant"] == "alice"
+        assert headers["X-Jimm-Model"] == "beta"
+
+    def test_throttled_not_retried_by_default(self, stub_server):
+        _StubHandler.script = [
+            (429, {"error": "throttled", "message": "slow down"}, 0.123)]
+        client = ServeClient(port=stub_server.server_port)
+        with pytest.raises(ThrottledClientError) as err:
+            client.embed(np.zeros(3, np.float32))
+        assert err.value.retry_after_s == pytest.approx(0.123)
+
+    def test_retry_throttled_honors_retry_after(self, stub_server):
+        _StubHandler.script = [
+            (429, {"error": "throttled", "message": "slow down"}, 0.123)]
+        client = ServeClient(port=stub_server.server_port, retry_throttled=2,
+                             backoff_base_s=0.001, backoff_seed=7)
+        slept = []
+        client._sleep = slept.append
+        out = client.embed(np.zeros(3, np.float32))
+        assert out == [[1.0]]
+        assert len(_StubHandler.seen) == 2
+        assert slept and slept[0] >= 0.123  # at least the server's hint
+
+    def test_shed_is_typed_and_retryable(self, stub_server):
+        _StubHandler.script = [
+            (503, {"error": "shed", "message": "sacrificed"}, 0.05),
+            (503, {"error": "shed", "message": "sacrificed"}, 0.05)]
+        client = ServeClient(port=stub_server.server_port, retry_throttled=1,
+                             backoff_base_s=0.001, backoff_seed=7)
+        client._sleep = lambda s: None
+        with pytest.raises(ShedClientError) as err:
+            client.embed(np.zeros(3, np.float32))
+        assert err.value.status == 503
+        assert err.value.code == "shed"
+        assert len(_StubHandler.seen) == 2  # one retry, then surfaced
+
+    def test_retry_budget_bounded_by_deadline(self, stub_server):
+        _StubHandler.script = [
+            (429, {"error": "throttled", "message": "later"}, 30.0)]
+        client = ServeClient(port=stub_server.server_port, retry_throttled=5)
+        client._sleep = lambda s: pytest.fail("slept past the deadline")
+        with pytest.raises(ThrottledClientError):
+            client.embed(np.zeros(3, np.float32), timeout_s=0.2)
+
+    def test_queue_full_stays_untyped_503(self, stub_server):
+        from jimm_tpu.serve import ServeClientError
+        _StubHandler.script = [
+            (503, {"error": "queue_full", "message": "full"}, None)]
+        client = ServeClient(port=stub_server.server_port)
+        with pytest.raises(ServeClientError) as err:
+            client.embed(np.zeros(3, np.float32))
+        assert not isinstance(err.value, (ThrottledClientError,
+                                          ShedClientError))
+        assert err.value.code == "queue_full"
+
+
+# ---------------------------------------------------------------------------
+# CLI + import hygiene
+# ---------------------------------------------------------------------------
+
+class TestQosCli:
+    def test_validate_ok(self, tmp_path, capsys):
+        from jimm_tpu.serve.qos.cli import main
+        path = tmp_path / "policy.json"
+        path.write_text(json.dumps(POLICY))
+        assert main(["qos", "validate", str(path)]) == 0
+        assert "OK" in capsys.readouterr().out
+
+    def test_validate_lists_every_problem(self, tmp_path, capsys):
+        from jimm_tpu.serve.qos.cli import main
+        path = tmp_path / "bad.json"
+        path.write_text(json.dumps(
+            {"tenants": {"t": {"class": "nope", "rate": -1}}}))
+        assert main(["qos", "validate", str(path)]) == 1
+        out = capsys.readouterr().out
+        assert "INVALID" in out
+        assert "unknown class" in out
+        assert "rate" in out
+
+    def test_ls_json(self, tmp_path, capsys):
+        from jimm_tpu.serve.qos.cli import main
+        path = tmp_path / "policy.json"
+        path.write_text(json.dumps(POLICY))
+        assert main(["qos", "ls", str(path), "--json"]) == 0
+        desc = json.loads(capsys.readouterr().out)
+        assert [t["name"] for t in desc["tenants"]] == ["bulk", "crawler",
+                                                        "vip"]
+
+    def test_qos_package_imports_without_jax(self):
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import sys\n"
+             "import jimm_tpu.serve.qos.cli\n"
+             "import jimm_tpu.serve.qos.policy\n"
+             "assert 'jax' not in sys.modules, 'qos CLI dragged in jax'"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
