@@ -200,6 +200,11 @@ def emit_error(model: str, msg: str, detail: str = "") -> None:
         "n_devices": 1,
         "replicas": 1,
         "model_parallel": 1,
+        # no measurement happened at all: stamped like the CPU-smoke rows
+        # so window_report/MEASUREMENTS consumers can never mistake this
+        # for a TPU datapoint (the BENCH_r01-r05 misread)
+        "backend": "none",
+        "fallback": True,
     }), flush=True)
 
 
@@ -323,6 +328,8 @@ def parent_main(args: argparse.Namespace) -> int:
             rec.pop("mfu", None)       # CPU mfu is meaningless vs TPU peak
             rec.pop("mfu_crosscheck", None)
             rec["vs_baseline"] = 0.0   # fallback never scores vs the bar
+            rec["fallback"] = True     # even a row from an older child
+            rec.setdefault("backend", "cpu")
             rec["error"] = ("TPU benchmark did not complete; value is a "
                             "CPU-smoke fallback proving the measurement "
                             "path, not the metric of record")
@@ -533,6 +540,11 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
         "metric": metric,
         "value": value,
         "unit": unit,
+        # provenance stamp: which backend actually measured this row, and
+        # whether it is a fallback (NOT the metric of record). TPU rows are
+        # the only ones that score vs the baseline.
+        "backend": jax.default_backend(),
+        "fallback": not on_tpu,
         "vs_baseline": round(achieved_mfu / 0.50, 4),
         "mfu": round(achieved_mfu, 4),
         "images_per_sec": round(images_per_sec, 2),

@@ -64,8 +64,14 @@ def _configure_backend(args: argparse.Namespace) -> None:
         initialize_distributed()
 
 
-def _parse_mesh(spec: str | None):
-    """``"data=4,model=2"`` -> Mesh (None -> no mesh: replicated 1-device)."""
+def _parse_mesh(spec: str | None, max_devices: int | None = None):
+    """``"data=4,model=2"`` -> Mesh (None -> no mesh: replicated 1-device).
+
+    ``max_devices`` restricts the mesh to the first N visible devices —
+    the elastic-restart path: a shrunk attempt plans its mesh over the
+    surviving subset while the process still sees the full virtual device
+    list (``make_mesh`` requires the axis product to equal the device
+    count, so the subset must be explicit)."""
     if not spec:
         return None
     from jimm_tpu.parallel import make_mesh
@@ -73,7 +79,15 @@ def _parse_mesh(spec: str | None):
     for part in spec.split(","):
         name, _, size = part.partition("=")
         axes[name.strip()] = int(size)
-    return make_mesh(axes)
+    devices = None
+    if max_devices is not None:
+        import jax
+        visible = jax.devices()
+        if not 1 <= max_devices <= len(visible):
+            raise SystemExit(f"--max-devices {max_devices} out of range "
+                             f"(1..{len(visible)} visible)")
+        devices = visible[:max_devices]
+    return make_mesh(axes, devices=devices)
 
 
 def _family(preset_name: str) -> str:
@@ -357,7 +371,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         rt["ln_impl"] = args.ln_impl
     if args.fused_qkv:
         rt["fused_qkv"] = True
-    mesh = _parse_mesh(args.mesh)
+    mesh = _parse_mesh(args.mesh, max_devices=args.max_devices)
     pp_extra = {}
     if args.pipeline_virtual > 1:
         if args.rules != "pp":
@@ -494,7 +508,10 @@ def cmd_train(args: argparse.Namespace) -> int:
     if args.preemption_save and not args.ckpt_dir:
         raise SystemExit("--preemption-save needs --ckpt-dir")
 
-    ckpt = CheckpointManager(args.ckpt_dir, save_interval_steps=args.save_every) \
+    # mesh= records the topology each save was sharded over and counts a
+    # topology change when a restore crosses mesh shapes (elastic restarts)
+    ckpt = CheckpointManager(args.ckpt_dir, save_interval_steps=args.save_every,
+                             mesh=mesh) \
         if args.ckpt_dir else None
     start_step = 0
     if ckpt is not None and args.resume:
@@ -736,6 +753,17 @@ def cmd_train(args: argparse.Namespace) -> int:
     return 0
 
 
+def _argv_flag_value(argv: list[str], flag: str, default):
+    """Last occurrence wins, mirroring argparse."""
+    value = default
+    for i, tok in enumerate(argv):
+        if tok == flag and i + 1 < len(argv):
+            value = argv[i + 1]
+        elif tok.startswith(flag + "="):
+            value = tok.split("=", 1)[1]
+    return value
+
+
 def cmd_supervise(args: argparse.Namespace) -> int:
     """Run ``train`` as restartable attempts.
 
@@ -745,7 +773,17 @@ def cmd_supervise(args: argparse.Namespace) -> int:
     In-process — one interpreter, one metric registry — so
     ``jimm_train_restarts_total`` and the lost-work goodput bucket
     accumulate across attempts; ``launch.py --restarts`` applies the same
-    policy at process-group granularity."""
+    policy at process-group granularity.
+
+    ``--elastic`` replans the mesh before every attempt from the devices
+    still available (``--shrink-plan`` shrinks the budget between attempts
+    for drills), so a restart that lost hosts restores its checkpoint onto
+    the smaller mesh (resharding-on-restore) instead of crashing on the old
+    shape. ``--adapt`` runs a :class:`~jimm_tpu.resilience.GoodputAdvisor`
+    over the per-attempt goodput breakdown and carries its bounded knob
+    decisions (checkpoint cadence, grace steps, scan unroll) into the next
+    attempt's flags. Without these flags, behavior is byte-identical to the
+    static supervise loop."""
     from jimm_tpu.resilience import BackoffPolicy, GiveUpError, Supervisor
     cmd = list(args.train_args or [])
     if cmd and cmd[0] == "--":
@@ -758,17 +796,86 @@ def cmd_supervise(args: argparse.Namespace) -> int:
                          "(restarts resume from checkpoints)")
     if "--preemption-save" not in cmd:
         cmd.append("--preemption-save")
+    shrink_plan = None
+    if args.shrink_plan:
+        if not args.elastic:
+            raise SystemExit("--shrink-plan is an --elastic drill knob")
+        try:
+            shrink_plan = [int(x) for x in args.shrink_plan.split(",")]
+        except ValueError:
+            raise SystemExit(f"--shrink-plan {args.shrink_plan!r}: expected "
+                             "comma-separated device counts, e.g. 8,4")
+        if any(n < 1 for n in shrink_plan):
+            raise SystemExit("--shrink-plan device counts must be >= 1")
+    advisor = None
+    if args.adapt:
+        from jimm_tpu.resilience import GoodputAdvisor
+
+        # seed the knobs from the train command itself (which already
+        # folded in any adopted_runtime pick): adopted-plus-adapted
+        advisor = GoodputAdvisor(knobs={
+            "save_every": int(_argv_flag_value(cmd, "--save-every", 50)),
+            "grace_steps": int(_argv_flag_value(cmd, "--grace-steps", 1)),
+            "scan_unroll": int(_argv_flag_value(cmd, "--scan-unroll", 0)),
+        })
     sup = Supervisor(max_restarts=args.max_restarts,
                      backoff=BackoffPolicy(base_s=args.backoff_base_s,
                                            max_s=args.backoff_max_s,
                                            jitter=0.5, seed=args.seed))
+    # elastic state threaded through attempts: the previous attempt's mesh
+    # width (to count replans) and the goodput counter values already
+    # booked (to hand the advisor per-attempt deltas)
+    elastic_state: dict[str, Any] = {"last_k": None, "booked": {}}
+
+    def _observe_goodput(attempt_i: int, t0: float) -> None:
+        from jimm_tpu import obs
+        snap = obs.snapshot()
+        prefix = "jimm_train_goodput_"
+        deltas = {}
+        for key, value in snap.items():
+            if key.startswith(prefix) and key.endswith("_seconds_total"):
+                bucket = key[len(prefix):-len("_seconds_total")]
+                deltas[bucket] = value - elastic_state["booked"].get(key, 0.0)
+                elastic_state["booked"][key] = value
+        import time as _time
+        advisor.observe(attempt_i, _time.monotonic() - t0, deltas)
 
     def attempt(i: int, resume: bool) -> int:
         argv = list(cmd)
         if resume and "--resume" not in argv:
             argv.append("--resume")
-        ns = build_parser().parse_args(argv)
-        return ns.fn(ns)
+        if args.elastic:
+            import jax
+            avail = len(jax.devices())
+            if shrink_plan is not None:
+                avail = min(avail,
+                            shrink_plan[min(i, len(shrink_plan) - 1)])
+            from jimm_tpu.resilience import plan_data_axis
+            batch = int(_argv_flag_value(argv, "--batch-size", 32))
+            k = plan_data_axis(avail, batch)
+            # appended AFTER the user's flags: argparse last-wins makes the
+            # replanned mesh effective without rewriting their command
+            argv += ["--mesh", f"data={k}", "--rules", "dp",
+                     "--max-devices", str(k)]
+            if (elastic_state["last_k"] is not None
+                    and k != elastic_state["last_k"]):
+                from jimm_tpu.obs import get_registry
+                get_registry("jimm_train").counter(
+                    "topology_changes_total").inc()
+                print(f"[supervise] attempt {i + 1}: replanned mesh "
+                      f"data={elastic_state['last_k']} -> data={k} "
+                      f"({avail} devices available)")
+            elastic_state["last_k"] = k
+        if advisor is not None:
+            argv += advisor.argv_overrides()
+        import time as _time
+        t0 = _time.monotonic()
+        try:
+            ns = build_parser().parse_args(argv)
+            return ns.fn(ns)
+        finally:
+            if advisor is not None:
+                _observe_goodput(i, t0)
 
     try:
         rc = sup.run(attempt)
@@ -785,6 +892,11 @@ def cmd_supervise(args: argparse.Namespace) -> int:
             "jimm_train_checkpoint_quarantined_total",
             "jimm_train_goodput_lost_work_seconds_total",
             "jimm_train_goodput_preemption_save_seconds_total")
+    if args.elastic:
+        keys += ("jimm_train_topology_changes_total",
+                 "jimm_train_checkpoint_topology_changes_total")
+    if advisor is not None:
+        keys += ("jimm_train_goodput_advisor_decisions_total",)
     print("resilience: "
           + _json.dumps({k: snap.get(k, 0.0) for k in keys}))
     return rc
@@ -1502,6 +1614,17 @@ def cmd_serve(args: argparse.Namespace) -> int:
                              buckets=buckets,
                              max_delay_ms=args.max_delay_ms, policy=policy,
                              trace_count=trace_count, qos=qos)
+    if args.self_heal:
+        if plan.is_trivial:
+            raise SystemExit("--self-heal needs a replica topology "
+                             "(--replicas/--model-parallel > 1): a single "
+                             "lane has nothing to replan around")
+        # watchdog escalation: fence -> probe/revive -> rebuild the full
+        # replica set from the AOT store and replan around the dead lane.
+        # The factory reuses _build_forward, so a warm store means the
+        # rebuild deserializes executables — zero fresh traces.
+        engine.set_heal(
+            lambda: _build_forward(model, method, size, model_key))
     pool = None
     pool_traces = []
     if args.pool_model:
@@ -1676,6 +1799,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "train-step compile")
     sp.add_argument("--mesh", default=None,
                     help='e.g. "data=4,model=2" (default: no mesh)')
+    sp.add_argument("--max-devices", type=int, default=None,
+                    help="build the mesh over only the first N visible "
+                         "devices (elastic restarts: a shrunk attempt plans "
+                         "over the surviving subset and restore reshards "
+                         "the checkpoint onto it)")
     sp.add_argument("--rules", default=None,
                     choices=["replicated", "dp", "tp", "fsdp",
                              "fsdp_tp", "sp", "pp"],
@@ -1762,6 +1890,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--seed", type=int, default=None,
                     help="seed the restart-backoff jitter "
                          "(reproducible drills)")
+    sp.add_argument("--elastic", action="store_true",
+                    help="replan the mesh from surviving devices before "
+                         "every attempt (--mesh data=K --max-devices K "
+                         "appended to the train command); restore reshards "
+                         "the checkpoint onto the new shape")
+    sp.add_argument("--shrink-plan", default=None,
+                    help="elastic drill: comma-separated device budgets per "
+                         "attempt, e.g. 8,4 = first attempt sees 8 devices, "
+                         "every later attempt 4 (simulates losing hosts)")
+    sp.add_argument("--adapt", action="store_true",
+                    help="run the GoodputAdvisor over per-attempt goodput "
+                         "breakdowns and carry its bounded knob decisions "
+                         "(--save-every/--grace-steps/--scan-unroll) into "
+                         "the next attempt")
     sp.add_argument("train_args", nargs=argparse.REMAINDER,
                     help="-- train --preset ... --ckpt-dir ...")
     sp.set_defaults(fn=cmd_supervise)
@@ -1933,6 +2075,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="devices per replica: each forward's params are "
                          "tensor-parallel over a (data=1, model=k) submesh "
                          "(big towers that don't fit one chip)")
+    sp.add_argument("--self-heal", action="store_true",
+                    help="escalate a watchdog fence: probe the fenced "
+                         "replica (transient fault -> revive in place), "
+                         "else rebuild the replica set from the AOT store "
+                         "and replan around it live (zero fresh traces "
+                         "when the store is warm)")
     sp.add_argument("--queue-size", type=int, default=256,
                     help="admission bound; requests past it get a 503 "
                          "queue_full")

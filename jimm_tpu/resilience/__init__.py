@@ -16,6 +16,12 @@ as a scheduled event instead:
   ``--inject-faults`` (preemption signals, hard crashes, slow-host stalls,
   checkpoint corruption at configured steps) — the drill that
   ``tests/test_resilience.py`` and ``scripts/resilience_smoke.py`` run.
+- :mod:`~jimm_tpu.resilience.elastic` closes the goodput loop:
+  :func:`plan_data_axis` replans the mesh from surviving devices between
+  attempts (restore lands on the new shape via resharding-on-restore) and
+  :class:`GoodputAdvisor` adjusts checkpoint cadence / grace steps /
+  scan unroll from the per-attempt goodput breakdown — bounded,
+  hysteretic, and logged (``supervise --elastic`` / ``--adapt``).
 
 Everything here is host-only (no jax import), so the supervisor can run on
 a coordinator box with no accelerator stack. Restarts, lost work, and
@@ -25,6 +31,7 @@ so resilience is measured, not assumed.
 """
 
 from jimm_tpu.resilience.backoff import BackoffPolicy
+from jimm_tpu.resilience.elastic import GoodputAdvisor, plan_data_axis
 from jimm_tpu.resilience.faults import (Fault, FaultPlan,
                                         corrupt_latest_checkpoint)
 from jimm_tpu.resilience.preemption import (PreemptedError, PreemptionGuard,
@@ -37,10 +44,12 @@ __all__ = [
     "Fault",
     "FaultPlan",
     "GiveUpError",
+    "GoodputAdvisor",
     "PreemptedError",
     "PreemptionGuard",
     "PreemptionHandler",
     "Supervisor",
     "corrupt_latest_checkpoint",
     "note_checkpoint_completed",
+    "plan_data_axis",
 ]

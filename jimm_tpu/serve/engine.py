@@ -91,10 +91,11 @@ class _Replica:
     load counters. ``inflight`` is the replica's queue depth (batches
     assigned but not finished) — the quantity dispatch balances on.
     ``restarts``/``dead`` belong to the engine's watchdog: a failing lane
-    gets one fresh executor, then is fenced off."""
+    gets one fresh executor, then is fenced off. ``revived`` counts
+    operator/self-heal un-fencings (each one re-arms the free restart)."""
 
     __slots__ = ("index", "forward", "name", "pool", "inflight",
-                 "dispatched", "device_s", "restarts", "dead")
+                 "dispatched", "device_s", "restarts", "dead", "revived")
 
     def __init__(self, index: int, forward: Callable, name: str):
         self.index = index
@@ -107,6 +108,7 @@ class _Replica:
         self.device_s = 0.0
         self.restarts = 0
         self.dead = False
+        self.revived = 0
 
 
 class InferenceEngine:
@@ -181,6 +183,11 @@ class InferenceEngine:
             # *_count names as histogram counters
             self.metrics.bind_gauge("n_replicas",
                                     lambda: float(len(self._replicas)))
+            self.metrics.bind_gauge(
+                "replicas_alive",
+                lambda: float(sum(1 for r in self._replicas if not r.dead)))
+            # pre-created at zero so "never replanned" is visible in scrapes
+            self.metrics.inc("replans_total", 0)
             for replica in self._replicas:
                 self._bind_replica_metrics(replica)
         # asyncio.Queue, or a qos.WeightedFairQueue (same surface) when a
@@ -191,6 +198,18 @@ class InferenceEngine:
         self._dispatch_tasks: set[asyncio.Task] = set()
         self._rr = 0
         self._running = False
+        # submission gate, separate from _running: a replan pauses the
+        # batcher (so _task/_capacity can be swapped safely) while submit()
+        # keeps enqueueing — queued requests ride through the swap
+        self._accepting = False
+        # self-heal hook (set_heal): a blocking factory rebuilding the full
+        # replica set from the AOT store, invoked by the watchdog when a
+        # fence would otherwise be permanent
+        self._heal: Callable | None = None
+        self._heal_task: asyncio.Task | None = None
+        self._replan_lock = asyncio.Lock()
+        #: repr of the last failed self-heal attempt (healthz debugging)
+        self.last_heal_error: str | None = None
         # Per-request phase decomposition (trace id -> phase seconds),
         # newest last; read by /healthz debugging and tests.
         self.recent_traces: deque[dict] = deque(maxlen=64)
@@ -225,7 +244,8 @@ class InferenceEngine:
         return [{"replica": r.index, "dispatched": r.dispatched,
                  "inflight": r.inflight,
                  "device_seconds": round(r.device_s, 6),
-                 "restarts": r.restarts, "dead": r.dead}
+                 "restarts": r.restarts, "dead": r.dead,
+                 "revived": r.revived}
                 for r in self._replicas]
 
     def dead_replicas(self) -> list[int]:
@@ -252,6 +272,180 @@ class InferenceEngine:
             replica.dead = True
             if self._multi:
                 self.metrics.inc(f"replica_{replica.index}_dead_total")
+            # fence -> attempt-revive -> replan-around: with a heal hook
+            # installed the fence is an escalation step, not a terminus
+            self._maybe_heal(replica)
+
+    def revive(self, index: int) -> dict:
+        """Operator hook: un-fence a watchdog-dead replica — fresh executor,
+        restart budget re-armed — without touching its siblings. Raises
+        ValueError for an unknown index or a replica that is not fenced
+        (the server maps that to a 400, so a typo'd revive is loud).
+        Returns the replica's new stats row."""
+        if not isinstance(index, int) or not 0 <= index < len(self._replicas):
+            raise ValueError(f"no replica {index!r} "
+                             f"(engine has {len(self._replicas)})")
+        replica = self._replicas[index]
+        if not replica.dead:
+            raise ValueError(f"replica {index} is not fenced; "
+                             "nothing to revive")
+        replica.pool.shutdown(wait=False)
+        replica.pool = ThreadPoolExecutor(max_workers=1,
+                                          thread_name_prefix=replica.name)
+        replica.restarts = 0
+        replica.dead = False
+        replica.revived += 1
+        if self._multi:
+            self.metrics.inc(f"replica_{index}_revived_total")
+            self.metrics.inc("revives_total")
+        return self.replica_stats()[index]
+
+    # -- self-heal / live replan ------------------------------------------
+
+    def set_heal(self, factory: Callable) -> None:
+        """Install the self-heal hook: a *blocking* zero-arg factory that
+        rebuilds the full replica forward set (normally a closure over
+        :func:`~jimm_tpu.serve.topology.build_replica_forwards` and the AOT
+        store, so the rebuild deserializes executables instead of
+        re-tracing). Invoked off-loop by the watchdog after a fence: probe
+        the fenced lane first (transient fault -> revive in place), else
+        rebuild and :meth:`replan` around it."""
+        self._heal = factory
+        self.metrics.inc("heal_failures_total", 0)
+
+    def _maybe_heal(self, replica: _Replica) -> None:
+        if self._heal is None:
+            return
+        if self._heal_task is not None and not self._heal_task.done():
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # fenced outside a loop (sync tests): nothing to schedule
+        self._heal_task = loop.create_task(self._heal_around(replica),
+                                           name="jimm-serve-heal")
+
+    async def _heal_around(self, replica: _Replica) -> None:
+        loop = asyncio.get_running_loop()
+        ok = await loop.run_in_executor(None, self._probe_blocking, replica)
+        if ok:
+            # the fault was transient (wedged thread, recovered device):
+            # the lane still computes, so un-fence it in place
+            self.revive(replica.index)
+            return
+        try:
+            built = await loop.run_in_executor(None, self._heal)
+        except Exception as e:  # noqa: BLE001 — a failed heal must never kill the loop; it is counted and surfaced, and the engine keeps serving degraded
+            self.metrics.inc("heal_failures_total")
+            self.last_heal_error = f"{type(e).__name__}: {e}"
+            return
+        forwards, trace_count = self._normalize_built(built)
+        await self.replan(forwards, trace_count=trace_count)
+
+    @staticmethod
+    def _normalize_built(built):
+        """Accept either ``(forwards, trace_count)`` — the
+        build_replica_forwards return shape — or a bare forward list."""
+        if (isinstance(built, tuple) and len(built) == 2
+                and isinstance(built[0], (list, tuple))
+                and (built[1] is None or callable(built[1]))):
+            return list(built[0]), built[1]
+        return built, None
+
+    def _probe_blocking(self, replica: _Replica) -> bool:
+        """One min-bucket forward on a fenced replica, off its (possibly
+        wedged) executor. True means the lane still computes."""
+        size = min(self.buckets.sizes)
+        zeros = np.zeros((size,) + self.item_shape, self.dtype)
+        try:
+            self._forward_blocking(zeros, replica)
+        except Exception:  # noqa: BLE001 — any failure IS the probe's answer; the caller escalates to a full rebuild
+            return False
+        return True
+
+    async def replan(self, forward, *, trace_count: Callable[[], int]
+                     | None = None, warm: bool = True) -> dict:
+        """Swap the live replica set for a new one — grow, shrink, or heal —
+        without dropping queued work.
+
+        Sequence: (1) warm every bucket of every new forward *off-loop*
+        while the old replicas keep serving (store-backed forwards go
+        through ``prepare_bucket`` first, so a warm AOT store means zero
+        fresh traces here); (2) pause the batcher via the ``_STOP``
+        sentinel and drain in-flight dispatches (their futures resolve
+        normally); (3) swap replicas/semaphore/gauges; (4) restart the
+        batcher. ``submit()`` keeps accepting throughout — queued requests
+        ride through the swap and dispatch onto the new topology."""
+        new_multi = isinstance(forward, (list, tuple))
+        forwards = list(forward) if new_multi else [forward]
+        if not forwards:
+            raise ValueError("replan needs at least one replica forward")
+        async with self._replan_lock:
+            loop = asyncio.get_running_loop()
+            if warm:
+                await loop.run_in_executor(
+                    None, self._warm_forwards_blocking, forwards)
+            was_running = self._running and self._task is not None
+            if was_running:
+                assert self._queue is not None
+                self._queue.put_nowait(_STOP)
+                await self._task
+                self._task = None
+                if self._dispatch_tasks:
+                    await asyncio.gather(*tuple(self._dispatch_tasks),
+                                         return_exceptions=True)
+            old = self._replicas
+            for replica in old:
+                replica.pool.shutdown(wait=True)
+            self._multi = new_multi
+            self._replicas = [
+                _Replica(i, f, name=(f"jimm-serve-fwd-r{i}" if new_multi
+                                     else "jimm-serve-fwd"))
+                for i, f in enumerate(forwards)]
+            self.forward = forwards[0]
+            self._rr = 0
+            if trace_count is not None:
+                self.trace_count = trace_count
+                self.metrics.bind_gauge("compile_count", trace_count)
+            if new_multi:
+                self.metrics.bind_gauge(
+                    "n_replicas", lambda: float(len(self._replicas)))
+                self.metrics.bind_gauge(
+                    "replicas_alive",
+                    lambda: float(sum(1 for r in self._replicas
+                                      if not r.dead)))
+                for replica in self._replicas:
+                    self._bind_replica_metrics(replica)
+            # a shrink leaves higher-index gauges bound to dead objects:
+            # freeze them at zero so scrapes don't report ghost load
+            for i in range(len(forwards), len(old)):
+                self.metrics.bind_gauge(f"replica_{i}_inflight", lambda: 0.0)
+                self.metrics.bind_gauge(f"replica_{i}_device_seconds",
+                                        lambda: 0.0)
+            if was_running:
+                self._capacity = asyncio.Semaphore(len(self._replicas))
+                self._dispatch_tasks = set()
+                self._task = loop.create_task(self._batcher(),
+                                              name="jimm-serve-batcher")
+            self.metrics.inc("replans_total")
+            return {"replicas": len(self._replicas),
+                    "was_running": was_running,
+                    "replans": self.metrics.count("replans_total")}
+
+    def _warm_forwards_blocking(self, forwards) -> None:
+        """Every bucket of every new forward prepared and primed (blocking;
+        run off-loop). The priming call matters: an AotForward falls back
+        to a fresh trace for any bucket it was never primed on, which
+        would break replan's zero-fresh-traces contract."""
+        for size in self.buckets.sizes:
+            zeros = np.zeros((size,) + self.item_shape, self.dtype)
+            for fwd in forwards:
+                prepare = getattr(fwd, "prepare_bucket", None)
+                if prepare is not None:
+                    prepare(size)
+                out = fwd(zeros)
+                if hasattr(out, "block_until_ready"):
+                    out.block_until_ready()
 
     def _pick_replica(self) -> _Replica:
         """Least-loaded live replica by inflight batch count; ties break
@@ -323,6 +517,7 @@ class InferenceEngine:
         self._capacity = asyncio.Semaphore(len(self._replicas))
         self._dispatch_tasks = set()
         self._running = True
+        self._accepting = True
         self._task = asyncio.get_running_loop().create_task(
             self._batcher(), name="jimm-serve-batcher")
 
@@ -330,6 +525,12 @@ class InferenceEngine:
         if not self._running:
             return
         self._running = False
+        self._accepting = False
+        if self._heal_task is not None:
+            self._heal_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._heal_task
+            self._heal_task = None
         assert self._queue is not None
         self._queue.put_nowait(_STOP)
         if self._task is not None:
@@ -363,7 +564,7 @@ class InferenceEngine:
         higher-class arrival. Without a scheduler ``tenant`` is ignored
         and this path is byte-identical to the original engine.
         """
-        if not self._running or self._queue is None:
+        if not self._accepting or self._queue is None:
             raise EngineClosedError("engine is not running; call start()")
         item = self._coerce(item)
         self.metrics.inc("requests_total")

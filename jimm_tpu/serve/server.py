@@ -200,6 +200,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, app.classify(payload))
             elif self.path == "/v1/search":
                 self._send_json(200, app.search(payload))
+            elif self.path == "/admin/revive":
+                self._send_json(200, app.revive(payload))
             else:
                 self._send_json(404, {"error": "not_found",
                                       "message": self.path})
@@ -445,6 +447,24 @@ class ServingServer:
                 "cached": cached,
                 "trace_id": rid}
 
+    def revive(self, payload: dict) -> dict:
+        """Operator recourse for a watchdog-fenced replica:
+        ``POST /admin/revive {"replica": N}`` un-fences lane N with a fresh
+        executor and a re-armed restart budget. Without this hook a fence
+        is forever — the watchdog never retries a dead lane on its own
+        (unless the engine has a self-heal factory installed). A bad index
+        or an un-fenced replica is a 400, so drills notice typos."""
+        index = payload.get("replica")
+        if not isinstance(index, int) or isinstance(index, bool):
+            raise RequestError("revive needs 'replica': <int index>")
+        engine = self._engine_for(payload.get("model"))
+        try:
+            stats = engine.revive(index)
+        except ValueError as e:
+            raise RequestError(str(e)) from None
+        return {"revived": index, "replica_stats": stats,
+                "dead_replicas": engine.dead_replicas()}
+
     def metrics_text(self) -> str:
         """Unified Prometheus dump for ``/metrics``: this server's
         ``jimm_serve_*`` series (the exact ServeMetrics snapshot names, as
@@ -479,6 +499,10 @@ class ServingServer:
         # replica cold/stuck?" is answerable from a health probe
         if getattr(self.engine, "_multi", False):
             out["replicas"] = self.engine.replica_stats()
+            out["replans"] = int(self.metrics.count("replans_total"))
+            heal_err = getattr(self.engine, "last_heal_error", None)
+            if heal_err:
+                out["last_heal_error"] = heal_err
         # a watchdog-fenced replica downgrades the whole probe: the server
         # still answers, but capacity is reduced and an operator should act
         dead = getattr(self.engine, "dead_replicas", lambda: [])()

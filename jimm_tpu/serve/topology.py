@@ -17,6 +17,13 @@ device_put) so single-chip serving stays byte-identical. ``plan_topology``
 rejects infeasible splits (``R * k > n_devices``) with an error that names
 the fix.
 
+Plans are **revisable at runtime**: :meth:`TopologyPlan.revise` derives a
+new plan (grow, shrink, or re-partition around a lost group) and
+``build_replica_forwards`` over it produces the forward list that
+``InferenceEngine.replan`` swaps in live — queued requests ride through,
+and a warm AOT store makes the rebuild trace-free. The boot-time plan is
+just the first revision.
+
 FastUSP (PAPERS.md) motivates exactly this two-level split — replication for
 throughput, tensor parallelism for per-request latency on towers too big for
 one chip.
@@ -75,6 +82,24 @@ class TopologyPlan:
                 "model_parallel": self.model_parallel,
                 "devices_used": self.devices_used,
                 "devices_unused": self.n_devices - self.devices_used}
+
+    def revise(self, *, replicas: int | None = None,
+               model_parallel: int | None = None,
+               devices: Sequence | None = None) -> "TopologyPlan":
+        """Derive a runtime revision of this plan: same partitioning rules,
+        new shape and/or device set. Unspecified dimensions keep their
+        current values; ``devices=None`` re-plans over this plan's own
+        device list (flattened groups plus any unused tail is NOT
+        recoverable here — pass the surviving ``jax.devices()`` subset
+        explicitly when healing around lost hardware). Feed the result to
+        :func:`build_replica_forwards` and then
+        ``InferenceEngine.replan`` to apply it live."""
+        if devices is None:
+            devices = [d for group in self.device_groups for d in group]
+        return plan_topology(
+            self.replicas if replicas is None else replicas,
+            self.model_parallel if model_parallel is None else model_parallel,
+            devices=devices)
 
 
 def plan_topology(replicas: int | None = None,

@@ -43,6 +43,18 @@ def _storage_layout(model: nnx.Module) -> dict[str, Any] | None:
     return layout or None
 
 
+def _mesh_layout(mesh) -> dict[str, Any] | None:
+    """JSON-able fingerprint of the mesh a state was saved under (axis
+    sizes + device count). Orbax's ``StandardRestore`` already reshards
+    every array onto the *target* state's shardings, so a mesh change needs
+    no data movement here — the layout is recorded so restore can tell an
+    elastic topology change apart from a same-shape resume and count it."""
+    if mesh is None:
+        return None
+    return {"axes": {str(k): int(v) for k, v in dict(mesh.shape).items()},
+            "n_devices": int(mesh.devices.size)}
+
+
 def _relayout(state, saved: dict | None, current: dict | None):
     """Re-permute stacked layer rows from a checkpoint's baked pipeline
     placement to the target model's (either may be canonical=None). Applies
@@ -98,12 +110,44 @@ def _relayout(state, saved: dict | None, current: dict | None):
     return nnx.from_flat_state(out)
 
 
+def _pin_unannotated(state, mesh):
+    """Leaves the model never annotated (optimizer scalars like the Adam
+    step count) restore *committed to a single device*: orbax reshards
+    onto the target's sharding, and an unannotated target array means
+    SingleDeviceSharding. A later jit mixing them with mesh-committed
+    params then refuses placement outright. Re-pin such leaves replicated
+    over the live mesh — exactly where jit would have put them before the
+    restore committed them."""
+    if mesh is None:
+        return state
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    rep = NamedSharding(mesh, PartitionSpec())
+    out = []
+    for path, leaf in nnx.to_flat_state(state):
+        val = leaf.get_value() if hasattr(leaf, "get_value") else leaf
+        sh = getattr(val, "sharding", None)
+        if sh is not None and not isinstance(sh, NamedSharding):
+            new = jax.device_put(val, rep)
+            leaf = leaf.replace(new) if hasattr(leaf, "replace") else new
+        out.append((path, leaf))
+    return nnx.from_flat_state(out)
+
+
 class CheckpointManager:
     """Thin nnx-aware wrapper over ``orbax.checkpoint.CheckpointManager``."""
 
     def __init__(self, directory: str | Path, *, max_to_keep: int = 3,
-                 save_interval_steps: int = 1):
+                 save_interval_steps: int = 1, mesh=None):
         self._dir = Path(directory).absolute()
+        #: mesh the live model is sharded over (None = unsharded). Saves
+        #: record its layout; restore compares it against the checkpoint's
+        #: and counts a topology change when they differ (elastic restarts
+        #: that lost or gained devices land here).
+        self.mesh = mesh
+        #: ``{"saved": ..., "current": ...}`` of the last restore that
+        #: crossed a mesh change, else None
+        self.last_topology_change: dict[str, Any] | None = None
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
@@ -138,6 +182,9 @@ class CheckpointManager:
             layout = _storage_layout(model)
             if layout is not None:
                 meta["_storage_layout"] = layout
+            mesh_layout = _mesh_layout(self.mesh)
+            if mesh_layout is not None:
+                meta["_mesh_layout"] = mesh_layout
             if meta:
                 items["extra"] = ocp.args.JsonSave(meta)
             saved = self._mgr.save(step, args=ocp.args.Composite(**items),
@@ -300,8 +347,10 @@ class CheckpointManager:
             restored = self._mgr.restore(step,
                                          args=ocp.args.Composite(**items))
             saved_meta = (restored.get("extra") or {}) if has_extra else {}
-            self.last_restored_extra = {k: v for k, v in saved_meta.items()
-                                        if k != "_storage_layout"}
+            self.last_restored_extra = {
+                k: v for k, v in saved_meta.items()
+                if k not in ("_storage_layout", "_mesh_layout")}
+            self._note_mesh_change(step, saved_meta.get("_mesh_layout"))
             saved = saved_meta.get("_storage_layout")
             current = _storage_layout(model)
             model_state = restored["model"]
@@ -312,10 +361,35 @@ class CheckpointManager:
                     # optimizer moments live under opt.model mirroring the
                     # param tree; same stacked rows, same re-permutation
                     opt_state = _relayout(opt_state, saved, current)
+            model_state = _pin_unannotated(model_state, self.mesh)
+            if opt_state is not None:
+                opt_state = _pin_unannotated(opt_state, self.mesh)
             nnx.update(model, model_state)
             if optimizer is not None:
                 nnx.update(optimizer, opt_state)
         return step
+
+    def _note_mesh_change(self, step: int, saved: dict | None) -> None:
+        """Detect restore-onto-a-different-mesh (elastic shrink/grow).
+
+        The actual resharding is free: ``StandardRestore`` targets the live
+        model's NamedShardings, so the arrays land distributed over
+        whatever mesh the model was rebuilt on. What a topology change
+        still needs is to be *visible* — the counter is what drills and
+        dashboards assert on."""
+        current = _mesh_layout(self.mesh)
+        if saved is None or current is None or saved == current:
+            return
+        self.last_topology_change = {"step": step, "saved": saved,
+                                     "current": current}
+        from jimm_tpu.obs import get_registry
+        get_registry("jimm_train").counter(
+            "checkpoint_topology_changes_total").inc()
+        print(  # jaxlint: disable=JL007 — one-shot operator narration of an elastic restore, mirrors the supervisor's restart lines
+            f"[checkpoint] step {step} saved on mesh {saved['axes']} "
+            f"({saved['n_devices']} devices), restored onto "
+            f"{current['axes']} ({current['n_devices']} devices) — "
+            f"resharded onto the current topology")
 
     def latest_step(self) -> int | None:
         """Newest *completed* step (marker-verified) — unlike raw orbax,

@@ -1,0 +1,276 @@
+"""CI drill for goodput-driven elastic adaptation (ISSUE 12).
+
+Two legs in one process, both through shipped code paths:
+
+**Train leg — shrink between attempts.** A control run on a ``data=8`` mesh
+is the oracle; then ``supervise --elastic --shrink-plan 8,4 --adapt`` runs
+the same job with ``preempt@2`` injected. Attempt 1 plans ``data=8``, the
+preemption's grace-window save commits, and attempt 2 — seeing only 4
+surviving devices — replans ``data=4`` and restores the 8-device checkpoint
+onto the smaller mesh (resharding-on-restore). The finished run must match
+the control step-for-step: same losses (rtol 2e-4) and same batch content
+hashes, with ``restarts_total``, ``topology_changes_total``,
+``checkpoint_topology_changes_total`` all >= 1 and the GoodputAdvisor's
+decision counter present (auditable, possibly zero decisions).
+
+**Serve leg — kill one replica of a 2x2 topology.** A 2-replica x
+2-model-parallel engine over a warm AOT store (populated by a first life,
+so the serving life starts with zero fresh traces) gets a self-heal
+factory, serves traffic, then has one replica's forward replaced with a
+raiser. The watchdog restarts it, fences it, probes it, rebuilds from the
+store, and replans around it — the engine must keep answering throughout,
+finish with full capacity (``replicas_alive == 2`` in the rendered
+Prometheus text, ``replans_total >= 1``, no dead replicas) and pay ZERO
+fresh compiles for the heal (the rebuild deserializes store artifacts).
+
+Exits nonzero with a JSON error line on any violation.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m scripts.elastic_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import tempfile
+from pathlib import Path
+
+RTOL = 2e-4
+STEPS = 6
+REPLICAS = 2
+MODEL_PARALLEL = 2
+
+
+def fail(msg: str) -> int:
+    print(json.dumps({"metric": "elastic_smoke", "value": 0.0,
+                      "error": msg}), flush=True)
+    return 1
+
+
+def read_metrics(path: Path) -> dict[int, dict]:
+    with open(path) as f:
+        records = [json.loads(line) for line in f]
+    # later rows win duplicate steps: a grace-window step's row is
+    # superseded by its resumed re-run
+    return {rec["step"]: rec for rec in records}
+
+
+def check_against_control(ctl: dict[int, dict], got: dict[int, dict],
+                          steps, what: str) -> str | None:
+    for step in steps:
+        if step not in got:
+            return f"{what}: step {step} missing from resumed metrics"
+        if abs(got[step]["loss"] - ctl[step]["loss"]) > \
+                RTOL * abs(ctl[step]["loss"]):
+            return (f"{what}: loss diverged at step {step}: "
+                    f"{got[step]['loss']} vs control {ctl[step]['loss']}")
+        if got[step].get("batch_fingerprint") != \
+                ctl[step].get("batch_fingerprint"):
+            return (f"{what}: batch fingerprint mismatch at step {step} — "
+                    f"the shrunk run replayed or skipped batches")
+    return None
+
+
+def train_leg(tmp: Path) -> tuple[str | None, dict]:
+    from jimm_tpu import cli, obs
+
+    common = ["train", "--preset", "vit-tiny-patch16-224", "--tiny",
+              "--batch-size", "8", "--steps", str(STEPS),
+              "--save-every", "1", "--log-every", "0", "--seed", "7",
+              "--batch-fingerprint"]
+
+    control_file = tmp / "control.jsonl"
+    rc = cli.main(common + ["--mesh", "data=8", "--rules", "dp",
+                            "--metrics-file", str(control_file)])
+    if rc:
+        return f"control train exited {rc}", {}
+    ctl = read_metrics(control_file)
+    if set(ctl) != set(range(STEPS)):
+        return f"control logged steps {sorted(ctl)}, expected 0..{STEPS - 1}", {}
+
+    drill_file = tmp / "elastic.jsonl"
+    rc = cli.main(["supervise", "--max-restarts", "2",
+                   "--backoff-base-s", "0.01", "--seed", "0",
+                   "--elastic", "--shrink-plan", "8,4", "--adapt", "--"]
+                  + common + ["--ckpt-dir", str(tmp / "ckpt"),
+                              "--metrics-file", str(drill_file),
+                              "--inject-faults", "preempt@2"])
+    if rc:
+        return f"supervised elastic drill exited {rc}", {}
+    err = check_against_control(ctl, read_metrics(drill_file),
+                                range(STEPS), "elastic drill")
+    if err:
+        return err, {}
+
+    snap = obs.snapshot()
+    if snap.get("jimm_train_restarts_total", 0) < 1:
+        return "restarts_total is 0 after a preemption", {}
+    if snap.get("jimm_train_topology_changes_total", 0) < 1:
+        return ("topology_changes_total is 0 — the supervisor never "
+                "replanned the mesh"), {}
+    if snap.get("jimm_train_checkpoint_topology_changes_total", 0) < 1:
+        return ("checkpoint_topology_changes_total is 0 — the restore "
+                "never crossed mesh shapes"), {}
+    if "jimm_train_goodput_advisor_decisions_total" not in snap:
+        return ("advisor decision counter missing from the snapshot — "
+                "--adapt never instantiated the GoodputAdvisor"), {}
+    return None, {
+        "restarts_total": snap.get("jimm_train_restarts_total"),
+        "topology_changes_total": snap.get(
+            "jimm_train_topology_changes_total"),
+        "checkpoint_topology_changes_total": snap.get(
+            "jimm_train_checkpoint_topology_changes_total"),
+        "advisor_decisions_total": snap.get(
+            "jimm_train_goodput_advisor_decisions_total"),
+    }
+
+
+def serve_leg() -> tuple[str | None, dict]:
+    import asyncio
+
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import CLIP, preset
+    from jimm_tpu.aot import ArtifactStore
+    from jimm_tpu.cli import _tiny_override
+    from jimm_tpu.serve import (BucketTable, InferenceEngine,
+                                build_replica_forwards, plan_topology)
+
+    cfg = _tiny_override(preset("clip-vit-base-patch16"))
+    model = CLIP(cfg, rngs=nnx.Rngs(0))
+    size = cfg.vision.image_size
+    plan = plan_topology(REPLICAS, MODEL_PARALLEL)
+
+    with tempfile.TemporaryDirectory(prefix="jimm-elastic-serve-") as root:
+        store = ArtifactStore(root)
+
+        def build():
+            return build_replica_forwards(
+                model, plan, method="encode_image",
+                item_shape=(size, size, 3), store=store,
+                label="elastic_smoke")
+
+        # life 1: populate the store through write-through warmup
+        forwards1, traces1 = build()
+        warm1 = InferenceEngine(forwards1, item_shape=(size, size, 3),
+                                buckets=BucketTable((1, 4)),
+                                max_delay_ms=2.0, trace_count=traces1)
+        warm1.warmup_blocking()
+        if not store.entries():
+            return "life-1 warmup wrote nothing to the store", {}
+
+        # serving life: warm start, then self-heal from the same store
+        forwards, traces = build()
+        engine = InferenceEngine(forwards, item_shape=(size, size, 3),
+                                 buckets=BucketTable((1, 4)),
+                                 max_delay_ms=2.0, trace_count=traces)
+        engine.warmup_blocking()
+        if traces():
+            return (f"warm start paid {traces()} fresh traces; the store "
+                    f"did not round-trip"), {}
+        engine.set_heal(build)
+
+        x = np.random.RandomState(0).rand(size, size, 3).astype(np.float32)
+
+        class Raiser:
+            def __call__(self, _):
+                raise RuntimeError("injected: replica device lost")
+
+        async def drive():
+            await engine.start()
+            answered = errors = 0
+            try:
+                for _ in range(8):
+                    await engine.submit(x)
+                    answered += 1
+                # kill replica 1 and keep driving until the watchdog
+                # fences it and the self-heal replans around it
+                engine._replicas[1].forward = Raiser()
+                for _ in range(400):
+                    try:
+                        await engine.submit(x)
+                        answered += 1
+                    except RuntimeError:
+                        errors += 1
+                    if engine.metrics.count("replans_total") >= 1:
+                        break
+                    await asyncio.sleep(0.01)
+                else:
+                    return None, answered, errors, "no replan happened"
+                # healed: full capacity, every request answered
+                post = []
+                for _ in range(16):
+                    post.append(np.asarray(await engine.submit(x)))
+                    answered += 1
+                return post, answered, errors, None
+            finally:
+                await engine.stop()
+
+        post, answered, errors, err = asyncio.run(drive())
+        if err:
+            return f"serve leg: {err} (answered={answered}, " \
+                   f"errors={errors})", {}
+        if engine.dead_replicas():
+            return (f"dead replicas after heal: "
+                    f"{engine.dead_replicas()}"), {}
+        if engine.n_replicas != REPLICAS:
+            return (f"replan restored {engine.n_replicas} replicas, "
+                    f"wanted {REPLICAS}"), {}
+        # zero fresh compiles for the heal: replan rebinds compile_count to
+        # the rebuilt forwards' counter, which must still read 0 (every
+        # bucket of every replica deserialized from the store)
+        if engine.trace_count():
+            return (f"heal paid {engine.trace_count()} fresh compile(s); "
+                    f"the rebuild did not come from the store"), {}
+        want = np.asarray(model.encode_image(x[None]))[0]
+        for out in post:
+            if not np.allclose(out, want, rtol=1e-4, atol=1e-4):
+                return "post-heal output disagrees with the model", {}
+        text = engine.metrics.render_prometheus()
+        alive = re.search(r"^jimm_serve_replicas_alive (\S+)$", text,
+                          re.MULTILINE)
+        if alive is None or float(alive.group(1)) != REPLICAS:
+            return (f"jimm_serve_replicas_alive != {REPLICAS} in the "
+                    f"Prometheus text (got "
+                    f"{alive.group(1) if alive else 'missing'})"), {}
+        replans = re.search(r"^jimm_serve_replans_total (\S+)$", text,
+                            re.MULTILINE)
+        if replans is None or float(replans.group(1)) < 1:
+            return "jimm_serve_replans_total < 1 in the Prometheus text", {}
+        return None, {
+            "requests_answered": answered,
+            "errors_during_fence": errors,
+            "replans_total": int(float(replans.group(1))),
+            "replicas_alive": int(float(alive.group(1))),
+            "heal_compiles": engine.trace_count(),
+        }
+
+
+def main() -> int:
+    # must land before jax initializes its backends
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    import jax
+    if jax.device_count() < 8:
+        return fail(f"need 8 virtual devices, have {jax.device_count()} — "
+                    f"was XLA_FLAGS set before another jax import?")
+
+    tmp = Path(tempfile.mkdtemp(prefix="elastic_smoke_"))
+    err, train_summary = train_leg(tmp)
+    if err:
+        return fail(f"train leg: {err}")
+    err, serve_summary = serve_leg()
+    if err:
+        return fail(f"serve leg: {err}")
+    print(json.dumps({"metric": "elastic_smoke", "value": 1.0,
+                      "train": train_summary, "serve": serve_summary}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

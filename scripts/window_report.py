@@ -6,6 +6,12 @@ existed and what each one bought" is answerable at a glance:
 
     python -m scripts.window_report               # human table
     python -m scripts.window_report --markdown    # rows for docs/
+
+Fallback rows — bench.py CPU-smoke records stamped ``fallback: true`` (and
+``backend``) — are segregated from real TPU datapoints everywhere: prefixed
+in the per-record cells, counted separately in the per-phase summary, and
+never folded into the "clean" tally. BENCH_r01–r05 were misread precisely
+because the two were indistinguishable.
 """
 
 from __future__ import annotations
@@ -21,12 +27,24 @@ if str(REPO) not in sys.path:
 from scripts._measurements import MEASUREMENTS, read_records as load
 
 
+def is_fallback(rec: dict) -> bool:
+    """True for rows that are NOT the metric of record: bench.py CPU-smoke
+    reruns and pre-stamp rows whose metric name carries the legacy
+    "(cpu smoke)" marker."""
+    if rec.get("fallback") is True:
+        return True
+    return "(cpu smoke)" in str(rec.get("metric", ""))
+
+
 def describe(rec: dict) -> str:
     """One cell summarizing what the record measured (or why it failed)."""
+    prefix = ""
+    if is_fallback(rec):
+        prefix = f"FALLBACK[{rec.get('backend', 'cpu')}] "
     if "error" in rec:
-        return "ERROR: " + str(rec["error"])[:60]
+        return prefix + "ERROR: " + str(rec["error"])[:60]
     if "skipped" in rec:
-        return "skipped: " + str(rec["skipped"])[:40]
+        return prefix + "skipped: " + str(rec["skipped"])[:40]
     parts = []
     if isinstance(rec.get("variant"), dict):
         parts.append(",".join(f"{k}={v}" for k, v in rec["variant"].items()))
@@ -39,7 +57,7 @@ def describe(rec: dict) -> str:
             parts.append(f"{k}={rec[k]}")
     if "value" in rec and "mfu" not in rec:
         parts.append(f"value={rec['value']}")
-    return "  ".join(parts) or "(no payload)"
+    return prefix + ("  ".join(parts) or "(no payload)")
 
 
 def main() -> None:
@@ -53,12 +71,15 @@ def main() -> None:
         return
     if args.markdown:
         try:
-            print("| ts (UTC) | phase | try | rc | result |")
-            print("|---|---|---|---|---|")
+            print("| ts (UTC) | phase | try | rc | backend | result |")
+            print("|---|---|---|---|---|---|")
             for r in recs:
+                backend = str(r.get("backend", "?"))
+                if is_fallback(r):
+                    backend += " (fallback)"
                 print(f"| {r.get('ts', '?')} | {r.get('phase', '?')} "
                       f"| {r.get('attempt', '?')} | {r.get('rc', '?')} "
-                      f"| {describe(r)} |")
+                      f"| {backend} | {describe(r)} |")
         except BrokenPipeError:  # `| head` is a normal way to use this
             pass
         return
@@ -71,12 +92,15 @@ def main() -> None:
         phases = {}
         for r in recs:
             ph = str(r.get("phase", "?"))
-            ok = "error" not in r and "skipped" not in r
-            good, total = phases.get(ph, (0, 0))
-            phases[ph] = (good + ok, total + 1)
-        print("\nper phase (clean/total):",
-              "  ".join(f"{ph}={g}/{t}"
-                        for ph, (g, t) in sorted(phases.items())))
+            fb = is_fallback(r)
+            # a fallback row is never "clean" — it proves the measurement
+            # path, not the metric — so it gets its own tally
+            ok = not fb and "error" not in r and "skipped" not in r
+            good, total, fallbacks = phases.get(ph, (0, 0, 0))
+            phases[ph] = (good + ok, total + 1, fallbacks + fb)
+        print("\nper phase (clean/total, fallbacks):",
+              "  ".join(f"{ph}={g}/{t}" + (f" ({fb} fallback)" if fb else "")
+                        for ph, (g, t, fb) in sorted(phases.items())))
     except BrokenPipeError:  # `| head` is a normal way to use this
         pass
 

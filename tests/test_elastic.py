@@ -1,0 +1,432 @@
+"""Goodput-driven elastic adaptation (ISSUE 12): the mesh replanner, the
+bounded GoodputAdvisor, checkpoint mesh-layout metadata, and the serving
+engine's revive / live-replan / self-heal paths.
+
+The advisor and replanner are host-only (no jax); the engine tests use
+plain-callable forwards, so nothing here compiles a model — the end-to-end
+drills live in tests/test_failure_recovery.py and scripts/elastic_smoke.py.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from jimm_tpu.resilience import GoodputAdvisor, plan_data_axis
+from jimm_tpu.resilience.elastic import KNOB_BOUNDS
+
+
+# ---------------------------------------------------------------------------
+# plan_data_axis
+# ---------------------------------------------------------------------------
+
+class TestPlanDataAxis:
+    @pytest.mark.parametrize("n_devices,batch,expected", [
+        (8, 8, 8),      # full width
+        (4, 8, 4),      # shrink: half the devices still divide the batch
+        (8, 4, 4),      # batch-bound: never wider than the batch
+        (6, 8, 4),      # 6 doesn't divide 8 -> largest divisor below
+        (3, 8, 2),
+        (1, 8, 1),      # single survivor: degenerate but runnable
+        (5, 7, 1),      # coprime: falls all the way to 1
+    ])
+    def test_widest_dividing_axis(self, n_devices, batch, expected):
+        assert plan_data_axis(n_devices, batch) == expected
+
+    def test_rejects_nonpositive(self):
+        with pytest.raises(ValueError):
+            plan_data_axis(0, 8)
+        with pytest.raises(ValueError):
+            plan_data_axis(4, 0)
+
+
+# ---------------------------------------------------------------------------
+# GoodputAdvisor
+# ---------------------------------------------------------------------------
+
+def _advisor(**kw):
+    lines = []
+    kw.setdefault("knobs", {"save_every": 8, "grace_steps": 1,
+                            "scan_unroll": 4})
+    adv = GoodputAdvisor(emit=lines.append, **kw)
+    return adv, lines
+
+
+class TestGoodputAdvisor:
+    def test_healthy_window_makes_no_decision(self):
+        adv, lines = _advisor(window=2, cooldown=0)
+        for i in range(4):
+            d = adv.observe(i, 10.0, {"step": 9.0, "checkpoint": 0.2})
+            assert d is None
+        assert adv.decisions == [] and lines == []
+        assert adv.knobs["save_every"] == 8
+
+    def test_high_lost_work_halves_save_every(self):
+        adv, lines = _advisor(window=2, cooldown=0)
+        d = adv.observe(0, 10.0, {"lost_work": 3.0, "step": 6.0})
+        assert d is not None and d["knob"] == "save_every"
+        assert d["from"] == 8 and d["to"] == 4
+        assert adv.knobs["save_every"] == 4
+        assert len(lines) == 1 and "goodput_advisor_decision" in lines[0]
+
+    def test_save_every_floor_escalates_to_grace_steps(self):
+        adv, _ = _advisor(window=1, cooldown=0,
+                          knobs={"save_every": 1, "grace_steps": 1})
+        d = adv.observe(0, 10.0, {"lost_work": 3.0})
+        assert d["knob"] == "grace_steps" and d["to"] == 2
+
+    def test_cooldown_suppresses_back_to_back_decisions(self):
+        adv, _ = _advisor(window=1, cooldown=1)
+        assert adv.observe(0, 10.0, {"lost_work": 3.0}) is not None
+        # next observation is still bad but falls inside the cooldown
+        assert adv.observe(1, 10.0, {"lost_work": 3.0}) is None
+        assert adv.observe(2, 10.0, {"lost_work": 3.0}) is not None
+
+    def test_checkpoint_relax_respects_dead_band(self):
+        adv, _ = _advisor(window=1, cooldown=0,
+                          lost_work_high=0.08, checkpoint_high=0.25)
+        # checkpoint heavy but lost_work INSIDE the dead band
+        # (>= lost_work_high / 2): neither rule may fire, so the two
+        # cadence rules can never ping-pong
+        d = adv.observe(0, 10.0, {"checkpoint": 4.0, "lost_work": 0.5})
+        assert d is None
+        # comfortably low lost work -> relax the cadence
+        d = adv.observe(1, 10.0, {"checkpoint": 4.0, "lost_work": 0.0})
+        assert d is not None and d["knob"] == "save_every" and d["to"] == 16
+
+    def test_compile_dominating_pins_scan_unroll(self):
+        adv, _ = _advisor(window=2, cooldown=0)
+        assert adv.observe(0, 10.0, {"compile": 6.0}) is None, \
+            "one attempt is not a trend"
+        d = adv.observe(1, 10.0, {"compile": 6.0})
+        assert d["knob"] == "scan_unroll" and d["to"] == 1
+
+    def test_every_knob_stays_inside_bounds(self):
+        adv, _ = _advisor(window=1, cooldown=0,
+                          knobs={"save_every": 2, "grace_steps": 7})
+        for i in range(40):
+            adv.observe(i, 10.0, {"lost_work": 5.0})
+        lo, hi = KNOB_BOUNDS["save_every"]
+        assert lo <= adv.knobs["save_every"] <= hi
+        lo, hi = KNOB_BOUNDS["grace_steps"]
+        assert lo <= adv.knobs["grace_steps"] <= hi
+        # once every reachable knob is at its clamp the advisor goes quiet
+        # instead of emitting no-op decisions
+        assert adv.knobs["grace_steps"] == hi
+        tail = adv.observe(99, 10.0, {"lost_work": 5.0})
+        assert tail is None
+
+    def test_decisions_are_counted_in_registry(self):
+        from jimm_tpu.obs import get_registry
+        reg = get_registry("jimm_train")
+        before = reg.snapshot().get("goodput_advisor_decisions_total", 0)
+        adv = GoodputAdvisor(window=1, cooldown=0, emit=lambda _: None,
+                             knobs={"save_every": 8})
+        adv.observe(0, 10.0, {"lost_work": 3.0})
+        after = reg.snapshot().get("goodput_advisor_decisions_total", 0)
+        assert after == before + 1
+
+    def test_argv_overrides_spell_train_flags(self):
+        adv, _ = _advisor()
+        flags = adv.argv_overrides()
+        assert flags[flags.index("--save-every") + 1] == "8"
+        assert flags[flags.index("--grace-steps") + 1] == "1"
+        assert flags[flags.index("--scan-unroll") + 1] == "4"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint mesh-layout metadata
+# ---------------------------------------------------------------------------
+
+class TestMeshLayout:
+    def test_layout_fingerprint(self, eight_devices):
+        import jax
+
+        from jimm_tpu.parallel.mesh import make_mesh
+        from jimm_tpu.train.checkpoint import _mesh_layout
+        assert _mesh_layout(None) is None
+        mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+        assert _mesh_layout(mesh) == {"axes": {"data": 4}, "n_devices": 4}
+
+    def test_note_mesh_change_counts_and_records(self, tmp_path,
+                                                 eight_devices):
+        import jax
+
+        from jimm_tpu import obs
+        from jimm_tpu.parallel.mesh import make_mesh
+        from jimm_tpu.train.checkpoint import CheckpointManager
+        mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+        mgr = CheckpointManager(tmp_path / "ckpt", mesh=mesh)
+        before = obs.snapshot().get(
+            "jimm_train_checkpoint_topology_changes_total", 0)
+        # same shape: not a topology change
+        mgr._note_mesh_change(3, {"axes": {"data": 4}, "n_devices": 4})
+        assert mgr.last_topology_change is None
+        # different shape: recorded + counted
+        mgr._note_mesh_change(3, {"axes": {"data": 8}, "n_devices": 8})
+        assert mgr.last_topology_change["step"] == 3
+        assert mgr.last_topology_change["saved"]["n_devices"] == 8
+        after = obs.snapshot().get(
+            "jimm_train_checkpoint_topology_changes_total", 0)
+        assert after == before + 1
+
+
+# ---------------------------------------------------------------------------
+# engine: revive / replan / self-heal
+# ---------------------------------------------------------------------------
+
+def _engine(forwards, **kw):
+    from jimm_tpu.serve import BucketTable, InferenceEngine
+    kw.setdefault("item_shape", (3,))
+    kw.setdefault("buckets", BucketTable((1, 2)))
+    kw.setdefault("max_delay_ms", 1.0)
+    return InferenceEngine(forwards, **kw)
+
+
+def _ok(x):
+    return np.asarray(x) * 2
+
+
+class _Raiser:
+    def __call__(self, x):
+        raise RuntimeError("device lost")
+
+
+async def _fence_replica(engine, index=1, tries=30):
+    """Drive traffic until the watchdog fences ``index`` (or a replan
+    already healed it)."""
+    for _ in range(tries):
+        try:
+            await engine.submit(np.ones(3, np.float32))
+        except RuntimeError:
+            pass
+        if index in engine.dead_replicas():
+            return
+        if engine.metrics.count("replans_total") > 0:
+            return
+        await asyncio.sleep(0.01)
+
+
+class TestReviveHook:
+    def test_revive_unfences_and_rearms(self):
+        engine = _engine([_ok, _ok])
+
+        async def go():
+            await engine.start()
+            try:
+                engine._replicas[1].forward = _Raiser()
+                await _fence_replica(engine)
+                assert engine.dead_replicas() == [1]
+                engine._replicas[1].forward = _ok  # lane repaired
+                row = engine.revive(1)
+                assert row["dead"] is False and row["revived"] == 1
+                assert row["restarts"] == 0, "restart budget re-armed"
+                assert engine.dead_replicas() == []
+                assert engine.metrics.count("revives_total") == 1
+                assert engine.metrics.count("replica_1_revived_total") == 1
+                for _ in range(4):
+                    out = await engine.submit(np.ones(3, np.float32))
+                    np.testing.assert_allclose(np.asarray(out), 2.0)
+            finally:
+                await engine.stop()
+
+        asyncio.run(go())
+
+    def test_revive_rejects_bad_targets(self):
+        engine = _engine([_ok, _ok])
+        with pytest.raises(ValueError, match="no replica 7"):
+            engine.revive(7)
+        with pytest.raises(ValueError, match="not fenced"):
+            engine.revive(0)
+
+    def test_server_revive_route_and_healthz(self):
+        from jimm_tpu.serve import ServingServer
+        from jimm_tpu.serve.admission import RequestError
+        engine = _engine([_ok, _ok])
+        engine._replicas[1].dead = True
+        server = ServingServer(engine, warmup=False)
+        out = server.healthz()
+        assert out["status"] == "degraded"
+        assert out["replans"] == 0
+        assert out["replicas"][1]["revived"] == 0
+        res = server.revive({"replica": 1})
+        assert res["revived"] == 1 and res["dead_replicas"] == []
+        out = server.healthz()
+        assert out["status"] == "ok"
+        assert out["replicas"][1]["revived"] == 1
+        with pytest.raises(RequestError):
+            server.revive({"replica": "one"})
+        with pytest.raises(RequestError):
+            server.revive({"replica": 5})
+
+
+class TestReplan:
+    def test_replan_grows_and_shrinks_live(self):
+        engine = _engine([_ok, _ok])
+
+        async def go():
+            await engine.start()
+            try:
+                out = await engine.submit(np.ones(3, np.float32))
+                np.testing.assert_allclose(np.asarray(out), 2.0)
+                # grow 2 -> 3
+                info = await engine.replan([_ok, _ok, _ok])
+                assert info["replicas"] == 3 and info["was_running"]
+                assert engine.n_replicas == 3
+                for _ in range(6):
+                    out = await engine.submit(np.ones(3, np.float32))
+                    np.testing.assert_allclose(np.asarray(out), 2.0)
+                # shrink 3 -> 2; ghost replica 2 gauges freeze at zero
+                await engine.replan([_ok, _ok])
+                assert engine.n_replicas == 2
+                snap = engine.metrics.snapshot()
+                assert snap["replica_2_inflight"] == 0.0
+                assert engine.metrics.count("replans_total") == 2
+                out = await engine.submit(np.ones(3, np.float32))
+                np.testing.assert_allclose(np.asarray(out), 2.0)
+            finally:
+                await engine.stop()
+
+        asyncio.run(go())
+
+    def test_replan_keeps_queued_requests(self):
+        engine = _engine([_ok])
+
+        async def go():
+            await engine.start()
+            try:
+                # enqueue while the replan swap is in flight: submissions
+                # must keep being accepted and answered by the new replicas
+                submits = [asyncio.ensure_future(
+                    engine.submit(np.ones(3, np.float32)))
+                    for _ in range(8)]
+                await engine.replan([_ok, _ok])
+                outs = await asyncio.gather(*submits)
+                for out in outs:
+                    np.testing.assert_allclose(np.asarray(out), 2.0)
+            finally:
+                await engine.stop()
+
+        asyncio.run(go())
+
+    def test_replan_warms_prepare_bucket_forwards(self):
+        calls = []
+
+        class StoreBacked:
+            def prepare_bucket(self, bucket):
+                calls.append(bucket)
+                return "aot"
+
+            def __call__(self, x):
+                return np.asarray(x) * 2
+
+        engine = _engine([_ok, _ok])
+
+        async def go():
+            await engine.start()
+            try:
+                await engine.replan([StoreBacked(), StoreBacked()])
+                # every bucket of every new forward prepared BEFORE the
+                # swap — an unprepared bucket would fall back to a fresh
+                # trace on first traffic
+                assert sorted(calls) == [1, 1, 2, 2]
+                out = await engine.submit(np.ones(3, np.float32))
+                np.testing.assert_allclose(np.asarray(out), 2.0)
+            finally:
+                await engine.stop()
+
+        asyncio.run(go())
+
+
+class TestSelfHeal:
+    def test_fence_escalates_to_replan_around(self):
+        heal_calls = []
+
+        def heal():
+            heal_calls.append(1)
+            return [_ok, _ok], lambda: 0
+
+        engine = _engine([_ok, _Raiser()])
+        engine.set_heal(heal)
+
+        async def go():
+            await engine.start()
+            try:
+                await _fence_replica(engine)
+                for _ in range(100):
+                    if engine.metrics.count("replans_total") >= 1:
+                        break
+                    await asyncio.sleep(0.01)
+                assert heal_calls == [1]
+                assert engine.metrics.count("replans_total") == 1
+                assert engine.dead_replicas() == []
+                assert engine.n_replicas == 2
+                for _ in range(6):
+                    out = await engine.submit(np.ones(3, np.float32))
+                    np.testing.assert_allclose(np.asarray(out), 2.0)
+            finally:
+                await engine.stop()
+
+        asyncio.run(go())
+
+    def test_transient_fault_revives_in_place(self):
+        heal_calls = []
+        flaky = {"fails": 0}
+
+        def sometimes(x):
+            # fails exactly twice (restart, then fence), then recovers —
+            # the heal probe finds a working lane and revives it without
+            # a rebuild
+            if flaky["fails"] < 2:
+                flaky["fails"] += 1
+                raise RuntimeError("transient")
+            return np.asarray(x) * 2
+
+        def heal():
+            heal_calls.append(1)
+            return [_ok, _ok], None
+
+        engine = _engine([_ok, sometimes])
+        engine.set_heal(heal)
+
+        async def go():
+            await engine.start()
+            try:
+                await _fence_replica(engine)
+                for _ in range(100):
+                    if engine.metrics.count("revives_total") >= 1:
+                        break
+                    await asyncio.sleep(0.01)
+                assert engine.metrics.count("revives_total") == 1
+                assert heal_calls == [], \
+                    "a lane that probes healthy must not trigger a rebuild"
+                assert engine.dead_replicas() == []
+            finally:
+                await engine.stop()
+
+        asyncio.run(go())
+
+    def test_failed_heal_is_counted_not_fatal(self):
+        def heal():
+            raise OSError("store unreachable")
+
+        engine = _engine([_ok, _Raiser()])
+        engine.set_heal(heal)
+
+        async def go():
+            await engine.start()
+            try:
+                await _fence_replica(engine)
+                for _ in range(100):
+                    if engine.metrics.count("heal_failures_total") >= 1:
+                        break
+                    await asyncio.sleep(0.01)
+                assert engine.metrics.count("heal_failures_total") == 1
+                assert "store unreachable" in engine.last_heal_error
+                # degraded but serving: the live lane still answers
+                out = await engine.submit(np.ones(3, np.float32))
+                np.testing.assert_allclose(np.asarray(out), 2.0)
+            finally:
+                await engine.stop()
+
+        asyncio.run(go())
