@@ -64,6 +64,14 @@ def _configure_backend(args: argparse.Namespace) -> None:
         initialize_distributed()
 
 
+def _configure_journal(args: argparse.Namespace) -> None:
+    """Point the process-wide flight-recorder journal at ``--journal PATH``
+    (no flag: in-memory ring only, or the JIMM_JOURNAL env default)."""
+    if getattr(args, "journal", None):
+        from jimm_tpu.obs.journal import configure_journal
+        configure_journal(args.journal)
+
+
 def _parse_mesh(spec: str | None, max_devices: int | None = None):
     """``"data=4,model=2"`` -> Mesh (None -> no mesh: replicated 1-device).
 
@@ -324,6 +332,7 @@ def _batch_fingerprint(batch) -> int:
 
 def cmd_train(args: argparse.Namespace) -> int:
     _configure_backend(args)
+    _configure_journal(args)
     if args.compilation_cache_dir:
         # persistent XLA compile cache: restarted runs (preemption,
         # resume, sweep retries) skip straight past the train-step compile
@@ -785,6 +794,7 @@ def cmd_supervise(args: argparse.Namespace) -> int:
     attempt's flags. Without these flags, behavior is byte-identical to the
     static supervise loop."""
     from jimm_tpu.resilience import BackoffPolicy, GiveUpError, Supervisor
+    _configure_journal(args)
     cmd = list(args.train_args or [])
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
@@ -860,8 +870,14 @@ def cmd_supervise(args: argparse.Namespace) -> int:
             if (elastic_state["last_k"] is not None
                     and k != elastic_state["last_k"]):
                 from jimm_tpu.obs import get_registry
+                from jimm_tpu.obs.journal import get_journal
                 get_registry("jimm_train").counter(
                     "topology_changes_total").inc()
+                # runs inside the supervisor's correlate(incident) scope,
+                # so the replan joins the preemption/crash chain ambiently
+                get_journal().emit("mesh_replanned", attempt=i + 1,
+                                   data_from=elastic_state["last_k"],
+                                   data_to=k, devices=avail)
                 print(f"[supervise] attempt {i + 1}: replanned mesh "
                       f"data={elastic_state['last_k']} -> data={k} "
                       f"({avail} devices available)")
@@ -1515,6 +1531,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     control. ``/healthz`` and ``/metrics`` report engine state.
     """
     _configure_backend(args)
+    _configure_journal(args)
     import json
     import time
 
@@ -1614,6 +1631,11 @@ def cmd_serve(args: argparse.Namespace) -> int:
                              buckets=buckets,
                              max_delay_ms=args.max_delay_ms, policy=policy,
                              trace_count=trace_count, qos=qos)
+    if qos is not None and qos.registry.slo:
+        # the policy's slo section -> per-tenant burn-rate tracking; a
+        # fast burn escalates into the self-heal path and flips /healthz
+        from jimm_tpu.obs.slo import SloEngine
+        engine.attach_slo(SloEngine.from_objective_dicts(qos.registry.slo))
     if args.self_heal:
         if plan.is_trivial:
             raise SystemExit("--self-heal needs a replica topology "
@@ -1715,6 +1737,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         ready["qos"] = {"policy": args.qos_policy,
                         "classes": list(qos.registry.class_order),
                         "tenants": sorted(qos.registry.tenants)}
+        if qos.registry.slo:
+            ready["qos"]["slo"] = sorted(qos.registry.slo)
     if pool is not None:
         ready["models"] = pool.describe()
     if not plan.is_trivial:
@@ -1877,6 +1901,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write TensorBoard scalar events here")
     sp.add_argument("--profile-dir", default=None,
                     help="capture a jax.profiler trace of steps 2-4 here")
+    sp.add_argument("--journal", default=None, metavar="FILE",
+                    help="persist flight-recorder events (preemption, "
+                         "checkpoint, reshard) to this rotating JSONL "
+                         "journal")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_train)
 
@@ -1904,6 +1932,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "breakdowns and carry its bounded knob decisions "
                          "(--save-every/--grace-steps/--scan-unroll) into "
                          "the next attempt")
+    sp.add_argument("--journal", default=None, metavar="FILE",
+                    help="persist flight-recorder events (attempts, "
+                         "restarts, replans, advisor decisions) to this "
+                         "rotating JSONL journal — one correlated incident "
+                         "chain per failure")
     sp.add_argument("train_args", nargs=argparse.REMAINDER,
                     help="-- train --preset ... --ckpt-dir ...")
     sp.set_defaults(fn=cmd_supervise)
@@ -2135,6 +2168,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "f32), warm its own engine + AOT fingerprint, and "
                          "route requests naming model=NAME to it; inherits "
                          "--tiny/--buckets/--aot-store")
+    sp.add_argument("--journal", default=None, metavar="FILE",
+                    help="persist flight-recorder events (replica faults, "
+                         "fences, heals, replans, SLO burns) to this "
+                         "rotating JSONL journal")
     _add_backend_flags(sp)
     sp.set_defaults(fn=cmd_serve)
 

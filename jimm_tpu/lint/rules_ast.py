@@ -80,6 +80,16 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   map unknown ids onto one shared default slot, or give the table an
   eviction path; deliberate bounded tables carry a
   ``# jaxlint: disable=JL014`` justification. Tests are exempt.
+- **JL015** structured event emitted as a bare ``print(json.dumps(...))``
+  (or a print concatenating/formatting a ``json.dumps`` result) in
+  ``serve/``, ``train/``, or ``resilience/`` code — ad-hoc JSON on stdout
+  has no sequence number, no timestamp, no correlation id, and no
+  crash-safe file behind it, so the incident chain the flight recorder
+  reconstructs (fault → fence → heal → replan) silently loses the event.
+  Emit through ``jimm_tpu.obs.journal`` instead; CLI entry points
+  (``cli.py``/``__main__.py``/``launch.py``) keep their sanctioned
+  parseable ready-lines, and deliberate console sinks carry a
+  ``# jaxlint: disable=JL015`` justification. Tests are exempt.
 """
 
 from __future__ import annotations
@@ -1094,6 +1104,50 @@ def check_unbounded_tenant_table(tree: ast.AST, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL015 — journal bypass: print(json.dumps(...)) structured-event emission
+# ---------------------------------------------------------------------------
+
+def _is_json_dumps_call(node: ast.AST) -> bool:
+    """``json.dumps(...)`` / ``_json.dumps(...)`` / bare ``dumps(...)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "dumps"
+    return isinstance(fn, ast.Name) and fn.id == "dumps"
+
+
+def check_journal_bypass(tree: ast.AST, path: str) -> list[Finding]:
+    """JL015: in serve/train/resilience code, a structured event printed
+    as ad-hoc JSON bypasses the flight recorder. The journal exists so an
+    incident reads back as ONE correlated chain — seq, timestamps, cid —
+    from a crash-safe rotating file; a ``print(json.dumps({...}))`` emits
+    the same fact as an orphan line only a console scraper can find.
+    Walking the print's argument subtrees catches the concatenation and
+    f-string spellings too (``print("x: " + json.dumps(d))``)."""
+    if not _path_is_resilient(path) or _path_is_test(path):
+        return []
+    base = path.replace("\\", "/").rsplit("/", 1)[-1]
+    if base in PRINT_EXEMPT_BASENAMES:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            continue
+        if any(_is_json_dumps_call(sub) for arg in node.args
+               for sub in ast.walk(arg)):
+            findings.append(Finding(
+                "JL015", ERROR, path, node.lineno,
+                "structured event printed as ad-hoc JSON — this bypasses "
+                "the flight-recorder journal (no seq/ts/cid, not crash-"
+                "safe), orphaning the event from its incident chain; emit "
+                "via jimm_tpu.obs.journal (get_journal().emit(...)) or "
+                "justify with # jaxlint: disable=JL015"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -1113,4 +1167,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_quant_upcast(tree, path)
     findings += check_swallowed_exception(tree, path)
     findings += check_unbounded_tenant_table(tree, path)
+    findings += check_journal_bypass(tree, path)
     return findings

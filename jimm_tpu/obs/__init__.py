@@ -17,22 +17,36 @@ Disable all optional instrumentation with ``JIMM_OBS=0`` (or
 registries keep counting (serve counters are product behavior).
 """
 
+from jimm_tpu.obs.baseline import (BaselineStore, check_rows, is_fallback,
+                                   row_key)
 from jimm_tpu.obs.exporters import (JsonlExporter, console_table,
                                     diff_snapshots, parse_prometheus_text,
                                     render_prometheus_text)
 from jimm_tpu.obs.goodput import BUCKETS, GoodputAccounter
+from jimm_tpu.obs.journal import (EventJournal, chain, configure_journal,
+                                  correlate, current_cid, get_journal,
+                                  new_correlation_id, read_events,
+                                  reset_journal)
 from jimm_tpu.obs.registry import (Counter, DuplicateMetricError, Gauge,
                                    Histogram, MetricRegistry, enabled,
                                    get_registry, percentile, publish,
                                    registries, render_prometheus,
                                    set_enabled, snapshot, unpublish)
+from jimm_tpu.obs.slo import SloEngine, SloObjective
 from jimm_tpu.obs.spans import new_trace_id, span
+from jimm_tpu.obs.timeline import (export_timeline, validate_chrome_trace,
+                                   write_timeline)
 
 __all__ = [
-    "BUCKETS", "Counter", "DuplicateMetricError", "Gauge", "GoodputAccounter",
-    "Histogram", "JsonlExporter", "MetricRegistry", "console_table",
-    "diff_snapshots", "enabled", "get_registry", "new_trace_id",
-    "parse_prometheus_text", "percentile", "publish", "registries",
-    "render_prometheus", "render_prometheus_text", "set_enabled", "snapshot",
-    "span", "unpublish",
+    "BUCKETS", "BaselineStore", "Counter", "DuplicateMetricError",
+    "EventJournal", "Gauge", "GoodputAccounter", "Histogram",
+    "JsonlExporter", "MetricRegistry", "SloEngine", "SloObjective", "chain",
+    "check_rows", "configure_journal", "console_table", "correlate",
+    "current_cid", "diff_snapshots", "enabled", "export_timeline",
+    "get_journal", "get_registry", "is_fallback", "new_correlation_id",
+    "new_trace_id", "parse_prometheus_text", "percentile", "publish",
+    "read_events", "registries", "render_prometheus",
+    "render_prometheus_text", "reset_journal", "row_key", "set_enabled",
+    "snapshot", "span", "unpublish", "validate_chrome_trace",
+    "write_timeline",
 ]

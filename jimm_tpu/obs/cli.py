@@ -1,15 +1,22 @@
-"""``jimm-tpu obs`` — tail, snapshot, and diff metric dumps.
+"""``jimm-tpu obs`` — tail, snapshot, diff, timeline, and regress.
 
-Three verbs over the exporter formats (stdlib only, no jax import):
+Five verbs over the exporter formats (stdlib only, no jax import):
 
 - ``snapshot`` — fetch a ``/metrics`` endpoint (or read a saved dump) and
   print it as a console table, JSON, or raw Prometheus text; ``-o`` saves
   the parsed snapshot as JSON for a later ``diff``.
 - ``tail``     — follow a MEASUREMENTS.jsonl-style ledger (``tail -f`` with
   JSON pretty-keys), or poll a ``/metrics`` URL and print only the series
-  that changed between polls.
+  that changed between polls; ``--traces`` polls a serving server's
+  ``/debug/traces`` ring and prints each request's phase decomposition.
 - ``diff``     — structural diff of two dumps (JSON snapshot or Prometheus
   text, auto-detected): added / removed / changed with deltas.
+- ``timeline`` — merge a flight-recorder journal (plus optional serve
+  traces and a goodput report) into Chrome trace-event JSON loadable in
+  Perfetto / ``chrome://tracing``.
+- ``regress``  — gate fresh MEASUREMENTS.jsonl rows against adopted
+  per-(workload,backend,preset) baselines; fallback rows are excluded
+  from comparison and ``--adopt`` records new baselines.
 
 Wired as a subparser under the main ``jimm-tpu`` CLI (see jimm_tpu/cli.py).
 """
@@ -100,7 +107,60 @@ def _tail_url(url: str, interval_s: float) -> int:
         time.sleep(interval_s)
 
 
+def _trace_line(row: dict) -> str:
+    phases = " ".join(
+        f"{p[:-2]}={row.get(p, 0.0) * 1e3:.2f}ms"
+        for p in ("queue_s", "pad_s", "device_s", "readback_s")
+        if isinstance(row.get(p), (int, float)))
+    total = row.get("total_s")
+    total_txt = f" total={total * 1e3:.2f}ms" \
+        if isinstance(total, (int, float)) else ""
+    return (f"{row.get('trace_id', '?')} replica={row.get('replica', '?')} "
+            f"bucket={row.get('bucket', '?')} {phases}{total_txt}")
+
+
+def _load_trace_rows(source: str) -> list[dict]:
+    """Rows from a ``/debug/traces`` endpoint or a saved JSON dump."""
+    if source.startswith(("http://", "https://")):
+        url = source if source.endswith("/debug/traces") \
+            else source.rstrip("/") + "/debug/traces"
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            data = json.loads(resp.read().decode("utf-8"))
+    else:
+        with open(source) as f:
+            data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get("traces", [])
+    return [r for r in data if isinstance(r, dict)]
+
+
+def _tail_traces(source: str, interval_s: float, follow: bool) -> int:
+    seen: set = set()
+    while True:
+        try:
+            rows = _load_trace_rows(source)
+        except OSError as e:
+            print(f"# fetch failed: {e}", file=sys.stderr, flush=True)
+            rows = []
+        for row in rows:
+            tid = row.get("trace_id")
+            if tid in seen:
+                continue
+            seen.add(tid)
+            print(_trace_line(row), flush=True)
+        if len(seen) > 4096:  # ring is small; cap the dedup set anyway
+            seen = set(r.get("trace_id") for r in rows)
+        if not follow and not source.startswith(("http://", "https://")):
+            return 0
+        time.sleep(interval_s)
+
+
 def _cmd_tail(args) -> int:
+    if args.traces:
+        try:
+            return _tail_traces(args.source, args.interval, args.follow)
+        except KeyboardInterrupt:
+            return 0
     if args.source.startswith(("http://", "https://")):
         try:
             return _tail_url(args.source, args.interval)
@@ -131,6 +191,97 @@ def _cmd_diff(args) -> int:
     return 1 if (d["added"] or d["removed"] or d["changed"]) else 0
 
 
+def _cmd_timeline(args) -> int:
+    from jimm_tpu.obs.journal import read_events
+    from jimm_tpu.obs.timeline import (export_timeline,
+                                       validate_chrome_trace,
+                                       write_timeline)
+
+    events = read_events(args.journal)
+    traces = _load_trace_rows(args.traces) if args.traces else []
+    goodput = None
+    if args.goodput:
+        with open(args.goodput) as f:
+            report = json.load(f)
+        # accept either a raw {bucket: seconds} map or a goodput report
+        # with {bucket}_s keys
+        goodput = {k[:-2]: v for k, v in report.items()
+                   if k.endswith("_s") and isinstance(v, (int, float))} \
+            or {k: v for k, v in report.items()
+                if isinstance(v, (int, float))}
+    trace = export_timeline(events, traces=traces, goodput=goodput,
+                            meta={"journal": str(args.journal)})
+    problems = validate_chrome_trace(trace)
+    if problems:
+        for p in problems:
+            print(f"invalid trace: {p}", file=sys.stderr)
+        return 1
+    out = args.out or "timeline.json"
+    write_timeline(out, trace)
+    n = sum(1 for e in trace["traceEvents"] if e.get("ph") != "M")
+    print(f"wrote {out}: {n} events from {len(events)} journal records"
+          f" + {len(traces)} serve traces"
+          f" (open in Perfetto or chrome://tracing)")
+    return 0
+
+
+def _cmd_regress(args) -> int:
+    from jimm_tpu.obs.baseline import (BaselineStore, check_rows, is_fallback,
+                                       summarize)
+
+    rows = []
+    with open(args.measurements) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                rows.append(rec)
+    store = BaselineStore(args.baselines)
+    if args.adopt:
+        adopted = store.adopt_rows(rows, note=args.note)
+        store.save()
+        print(f"adopted {len(adopted)} baseline(s) into {args.baselines}")
+        for name in adopted:
+            print(f"  + {name}")
+        return 0
+    verdicts = check_rows(store, rows, threshold=args.threshold)
+    counts = summarize(verdicts)
+    if args.json:
+        print(json.dumps({"verdicts": verdicts, "summary": counts},
+                         indent=2))
+    else:
+        for v in verdicts:
+            if v["status"] == "fallback_excluded":
+                print(f"! {v['key']}: fallback row excluded from gating")
+            elif v["status"] == "no_baseline":
+                print(f"? {v['key']} {v['metric']}={v['fresh']} "
+                      f"(no baseline; run with --adopt)")
+            else:
+                mark = {"ok": "=", "improved": "+",
+                        "regression": "REGRESSION"}[v["status"]]
+                print(f"{mark} {v['key']} {v['metric']}: {v['fresh']} vs "
+                      f"baseline {v['baseline']} "
+                      f"({v['delta_frac']:+.1%})")
+        print(f"summary: {counts['ok']} ok, {counts['improved']} improved, "
+              f"{counts['regression']} regression(s), "
+              f"{counts['no_baseline']} unbaselined, "
+              f"{counts['fallback_excluded']} fallback-excluded "
+              f"(threshold {args.threshold:.0%})")
+    if counts["regression"]:
+        return 1
+    if args.fail_on_fallback and counts["fallback_excluded"]:
+        n_real = sum(1 for r in rows if not is_fallback(r))
+        print(f"fallback rows present ({counts['fallback_excluded']}) with "
+              f"--fail-on-fallback ({n_real} real rows)", file=sys.stderr)
+        return 1
+    return 0
+
+
 def add_obs_parser(subparsers) -> None:
     """Attach the ``obs`` subcommand tree to the main CLI's subparsers."""
     p = subparsers.add_parser(
@@ -157,6 +308,9 @@ def add_obs_parser(subparsers) -> None:
                     help="keep following a JSONL file (tail -f)")
     pt.add_argument("--interval", type=float, default=2.0,
                     help="poll interval for URLs (seconds)")
+    pt.add_argument("--traces", action="store_true",
+                    help="tail the serve request-trace ring "
+                         "(/debug/traces) instead of metric series")
     pt.set_defaults(obs_func=_cmd_tail)
 
     pd = sub.add_parser("diff", help="diff two metric dumps")
@@ -164,6 +318,36 @@ def add_obs_parser(subparsers) -> None:
     pd.add_argument("after")
     pd.add_argument("--json", action="store_true")
     pd.set_defaults(obs_func=_cmd_diff)
+
+    px = sub.add_parser(
+        "timeline",
+        help="export a flight-recorder journal as Chrome trace JSON")
+    px.add_argument("journal", help="journal.jsonl path (rotated segments "
+                                    "are merged automatically)")
+    px.add_argument("-o", "--out", default=None,
+                    help="output path (default timeline.json)")
+    px.add_argument("--traces", default=None,
+                    help="serve traces: /debug/traces URL or saved JSON")
+    px.add_argument("--goodput", default=None,
+                    help="goodput report JSON to render as a bucket lane")
+    px.set_defaults(obs_func=_cmd_timeline)
+
+    pr = sub.add_parser(
+        "regress",
+        help="gate MEASUREMENTS.jsonl rows against adopted baselines")
+    pr.add_argument("--measurements", default="MEASUREMENTS.jsonl")
+    pr.add_argument("--baselines", default="BASELINES.json")
+    pr.add_argument("--threshold", type=float, default=0.20,
+                    help="max tolerated fractional regression (0.20 = 20%%)")
+    pr.add_argument("--adopt", action="store_true",
+                    help="adopt the rows' metrics as new baselines "
+                         "instead of gating")
+    pr.add_argument("--note", default=None,
+                    help="provenance note stored with adopted baselines")
+    pr.add_argument("--fail-on-fallback", action="store_true",
+                    help="exit nonzero when fallback rows are present")
+    pr.add_argument("--json", action="store_true")
+    pr.set_defaults(obs_func=_cmd_regress)
 
 
 def cmd_obs(args) -> int:

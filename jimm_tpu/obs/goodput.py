@@ -20,6 +20,10 @@ Buckets (the fixed vocabulary the docs and CI smoke assert on):
 - ``lost_work``  — wall time a preemption/restart discarded (grace-window
                    steps whose results are thrown away, work since the
                    last committed checkpoint on a crash)
+- ``replan``     — live topology replans: mesh re-planning between
+                   supervised attempts, serve-engine replica swaps
+- ``heal``       — self-heal wall time: probe + rebuild around a fenced
+                   replica (the replan it triggers books separately)
 - ``other``      — residual wall time not covered by a measure() region
 
 MFU-adjusted goodput = goodput × MFU: the fraction of *peak hardware* FLOPs
@@ -38,7 +42,7 @@ from jimm_tpu.obs.registry import MetricRegistry, enabled, get_registry
 __all__ = ["BUCKETS", "GoodputAccounter"]
 
 BUCKETS = ("compile", "data_wait", "step", "checkpoint", "host_sync",
-           "preemption_save", "lost_work")
+           "preemption_save", "lost_work", "replan", "heal")
 
 
 class GoodputAccounter:

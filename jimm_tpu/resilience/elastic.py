@@ -192,15 +192,17 @@ class GoodputAdvisor:
         return None
 
     def _apply(self, decision: dict) -> None:
+        from jimm_tpu.obs.journal import get_journal
         self.knobs[decision["knob"]] = decision["to"]
         self.decisions.append(decision)
         self._counter.inc()
         self._since_decision = 0
-        line = "goodput_advisor_decision: " + json.dumps(decision)
+        # the audit trail: journaled (joining the active incident's chain
+        # when one is ambient), echoed as the legacy parseable line only
+        # for injected sinks (tests, supervise transcripts)
+        get_journal().emit("advisor_decision", **decision)
         if self._emit is not None:
-            self._emit(line)
-        else:
-            print(line, flush=True)  # jaxlint: disable=JL007 — operator-facing adaptation audit line (parseable, mirrors the supervisor's restart narration)
+            self._emit("goodput_advisor_decision: " + json.dumps(decision))
 
     # -- handoff ----------------------------------------------------------
 

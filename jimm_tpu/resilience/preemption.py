@@ -16,6 +16,8 @@ import signal
 import threading
 import time
 
+from jimm_tpu.obs.journal import get_journal, new_correlation_id
+
 __all__ = ["PreemptedError", "PreemptionGuard", "PreemptionHandler"]
 
 
@@ -24,15 +26,19 @@ class PreemptedError(RuntimeError):
     ``--resume`` rerun continues at ``step + 1``. ``lost_seconds`` is the
     wall time spent on grace-window steps whose results the restart
     discards (plus the final save flush) — the goodput ``lost_work``
-    bucket carries the same number."""
+    bucket carries the same number. ``cid`` is the flight-recorder
+    correlation id minted at detection; the supervisor threads it through
+    the restart so the whole preempt→save→restore→reshard chain shares
+    one id in the journal."""
 
     def __init__(self, step: int, *, grace_steps: int = 0,
-                 lost_seconds: float = 0.0):
+                 lost_seconds: float = 0.0, cid: str | None = None):
         super().__init__(f"preempted: state saved at step {step}; "
                          f"resume with --resume")
         self.step = step
         self.grace_steps = grace_steps
         self.lost_seconds = lost_seconds
+        self.cid = cid
 
 
 class PreemptionGuard:
@@ -102,6 +108,8 @@ class PreemptionHandler:
         self.save_step: int | None = None
         self._steps_after = 0
         self._t_detected: float | None = None
+        #: incident correlation id, minted at detection (see PreemptedError)
+        self.cid: str | None = None
 
     @property
     def draining(self) -> bool:
@@ -121,7 +129,10 @@ class PreemptionHandler:
         if self.save_step is None:
             self._t_detected = time.monotonic()
             self.save_step = step
+            self.cid = new_correlation_id()
             self.registry.counter("preemptions_total").inc()
+            get_journal().emit("preempt_detected", cid=self.cid, step=step,
+                               grace_steps=self.grace_steps)
             self._timed_save(step, model, optimizer, extra, already_saved)
             if self.grace_steps > 0:
                 return  # overlap the async write with the next steps
@@ -139,19 +150,27 @@ class PreemptionHandler:
             if not already_saved:
                 self.ckpt.save(step, model, optimizer, extra=extra,
                                force=True)
+        dt = time.perf_counter() - t0
         if self.accounter is not None:
-            self.accounter.add("preemption_save", time.perf_counter() - t0)
+            self.accounter.add("preemption_save", dt)
+        get_journal().emit("grace_save_started", cid=self.cid, step=step,
+                           adopted=bool(already_saved), dur_s=round(dt, 6))
 
     def _finish(self) -> None:
         from jimm_tpu.obs import span
         t0 = time.perf_counter()
         with span("preemption_save"):
             self.ckpt.wait()
+        dt = time.perf_counter() - t0
         if self.accounter is not None:
-            self.accounter.add("preemption_save", time.perf_counter() - t0)
+            self.accounter.add("preemption_save", dt)
         self.ckpt.close()  # flushes the completion marker
         lost = time.monotonic() - self._t_detected
         if self.accounter is not None:
             self.accounter.add("lost_work", lost)
+        get_journal().emit("grace_save_committed", cid=self.cid,
+                           step=self.save_step,
+                           grace_steps=self._steps_after,
+                           lost_s=round(lost, 4), dur_s=round(dt, 6))
         raise PreemptedError(self.save_step, grace_steps=self._steps_after,
-                             lost_seconds=lost)
+                             lost_seconds=lost, cid=self.cid)

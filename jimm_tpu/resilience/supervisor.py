@@ -20,6 +20,7 @@ from __future__ import annotations
 import time
 from typing import Callable
 
+from jimm_tpu.obs.journal import correlate, get_journal, new_correlation_id
 from jimm_tpu.resilience.backoff import BackoffPolicy
 from jimm_tpu.resilience.preemption import PreemptedError
 
@@ -70,20 +71,32 @@ class Supervisor:
         self.history: list[str] = []
 
     def run(self, attempt_fn: Callable[[int, bool], int]) -> int:
+        journal = get_journal()
+        # correlation id of the incident currently being recovered from:
+        # minted when an attempt fails, inherited by everything the
+        # restarted attempt does (restore, reshard, advisor decisions)
+        # via the ambient correlate() context.
+        incident: str | None = None
         for attempt in range(self.max_restarts + 1):
             t0 = time.monotonic()
             lost: float | None = None
+            cid: str | None = None
             try:
-                rc = attempt_fn(attempt, attempt > 0)
+                with correlate(incident):
+                    rc = attempt_fn(attempt, attempt > 0)
             except PreemptedError as e:
                 failure = str(e)
                 lost = 0.0  # the grace window already booked its lost work
+                cid = getattr(e, "cid", None)
             except KeyboardInterrupt:
                 raise  # operator stop is not a failure to retry
             except Exception as e:  # worker death: restartable by design
                 failure = f"{type(e).__name__}: {e}"
             else:
                 if rc == 0:
+                    if incident is not None:
+                        journal.emit("supervise_recovered", cid=incident,
+                                     attempt=attempt)
                     return 0
                 failure = f"exit code {rc}"
             if lost is None:
@@ -93,7 +106,12 @@ class Supervisor:
                 base = since if since is not None and since >= t0 else t0
                 lost = time.monotonic() - base
             self.history.append(failure)
+            incident = cid or incident or new_correlation_id()
+            journal.emit("attempt_failed", cid=incident, attempt=attempt,
+                         failure=failure, lost_s=round(lost, 4))
             if attempt >= self.max_restarts:
+                journal.emit("supervise_gave_up", cid=incident,
+                             attempts=attempt + 1, failure=failure)
                 raise GiveUpError(
                     f"giving up after {self.max_restarts} restarts "
                     f"({attempt + 1} attempts); last failure: {failure}")
@@ -103,6 +121,8 @@ class Supervisor:
                 self.registry.counter(
                     "goodput_lost_work_seconds_total").inc(lost)
             delay = self.backoff.delay(attempt)
+            journal.emit("restart", cid=incident, attempt=attempt + 1,
+                         backoff_s=round(delay, 4), failure=failure)
             print(  # jaxlint: disable=JL007 — operator-facing restart narration
                 f"[supervise] attempt {attempt + 1} failed ({failure}); "
                 f"restarting in {delay:.2f}s")

@@ -38,6 +38,7 @@ from typing import Callable
 
 import numpy as np
 
+from jimm_tpu.obs.journal import get_journal, new_correlation_id
 from jimm_tpu.obs.spans import new_trace_id, span
 from jimm_tpu.serve.admission import (AdmissionController, AdmissionPolicy,
                                       DeadlineExceededError, EngineClosedError,
@@ -95,7 +96,8 @@ class _Replica:
     operator/self-heal un-fencings (each one re-arms the free restart)."""
 
     __slots__ = ("index", "forward", "name", "pool", "inflight",
-                 "dispatched", "device_s", "restarts", "dead", "revived")
+                 "dispatched", "device_s", "restarts", "dead", "revived",
+                 "incident_cid")
 
     def __init__(self, index: int, forward: Callable, name: str):
         self.index = index
@@ -109,6 +111,10 @@ class _Replica:
         self.restarts = 0
         self.dead = False
         self.revived = 0
+        # flight-recorder correlation id of the incident this replica is
+        # currently the subject of (minted at the first fault, cleared on
+        # revive) — every fence/probe/heal/replan event inherits it
+        self.incident_cid: str | None = None
 
 
 class InferenceEngine:
@@ -210,6 +216,10 @@ class InferenceEngine:
         self._replan_lock = asyncio.Lock()
         #: repr of the last failed self-heal attempt (healthz debugging)
         self.last_heal_error: str | None = None
+        # SLO burn-rate engine (attach_slo): fed one observation per
+        # finished request; fast-burn escalates into the self-heal path
+        self.slo = None
+        self._slo_burning: set = set()
         # Per-request phase decomposition (trace id -> phase seconds),
         # newest last; read by /healthz debugging and tests.
         self.recent_traces: deque[dict] = deque(maxlen=64)
@@ -259,6 +269,8 @@ class InferenceEngine:
         replica that fails again after its restart is fenced off — unless
         it is the last live lane, which keeps serving (and erroring
         loudly) rather than leaving the engine with nothing to pick."""
+        if replica.incident_cid is None:
+            replica.incident_cid = new_correlation_id()
         if replica.restarts == 0:
             replica.pool.shutdown(wait=False)
             replica.pool = ThreadPoolExecutor(max_workers=1,
@@ -266,15 +278,24 @@ class InferenceEngine:
             replica.restarts += 1
             if self._multi:
                 self.metrics.inc(f"replica_{replica.index}_restarts_total")
+            get_journal().emit("replica_fault", cid=replica.incident_cid,
+                               replica=replica.index, action="restart")
             return
         live = [r for r in self._replicas if not r.dead]
         if len(live) > 1:
             replica.dead = True
             if self._multi:
                 self.metrics.inc(f"replica_{replica.index}_dead_total")
+            get_journal().emit("replica_fenced", cid=replica.incident_cid,
+                               replica=replica.index,
+                               live=len(live) - 1)
             # fence -> attempt-revive -> replan-around: with a heal hook
             # installed the fence is an escalation step, not a terminus
             self._maybe_heal(replica)
+        else:
+            get_journal().emit("replica_fault", cid=replica.incident_cid,
+                               replica=replica.index, action="last_lane",
+                               live=len(live))
 
     def revive(self, index: int) -> dict:
         """Operator hook: un-fence a watchdog-dead replica — fresh executor,
@@ -298,9 +319,47 @@ class InferenceEngine:
         if self._multi:
             self.metrics.inc(f"replica_{index}_revived_total")
             self.metrics.inc("revives_total")
+        get_journal().emit("replica_revived", cid=replica.incident_cid,
+                           replica=index, revived=replica.revived)
+        replica.incident_cid = None  # incident closed
         return self.replica_stats()[index]
 
     # -- self-heal / live replan ------------------------------------------
+
+    def attach_slo(self, slo) -> None:
+        """Install an :class:`~jimm_tpu.obs.slo.SloEngine`: every finished
+        request (success, forward error, deadline timeout) becomes one
+        per-tenant availability/latency observation, and a tenant entering
+        fast burn escalates into the self-heal path (see
+        :meth:`_slo_check_escalate`)."""
+        self.slo = slo
+
+    def _observe_slo(self, req, ok: bool, latency_s: float | None) -> None:
+        if self.slo is None:
+            return
+        tenant = req.tenant.spec.name if req.tenant is not None else None
+        self.slo.observe(tenant, ok, latency_s)
+
+    def _slo_check_escalate(self) -> None:
+        """Called after bad observations: when a tenant *enters* fast burn
+        (multi-window guard inside the SLO engine), journal the escalation
+        and kick the self-heal watchdog at the first fenced replica — the
+        burn is the symptom, a dead lane is the usual cause."""
+        if self.slo is None:
+            return
+        burning = set(self.slo.fast_burning())
+        newly = burning - self._slo_burning
+        self._slo_burning = burning
+        if not newly:
+            return
+        dead = [r for r in self._replicas if r.dead]
+        cid = dead[0].incident_cid if dead else None
+        get_journal().emit("slo_fast_burn", cid=cid,
+                           tenants=sorted(newly),
+                           dead_replicas=[r.index for r in dead])
+        self.metrics.inc("slo_fast_burn_total")
+        if dead:
+            self._maybe_heal(dead[0])
 
     def set_heal(self, factory: Callable) -> None:
         """Install the self-heal hook: a *blocking* zero-arg factory that
@@ -327,20 +386,36 @@ class InferenceEngine:
 
     async def _heal_around(self, replica: _Replica) -> None:
         loop = asyncio.get_running_loop()
+        cid = replica.incident_cid
+        t_heal = time.perf_counter()
         ok = await loop.run_in_executor(None, self._probe_blocking, replica)
+        get_journal().emit("heal_probe", cid=cid, replica=replica.index,
+                           ok=ok)
         if ok:
             # the fault was transient (wedged thread, recovered device):
             # the lane still computes, so un-fence it in place
             self.revive(replica.index)
+            self.metrics.inc("goodput_heal_seconds_total",
+                             time.perf_counter() - t_heal)
             return
         try:
             built = await loop.run_in_executor(None, self._heal)
         except Exception as e:  # noqa: BLE001 — a failed heal must never kill the loop; it is counted and surfaced, and the engine keeps serving degraded
             self.metrics.inc("heal_failures_total")
             self.last_heal_error = f"{type(e).__name__}: {e}"
+            self.metrics.inc("goodput_heal_seconds_total",
+                             time.perf_counter() - t_heal)
+            get_journal().emit("heal_failed", cid=cid,
+                               replica=replica.index,
+                               error=self.last_heal_error)
             return
         forwards, trace_count = self._normalize_built(built)
-        await self.replan(forwards, trace_count=trace_count)
+        heal_s = time.perf_counter() - t_heal
+        # heal bucket = probe + rebuild; the replan books its own bucket
+        self.metrics.inc("goodput_heal_seconds_total", heal_s)
+        get_journal().emit("heal_rebuilt", cid=cid, replica=replica.index,
+                           replicas=len(forwards), dur_s=round(heal_s, 6))
+        await self.replan(forwards, trace_count=trace_count, cid=cid)
 
     @staticmethod
     def _normalize_built(built):
@@ -364,7 +439,8 @@ class InferenceEngine:
         return True
 
     async def replan(self, forward, *, trace_count: Callable[[], int]
-                     | None = None, warm: bool = True) -> dict:
+                     | None = None, warm: bool = True,
+                     cid: str | None = None) -> dict:
         """Swap the live replica set for a new one — grow, shrink, or heal —
         without dropping queued work.
 
@@ -375,11 +451,20 @@ class InferenceEngine:
         sentinel and drain in-flight dispatches (their futures resolve
         normally); (3) swap replicas/semaphore/gauges; (4) restart the
         batcher. ``submit()`` keeps accepting throughout — queued requests
-        ride through the swap and dispatch onto the new topology."""
+        ride through the swap and dispatch onto the new topology.
+
+        ``cid`` threads the triggering incident's flight-recorder
+        correlation id (the self-heal path passes the fenced replica's);
+        operator-initiated replans journal under a fresh id."""
         new_multi = isinstance(forward, (list, tuple))
         forwards = list(forward) if new_multi else [forward]
         if not forwards:
             raise ValueError("replan needs at least one replica forward")
+        cid = cid or new_correlation_id()
+        t_replan = time.perf_counter()
+        get_journal().emit("replan_started", cid=cid,
+                           replicas_to=len(forwards),
+                           replicas_from=len(self._replicas))
         async with self._replan_lock:
             loop = asyncio.get_running_loop()
             if warm:
@@ -428,6 +513,12 @@ class InferenceEngine:
                 self._task = loop.create_task(self._batcher(),
                                               name="jimm-serve-batcher")
             self.metrics.inc("replans_total")
+            replan_s = time.perf_counter() - t_replan
+            self.metrics.inc("goodput_replan_seconds_total", replan_s)
+            get_journal().emit("replan_done", cid=cid,
+                               replicas=len(self._replicas),
+                               was_running=was_running,
+                               dur_s=round(replan_s, 6))
             return {"replicas": len(self._replicas),
                     "was_running": was_running,
                     "replans": self.metrics.count("replans_total")}
@@ -590,6 +681,11 @@ class InferenceEngine:
             return await asyncio.wait_for(future, timeout=deadline - now)
         except asyncio.TimeoutError:
             self.metrics.inc("timeouts_total")
+            if self.slo is not None:
+                tname = tenant_state.spec.name \
+                    if tenant_state is not None else None
+                self.slo.observe(tname, False, deadline - now)
+                self._slo_check_escalate()
             raise DeadlineExceededError(
                 f"request deadline ({deadline - now:.3f}s) exceeded") \
                 from None
@@ -726,9 +822,12 @@ class InferenceEngine:
         except Exception as e:  # noqa: BLE001 — surface to every waiter
             self.metrics.inc("errors_total")
             self._note_replica_failure(replica)
+            t_err = time.monotonic()
             for req in live:
                 if not req.future.done():
                     req.future.set_exception(e)
+                self._observe_slo(req, False, t_err - req.t0)
+            self._slo_check_escalate()
             return
         replica.dispatched += 1
         replica.device_s += device_s
@@ -743,14 +842,19 @@ class InferenceEngine:
                 req.future.set_result(out[i])
                 self.metrics.inc("responses_total")
                 self.metrics.observe_latency(done - req.t0)
+                self._observe_slo(req, True, done - req.t0)
                 self.recent_traces.append({
                     "trace_id": req.rid,
+                    "replica": replica.index,
                     "bucket": bucket,
                     "queue_s": round(now - req.t0, 6),
                     "pad_s": round(pad_s, 6),
                     "device_s": round(device_s, 6),
                     "readback_s": round(readback_s, 6),
                     "total_s": round(done - req.t0, 6),
+                    # same clock as journal "mono": lets the timeline
+                    # exporter place this request among incident events
+                    "done_mono": round(done, 6),
                 })
 
     # -- device side (executor thread, never the event loop) --------------

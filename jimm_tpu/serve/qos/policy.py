@@ -13,7 +13,11 @@ stack. A policy file (JSON or TOML) looks like::
                   "timeout_s": 2.0, "max_queued": 64},
         "bob":   {"class": "batch", "rate": 50}
       },
-      "default": {"class": "interactive"}
+      "default": {"class": "interactive"},
+      "slo": {
+        "alice":   {"availability": 0.999, "latency_ms": 250},
+        "default": {"availability": 0.99}
+      }
     }
 
 Class **priority is declaration order** (first listed = highest = shed
@@ -24,6 +28,12 @@ to the **default tenant**: one shared spec and one shared runtime state,
 so an adversary inventing tenant names cannot grow any per-tenant table
 (the bounded-cardinality discipline lint rule JL014 enforces across
 ``serve/``).
+
+The optional ``slo`` section declares per-tenant service-level
+objectives (availability as a success-rate fraction, optional latency
+target in ms). Names must be declared tenants or ``default``; the serve
+CLI feeds the parsed objectives into the burn-rate engine
+(:class:`jimm_tpu.obs.slo.SloEngine`).
 """
 
 from __future__ import annotations
@@ -144,6 +154,48 @@ def _parse_tenant(name: str, spec, classes: dict[str, ClassSpec],
                       max_queued=max_queued)
 
 
+def _parse_slo(raw, tenants: dict[str, TenantSpec],
+               problems: list[str]) -> dict[str, dict]:
+    """Validate the optional ``slo`` section into plain objective dicts
+    keyed by tenant name (``SloEngine.from_objective_dicts`` consumes
+    them). Names must be declared tenants or ``default`` — an SLO for a
+    tenant the policy never admits would silently track nothing."""
+    if raw is None:
+        return {}
+    if not isinstance(raw, dict):
+        problems.append("'slo' must be a mapping of tenant -> objective")
+        return {}
+    slo: dict[str, dict] = {}
+    for name, spec in raw.items():
+        if name != TenantRegistry.DEFAULT_TENANT and name not in tenants:
+            problems.append(f"slo {name!r}: not a declared tenant "
+                            f"(declared: {sorted(tenants)} + ['default'])")
+            continue
+        if not isinstance(spec, dict):
+            problems.append(f"slo {name!r}: objective must be a mapping")
+            continue
+        unknown = set(spec) - {"availability", "latency_ms"}
+        if unknown:
+            problems.append(f"slo {name!r}: unknown keys {sorted(unknown)}")
+            continue
+        availability = spec.get("availability", 0.999)
+        if (not isinstance(availability, (int, float))
+                or not 0.0 < availability < 1.0):
+            problems.append(f"slo {name!r}: availability must be in (0, 1), "
+                            f"got {availability!r}")
+            continue
+        latency_ms = spec.get("latency_ms")
+        if latency_ms is not None and (
+                not isinstance(latency_ms, (int, float)) or latency_ms <= 0):
+            problems.append(f"slo {name!r}: latency_ms must be > 0, "
+                            f"got {latency_ms!r}")
+            continue
+        slo[str(name)] = {"availability": float(availability)}
+        if latency_ms is not None:
+            slo[str(name)]["latency_ms"] = float(latency_ms)
+    return slo
+
+
 class TenantRegistry:
     """The parsed policy: priority classes, named tenants, and the shared
     default tenant that anonymous/unknown traffic maps to."""
@@ -151,10 +203,14 @@ class TenantRegistry:
     DEFAULT_TENANT = "default"
 
     def __init__(self, classes: dict[str, ClassSpec],
-                 tenants: dict[str, TenantSpec], default: TenantSpec):
+                 tenants: dict[str, TenantSpec], default: TenantSpec,
+                 slo: dict[str, dict] | None = None):
         self.classes = classes
         self.tenants = tenants
         self.default = default
+        #: per-tenant SLO objective dicts from the policy's ``slo`` section
+        #: (empty when the policy declares none)
+        self.slo = dict(slo or {})
         #: class names in priority order (rank 0 first) — the weighted-fair
         #: queue's drain order and the INVERSE of the shed order
         self.class_order = tuple(sorted(classes, key=lambda n:
@@ -167,7 +223,7 @@ class TenantRegistry:
         if not isinstance(data, dict):
             raise QosPolicyError("policy must be a mapping")
         problems: list[str] = []
-        unknown = set(data) - {"classes", "tenants", "default"}
+        unknown = set(data) - {"classes", "tenants", "default", "slo"}
         if unknown:
             problems.append(f"unknown top-level keys {sorted(unknown)}")
         classes = _parse_classes(data.get("classes"), problems)
@@ -182,9 +238,10 @@ class TenantRegistry:
                                                problems)
         default = _parse_tenant(cls.DEFAULT_TENANT, data.get("default") or {},
                                 classes, problems)
+        slo = _parse_slo(data.get("slo"), tenants, problems)
         if problems:
             raise QosPolicyError("; ".join(problems))
-        return cls(classes, tenants, default)
+        return cls(classes, tenants, default, slo)
 
     @classmethod
     def load(cls, path: str) -> "TenantRegistry":
@@ -224,7 +281,7 @@ class TenantRegistry:
 
     def describe(self) -> dict:
         """JSON-shaped summary (the ``qos ls`` CLI and healthz payload)."""
-        return {
+        out = {
             "classes": [{"name": c.name, "weight": c.weight, "rank": c.rank}
                         for c in sorted(self.classes.values(),
                                         key=lambda c: c.rank)],
@@ -232,6 +289,10 @@ class TenantRegistry:
                         sorted(self.tenants.values(), key=lambda t: t.name)],
             "default": dataclasses.asdict(self.default),
         }
+        if self.slo:
+            out["slo"] = {name: dict(obj)
+                          for name, obj in sorted(self.slo.items())}
+        return out
 
 
 def load_policy(path: str) -> TenantRegistry:

@@ -177,6 +177,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             self._send(200, app.metrics_text().encode(),
                        "text/plain; version=0.0.4")
+        elif self.path == "/debug/traces":
+            self._send_json(200, app.debug_traces())
         else:
             self._send_json(404, {"error": "not_found",
                                   "message": self.path})
@@ -481,6 +483,14 @@ class ServingServer:
             series[f"jimm_serve_{name}"] = value
         return render_prometheus_text(series)
 
+    def debug_traces(self) -> dict:
+        """The engine's ``recent_traces`` ring (newest last): per-request
+        queue/pad/device/readback decomposition with the ``done_mono``
+        stamp the timeline exporter joins against. Read by
+        ``jimm-tpu obs tail --traces`` and ``obs timeline --traces``."""
+        return {"traces": list(self.engine.recent_traces),
+                "count": len(self.engine.recent_traces)}
+
     def healthz(self) -> dict:
         snap = self.metrics.snapshot()
         out = {"status": "ok",
@@ -518,4 +528,13 @@ class ServingServer:
             out["qos"] = self.engine.qos.snapshot()
         if self.pool is not None:
             out["models"] = self.pool.describe()
+        # SLO block only when an SloEngine is attached (same conditional
+        # contract as qos/models: the bare server's shape is unchanged).
+        # Fast-burning tenants downgrade the probe like a fenced replica:
+        # the server answers, but the error budget is being torched.
+        slo = getattr(self.engine, "slo", None)
+        if slo is not None:
+            out["slo"] = slo.snapshot()
+            if out["slo"]["fast_burning"] and out["status"] == "ok":
+                out["status"] = "degraded"
         return out

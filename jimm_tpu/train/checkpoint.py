@@ -269,6 +269,9 @@ class CheckpointManager:
         (self._markers / str(step)).unlink(missing_ok=True)
         get_registry("jimm_train").counter(
             "checkpoint_quarantined_total").inc()
+        from jimm_tpu.obs.journal import get_journal
+        get_journal().emit("checkpoint_quarantined", step=step,
+                           reason=reason, dest=str(dest))
         self._mgr.reload()  # drop the manager's cached view of the tree
         return dest
 
@@ -327,7 +330,11 @@ class CheckpointManager:
     def _restore_step(self, step: int, model: nnx.Module,
                       optimizer: nnx.Optimizer | None = None) -> int:
         from jimm_tpu.obs import get_registry, span
+        from jimm_tpu.obs.journal import get_journal
         get_registry("jimm_train").counter("checkpoint_restores_total").inc()
+        # inherits the ambient incident cid when the supervisor is
+        # restarting around a failure — the restore joins that chain
+        get_journal().emit("checkpoint_restored", step=step)
         with span("checkpoint_restore"):
             model_state = nnx.state(model, nnx.Param)
             items: dict[str, Any] = {
@@ -383,8 +390,11 @@ class CheckpointManager:
         self.last_topology_change = {"step": step, "saved": saved,
                                      "current": current}
         from jimm_tpu.obs import get_registry
+        from jimm_tpu.obs.journal import get_journal
         get_registry("jimm_train").counter(
             "checkpoint_topology_changes_total").inc()
+        get_journal().emit("mesh_resharded", step=step, saved=saved,
+                           current=current)
         print(  # jaxlint: disable=JL007 — one-shot operator narration of an elastic restore, mirrors the supervisor's restart lines
             f"[checkpoint] step {step} saved on mesh {saved['axes']} "
             f"({saved['n_devices']} devices), restored onto "

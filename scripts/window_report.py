@@ -11,7 +11,14 @@ Fallback rows — bench.py CPU-smoke records stamped ``fallback: true`` (and
 ``backend``) — are segregated from real TPU datapoints everywhere: prefixed
 in the per-record cells, counted separately in the per-phase summary, and
 never folded into the "clean" tally. BENCH_r01–r05 were misread precisely
-because the two were indistinguishable.
+because the two were indistinguishable. The fallback predicate itself
+lives in ``jimm_tpu.obs.baseline`` now, shared with the regression gate,
+so this report and ``jimm-tpu obs regress`` can never disagree about
+which rows count.
+
+With ``--baselines`` (or when ``BASELINES.json`` exists at the repo
+root), the report ends with a one-line trajectory verdict comparing the
+freshest real rows against the adopted baselines.
 """
 
 from __future__ import annotations
@@ -24,16 +31,8 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
+from jimm_tpu.obs.baseline import BaselineStore, check_rows, is_fallback
 from scripts._measurements import MEASUREMENTS, read_records as load
-
-
-def is_fallback(rec: dict) -> bool:
-    """True for rows that are NOT the metric of record: bench.py CPU-smoke
-    reruns and pre-stamp rows whose metric name carries the legacy
-    "(cpu smoke)" marker."""
-    if rec.get("fallback") is True:
-        return True
-    return "(cpu smoke)" in str(rec.get("metric", ""))
 
 
 def describe(rec: dict) -> str:
@@ -60,10 +59,34 @@ def describe(rec: dict) -> str:
     return prefix + ("  ".join(parts) or "(no payload)")
 
 
+def trajectory_line(recs: list[dict], baselines: pathlib.Path) -> str | None:
+    """One-line verdict of the freshest real rows vs the adopted
+    baselines, or None when there is no store to compare against."""
+    if not baselines.exists():
+        return None
+    verdicts = check_rows(BaselineStore(baselines), recs)
+    counts: dict[str, int] = {}
+    for v in verdicts:
+        counts[v["status"]] = counts.get(v["status"], 0) + 1
+    worst = [f"{v['key']}:{v['metric']} {v['delta_frac']:+.0%}"
+             for v in verdicts if v["status"] == "regression"]
+    line = ("trajectory vs " + baselines.name + ": "
+            + " ".join(f"{k}={counts.get(k, 0)}"
+                       for k in ("ok", "improved", "regression",
+                                 "no_baseline", "fallback_excluded")))
+    if worst:
+        line += "  REGRESSED: " + ", ".join(worst[:4])
+    return line
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--markdown", action="store_true")
     p.add_argument("--file", default=str(MEASUREMENTS))
+    p.add_argument("--baselines", default=str(REPO / "BASELINES.json"),
+                   help="adopted baseline store (jimm-tpu obs regress "
+                        "--adopt); when the file exists the report ends "
+                        "with a one-line trajectory verdict")
     args = p.parse_args()
     recs = load(pathlib.Path(args.file))
     if not recs:
@@ -101,6 +124,9 @@ def main() -> None:
         print("\nper phase (clean/total, fallbacks):",
               "  ".join(f"{ph}={g}/{t}" + (f" ({fb} fallback)" if fb else "")
                         for ph, (g, t, fb) in sorted(phases.items())))
+        line = trajectory_line(recs, pathlib.Path(args.baselines))
+        if line:
+            print(line)
     except BrokenPipeError:  # `| head` is a normal way to use this
         pass
 

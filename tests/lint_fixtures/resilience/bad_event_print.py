@@ -1,0 +1,28 @@
+"""JL015 fixture: structured events printed as ad-hoc JSON in
+resilience code — orphan lines the flight-recorder journal never sees."""
+
+import json
+
+
+def announce_decision(decision):
+    print(json.dumps(decision))             # JL015: no seq/ts/cid, not crash-safe
+
+
+def announce_replan(plan):
+    print("replan: " + json.dumps(plan))    # JL015: concat spelling, same hole
+
+
+def announce_restart(info):
+    print(f"restart {json.dumps(info)}")    # JL015: f-string spelling, same hole
+
+
+def sanctioned_sink(info):
+    # ok: justified console sink (a cross-process drill scrapes this line)
+    print("ready: " + json.dumps(info))  # jaxlint: disable=JL015
+
+
+def journaled(journal, decision):
+    # ok: the flight recorder is the sanctioned emitter, and plain
+    # narration without a structured payload stays legal
+    journal.emit("advisor_decision", **decision)
+    print("attempt failed; restarting")

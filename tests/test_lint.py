@@ -333,6 +333,39 @@ class TestRuleFixtures:
         assert check_unbounded_tenant_table(
             tree, "jimm_tpu/serve/test_helpers.py") == []
 
+    def test_jl015_journal_bypass(self):
+        findings = findings_for("resilience/bad_event_print.py")
+        assert rules_and_lines(findings) == {
+            ("JL015", 8),   # print(json.dumps(...))
+            ("JL015", 12),  # "..." + json.dumps(...) concat
+            ("JL015", 16),  # f-string interpolating json.dumps(...)
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert any("flight-recorder" in f.message for f in findings)
+        # the justified ready-line and the journal emitter (lines 19-28)
+        # stay clean
+
+    def test_jl015_scoped_to_resilience_paths_not_cli(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_journal_bypass
+        src = "import json\nprint(json.dumps({'a': 1}))\n"
+        tree = ast.parse(src)
+        assert check_journal_bypass(
+            tree, "jimm_tpu/resilience/supervisor.py") != []
+        assert check_journal_bypass(
+            tree, "jimm_tpu/serve/engine.py") != []
+        assert check_journal_bypass(
+            tree, "jimm_tpu/train/loop.py") != []
+        # CLI entry points keep their sanctioned parseable ready-lines,
+        # tests print what they like, and the rest of the tree is JL007's
+        # jurisdiction
+        assert check_journal_bypass(tree, "jimm_tpu/cli.py") == []
+        assert check_journal_bypass(tree, "jimm_tpu/launch.py") == []
+        assert check_journal_bypass(tree, "tests/test_serve.py") == []
+        assert check_journal_bypass(
+            tree, "jimm_tpu/obs/registry.py") == []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings
