@@ -1713,7 +1713,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         vstore = VectorStore(args.index_store)
         retrieval = RetrievalService.from_store(
             vstore, args.index, k=args.search_k, plan=plan,
-            aot_store=store)
+            aot_store=store, mode=args.index_mode, nprobe=args.nprobe,
+            nprobe_max=args.nprobe_max)
     elif args.index_store:
         raise SystemExit("--index-store needs --index (the index name)")
     logger = None
@@ -1751,7 +1752,12 @@ def cmd_serve(args: argparse.Namespace) -> int:
         ready["retrieval"] = {"index": info["index"], "rows": info["rows"],
                               "dim": info["dim"], "k": info["k"],
                               "block_n": info["block_n"],
-                              "partitions": info["partitions"]}
+                              "partitions": info["partitions"],
+                              "mode": info["mode"]}
+        if info["mode"] == "ivf":
+            ready["retrieval"]["nprobe"] = info["nprobe"]
+            ready["retrieval"]["nprobe_max"] = info["nprobe_max"]
+            ready["retrieval"]["clusters"] = info["clusters"]
         if args.aot_store:
             ready["retrieval"]["aot"] = {
                 str(b): s for b, s in sorted(
@@ -2154,6 +2160,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--search-k", type=int, default=10,
                     help="compiled top-k carry width; /v1/search requests "
                          "may ask for any k up to this")
+    sp.add_argument("--index-mode", default="exact",
+                    choices=["exact", "ivf"],
+                    help="retrieval mode: exact streaming top-k, or "
+                         "two-stage IVF over the index's trained codebook "
+                         "(train with `jimm-tpu index train-centroids`)")
+    sp.add_argument("--nprobe", type=int, default=None,
+                    help="ivf mode: default clusters probed per query "
+                         "(requests may override up to --nprobe-max; "
+                         "default: min(8, --nprobe-max))")
+    sp.add_argument("--nprobe-max", type=int, default=32,
+                    help="ivf mode: compiled probe-width ceiling — any "
+                         "nprobe up to this reuses one program (a runtime "
+                         "scalar, never a recompile)")
     sp.add_argument("--qos-policy", default=None, metavar="FILE",
                     help="tenant QoS policy (JSON/TOML): priority classes, "
                          "per-tenant token-bucket rate limits, and queue "

@@ -39,6 +39,9 @@ METRIC_DIRECTIONS = {
     "mfu": +1,
     "goodput": +1,
     "recall": +1,
+    # ann frontier: measured recall vs the exact oracle — a ≥20% recall
+    # drop gates exactly like a ≥20% throughput drop
+    "recall_at_10": +1,
     "value": +1,
     "step_time_ms": -1,
     "latency_ms": -1,

@@ -6,6 +6,9 @@
 - :mod:`~jimm_tpu.retrieval.topk` — exact streaming top-k scoring on
   device (blocked matmul + running ``lax.top_k`` merge, corpus sharded
   over the serving topology), AOT-warm and tune-resolved.
+- :mod:`~jimm_tpu.retrieval.ann` — IVF two-stage approximate search
+  (k-means coarse quantizer + runtime-``nprobe`` cluster probe + exact
+  rescore of candidate spans), same AOT/tune/sharding contracts.
 - :mod:`~jimm_tpu.retrieval.api` — the service facade ``serve --index``
   and ``/v1/search`` ride, plus the ``jimm_retrieval`` metric namespace.
 - :mod:`~jimm_tpu.retrieval.cli` — ``jimm-tpu index build|add|ls|verify``
@@ -15,6 +18,9 @@ Importing this package never imports jax (the device program materializes
 inside function bodies), so the index CLI stays a pure-host tool.
 """
 
+from jimm_tpu.retrieval.ann import (DEFAULT_NPROBE, IvfIndexSearcher,
+                                    IvfSearcher, assign_clusters,
+                                    train_centroids)
 from jimm_tpu.retrieval.api import RetrievalService, retrieval_metrics
 from jimm_tpu.retrieval.store import (LoadedIndex, PersistentEmbeddingCache,
                                       RetrievalStoreError, VectorStore,
@@ -23,8 +29,9 @@ from jimm_tpu.retrieval.topk import (DEFAULT_BLOCK_N, IndexSearcher,
                                      Searcher, merge_partials,
                                      streaming_topk)
 
-__all__ = ["DEFAULT_BLOCK_N", "IndexSearcher", "LoadedIndex",
+__all__ = ["DEFAULT_BLOCK_N", "DEFAULT_NPROBE", "IndexSearcher",
+           "IvfIndexSearcher", "IvfSearcher", "LoadedIndex",
            "PersistentEmbeddingCache", "RetrievalService",
            "RetrievalStoreError", "Searcher", "VectorStore",
-           "merge_partials", "normalize_rows", "retrieval_metrics",
-           "streaming_topk"]
+           "assign_clusters", "merge_partials", "normalize_rows",
+           "retrieval_metrics", "streaming_topk", "train_centroids"]

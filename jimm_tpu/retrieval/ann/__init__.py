@@ -1,0 +1,25 @@
+"""Approximate nearest-neighbor retrieval: IVF two-stage search.
+
+- :mod:`~jimm_tpu.retrieval.ann.kmeans` — the coarse quantizer's trainer
+  (jit-compiled mini-batch Lloyd's) plus the pure-NumPy assigner and
+  codebook framing the jax-free store/CLI paths use.
+- :mod:`~jimm_tpu.retrieval.ann.ivf` — the fused two-stage device
+  program (coarse centroid scan → runtime-``nprobe`` cluster probe →
+  exact rescore of candidate spans) and its AOT-warm searchers.
+
+Like the parent package, importing this never imports jax — the device
+program materializes inside function bodies.
+"""
+
+from jimm_tpu.retrieval.ann.ivf import (DEFAULT_NPROBE, IvfIndexSearcher,
+                                        IvfSearcher, cluster_layout,
+                                        make_ivf_fn)
+from jimm_tpu.retrieval.ann.kmeans import (CODEBOOK_FORMAT_VERSION,
+                                           assign_clusters, clustered_rows,
+                                           decode_codebook, encode_codebook,
+                                           train_centroids)
+
+__all__ = ["CODEBOOK_FORMAT_VERSION", "DEFAULT_NPROBE", "IvfIndexSearcher",
+           "IvfSearcher", "assign_clusters", "cluster_layout",
+           "clustered_rows", "decode_codebook", "encode_codebook",
+           "make_ivf_fn", "train_centroids"]

@@ -1,0 +1,615 @@
+"""Two-stage IVF approximate top-k: coarse centroid scan + exact rescore.
+
+Stage 1 is one small ``(B, C)`` matmul against the codebook followed by
+``lax.top_k`` over ``nprobe_max`` clusters. Stage 2 exact-rescores *only*
+the candidate cluster spans with the same streaming-scan + running
+``lax.top_k`` idiom ``topk.py`` proves out: the corpus lives on device
+cluster-major as ``(nblocks, block_n, D)`` blocks (no block spans two
+clusters), and a ``lax.scan`` of ``nprobe_max * max_blocks_per_cluster``
+steps gathers each query's candidate blocks by *runtime* block index —
+derived on device from the resident per-cluster (start, count) span table
+— and folds per-block ``top_k`` into a ``(B, k)`` carry. Rows carry their
+global index in a resident ``(nblocks, block_n)`` id map (``-1`` padding
+masks to ``-inf``), so results are exact over the probed subset.
+
+Both stages are one fused program. Everything that varies at request time
+— the probe width ``nprobe``, the live-centroid count — is a *runtime
+scalar*, so every request shape compiles once: equally-padded replica
+partitions share one program and one AOT fingerprint
+(``method="retrieval_ivf"`` in the same artifact store as the serve
+buckets), and sweeping ``nprobe`` on a warm server is zero recompiles by
+construction. The corpus stays replica-sharded over the PR 6 submeshes —
+clusters partition contiguously across replicas, each replica scores its
+owned spans (unowned clusters have empty span tables), and the final merge
+is the bounded host-side ``merge_partials`` lexsort over ``R * k``
+candidates. Block sizes resolve through
+``tune.best_config("retrieval_ivf", ...)``; an explicit ``block_n`` wins.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from jimm_tpu.retrieval.store import LoadedIndex, normalize_rows
+from jimm_tpu.retrieval.topk import merge_partials
+
+__all__ = ["DEFAULT_NPROBE", "IvfIndexSearcher", "IvfSearcher",
+           "cluster_layout", "make_ivf_fn"]
+
+#: serve-time default probe width; ``--nprobe`` / per-request ``nprobe``
+#: override it up to the searcher's compiled ``nprobe_max``
+DEFAULT_NPROBE = 8
+
+_LANES = 128
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# device program
+# ---------------------------------------------------------------------------
+
+def make_ivf_fn(k: int, nprobe_max: int, max_bpc: int) -> Callable:
+    """The traceable two-stage program for one ``(k, nprobe_max,
+    max_bpc)``.
+
+    Signature: ``fn(blocks (nb, bn, D), row_ids (nb, bn) i32,
+    centroids (Cp, D) f32, cl_start (Cp,) i32, cl_count (Cp,) i32,
+    live_c () i32, nprobe () i32, queries (B, D) f32) -> (values (B, k)
+    f32, indices (B, k) i32, cand_rows (B,) i32)`` where ``indices`` are
+    global corpus rows (from the resident id map, ``-1`` past the probed
+    set) and ``cand_rows`` counts the live rows each query rescored —
+    the candidate_frac observability series divides it by corpus size.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    k, nprobe_max, max_bpc = int(k), int(nprobe_max), int(max_bpc)
+
+    def fn(blocks, row_ids, centroids, cl_start, cl_count, live_c,
+           nprobe, queries):
+        qf = queries.astype(jnp.float32)
+        batch = qf.shape[0]
+        block_n = blocks.shape[1]
+        kk = min(k, block_n)
+
+        # stage 1: (B, Cp) coarse scores -> top nprobe_max clusters;
+        # padded centroid rows mask to -inf so they sort last, and the
+        # runtime nprobe mask trims the probe list without a retrace
+        cscores = qf @ centroids.astype(jnp.float32).T
+        c_iota = jax.lax.iota(jnp.int32, centroids.shape[0])
+        cscores = jnp.where(c_iota[None, :] < live_c, cscores, -jnp.inf)
+        _, sel = jax.lax.top_k(cscores, nprobe_max)  # (B, P) cluster ids
+        probe_live = jax.lax.iota(jnp.int32, nprobe_max) < nprobe
+
+        # candidate block list per query: each selected cluster expands to
+        # its span of (at most max_bpc) blocks via the resident runtime
+        # offsets/live-counts; -1 marks padding (unowned or past-count)
+        starts = cl_start[sel]                       # (B, P)
+        counts = cl_count[sel]                       # (B, P)
+        j = jax.lax.iota(jnp.int32, max_bpc)
+        cand = starts[..., None] + j[None, None, :]  # (B, P, M)
+        live_cand = (j[None, None, :] < counts[..., None]) \
+            & probe_live[None, :, None]
+        cand = jnp.where(live_cand, cand, -1)
+        cand = cand.reshape(batch, nprobe_max * max_bpc)
+
+        def body(carry, bidx):
+            carry_vals, carry_idx, carry_rows = carry
+            safe = jnp.maximum(bidx, 0)
+            blk = blocks[safe]                       # (B, bn, D) gather
+            rid = row_ids[safe]                      # (B, bn)
+            # the MXU step, batched per query's own block
+            scores = jnp.einsum("bd,bnd->bn", qf,
+                                blk.astype(jnp.float32))
+            live = (rid >= 0) & (bidx >= 0)[:, None]
+            scores = jnp.where(live, scores, -jnp.inf)
+            block_vals, block_pos = jax.lax.top_k(scores, kk)
+            block_idx = jnp.take_along_axis(
+                jnp.where(live, rid, -1), block_pos, axis=1)
+            # carry first: same stable earlier-candidate tie order as the
+            # exact kernel, within the probe traversal
+            merged_vals, merged_pos = jax.lax.top_k(
+                jnp.concatenate([carry_vals, block_vals], axis=1), k)
+            merged_idx = jnp.take_along_axis(
+                jnp.concatenate([carry_idx, block_idx], axis=1),
+                merged_pos, axis=1)
+            carry_rows = carry_rows + jnp.sum(live, axis=1,
+                                              dtype=jnp.int32)
+            return (merged_vals, merged_idx, carry_rows), None
+
+        init = (jnp.full((batch, k), -jnp.inf, jnp.float32),
+                jnp.full((batch, k), -1, jnp.int32),
+                jnp.zeros((batch,), jnp.int32))
+        (vals, idx, rows), _ = jax.lax.scan(body, init, cand.T)
+        return vals, idx, rows
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# host-side layout
+# ---------------------------------------------------------------------------
+
+def cluster_layout(vectors: np.ndarray, assign: np.ndarray,
+                   n_clusters: int, *, block_n: int,
+                   row_ids: np.ndarray | None = None,
+                   pad_blocks: int | None = None
+                   ) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                              np.ndarray]:
+    """Pack rows cluster-major into the device layout: ``(blocks (nb, bn,
+    D), row_ids (nb, bn) i32, cl_start (C,) i32, cl_count (C,) i32)``.
+    No block spans two clusters (each cluster pads its last block), so a
+    cluster's span is exactly ``cl_start[c] : cl_start[c] + cl_count[c]``
+    blocks. ``row_ids`` carries each packed row's global corpus index
+    (``-1`` padding); ``pad_blocks`` pads ``nb`` so every replica
+    partition of one index shares shapes — and one AOT fingerprint."""
+    vectors = np.ascontiguousarray(np.asarray(vectors))
+    if vectors.ndim != 2:
+        raise ValueError(f"vectors must be (N, D); got {vectors.shape}")
+    n, dim = vectors.shape
+    block_n = max(1, int(block_n))
+    n_clusters = int(n_clusters)
+    assign = np.asarray(assign, np.int64)
+    if assign.shape != (n,):
+        raise ValueError(f"assign must be ({n},); got {assign.shape}")
+    if n and (assign.min() < 0 or assign.max() >= n_clusters):
+        raise ValueError("assign has cluster ids outside "
+                         f"[0, {n_clusters})")
+    if row_ids is None:
+        row_ids = np.arange(n, dtype=np.int64)
+    row_ids = np.asarray(row_ids, np.int64)
+    # stable cluster-major order (ties by global row id) via the
+    # sanctioned lexsort — primary key last
+    order = np.lexsort((row_ids, assign))
+    counts = np.bincount(assign, minlength=n_clusters) if n else \
+        np.zeros(n_clusters, np.int64)
+    blocks_per = (counts + block_n - 1) // block_n
+    nb = max(int(blocks_per.sum()), 1)
+    if pad_blocks is not None:
+        if int(pad_blocks) < nb:
+            raise ValueError(f"pad_blocks={pad_blocks} < {nb} blocks")
+        nb = int(pad_blocks)
+    blocks = np.zeros((nb, block_n, dim), vectors.dtype)
+    rids = np.full((nb, block_n), -1, np.int32)
+    cl_start = np.zeros(n_clusters, np.int32)
+    cl_count = np.asarray(blocks_per, np.int32)
+    b = pos = 0
+    for c in range(n_clusters):
+        cnt = int(counts[c])
+        cl_start[c] = b
+        if not cnt:
+            continue
+        rows = order[pos:pos + cnt]
+        pos += cnt
+        for off in range(0, cnt, block_n):
+            chunk = rows[off:off + block_n]
+            blocks[b, :len(chunk)] = vectors[chunk]
+            rids[b, :len(chunk)] = row_ids[chunk]
+            b += 1
+    return blocks, rids, cl_start, cl_count
+
+
+def _resolve_block_n(n: int, dim: int, dtype, batch: int,
+                     block_n: int | None) -> int:
+    """Explicit block wins (tuner bench closures must not recurse);
+    otherwise consult the persistent tune cache — same contract as
+    ``retrieval_topk``, separate kernel key (the IVF scan gathers one
+    block *per query* per step, so its VMEM model scales with batch)."""
+    if block_n is not None:
+        return int(block_n)
+    from jimm_tpu import tune
+    config = tune.best_config(
+        "retrieval_ivf",
+        shapes=[(int(batch), int(dim)), (int(n), int(dim))],
+        dtypes=[np.dtype(dtype)])
+    return int(config["block_n"])
+
+
+# ---------------------------------------------------------------------------
+# warm searchers (AOT + tune integration)
+# ---------------------------------------------------------------------------
+
+class IvfSearcher:
+    """One cluster partition's warm IVF forward: device-resident
+    cluster-major blocks + span tables + codebook, and a store-first
+    compiled program per query bucket.
+
+    Same dispatch contract as :class:`~jimm_tpu.retrieval.topk.Searcher`:
+    ``prepare(bucket)`` consults the artifact store under an ``aot_load``
+    span (hit/miss/fallback counted in ``jimm_aot``), the fresh path is a
+    counting jit, and a loaded executable that raises at call time
+    quarantines itself and degrades to fresh.
+    """
+
+    def __init__(self, vectors: np.ndarray, assign: np.ndarray,
+                 centroids: np.ndarray, *, k: int, nprobe_max: int,
+                 buckets: Sequence[int] = (1,), block_n: int | None = None,
+                 mesh: Any = None, row_ids: np.ndarray | None = None,
+                 pad_blocks: int | None = None, max_bpc: int | None = None,
+                 aot_store: Any = None, label: str = "retrieval_ivf",
+                 write_through: bool = True):
+        import jax
+
+        vectors = np.ascontiguousarray(np.asarray(vectors))
+        centroids = np.asarray(centroids, np.float32)
+        self.k = int(k)
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        self.dim = int(centroids.shape[1])
+        self.n_rows = int(vectors.shape[0])
+        self.n_clusters = int(centroids.shape[0])
+        self.nprobe_max = max(1, min(int(nprobe_max), self.n_clusters))
+        self.mesh = mesh
+        self.store = aot_store
+        self.label = label
+        self.write_through = write_through
+        self.block_n = _resolve_block_n(self.n_rows, self.dim,
+                                        vectors.dtype, self.buckets[-1],
+                                        block_n)
+        # pad the codebook (and its span tables) to the lane boundary so
+        # the coarse matmul is lane-aligned; padded rows are zero vectors
+        # masked by the runtime live-centroid count
+        cp = _ceil_to(self.n_clusters, _LANES)
+        cents = np.zeros((cp, self.dim), np.float32)
+        cents[:self.n_clusters] = centroids
+        blocks, rids, cl_start, cl_count = cluster_layout(
+            vectors, assign, self.n_clusters, block_n=self.block_n,
+            row_ids=row_ids, pad_blocks=pad_blocks)
+        self.nblocks = int(blocks.shape[0])
+        self.max_bpc = max(1, int(max_bpc if max_bpc is not None
+                                  else cl_count.max(initial=0)))
+        if int(cl_count.max(initial=0)) > self.max_bpc:
+            raise ValueError(f"max_bpc={self.max_bpc} < largest cluster "
+                             f"span {int(cl_count.max())}")
+        start_p = np.zeros(cp, np.int32)
+        count_p = np.zeros(cp, np.int32)
+        start_p[:self.n_clusters] = cl_start
+        count_p[:self.n_clusters] = cl_count
+        self._corpus_dtype = str(blocks.dtype)
+        if mesh is not None:
+            # the program has no collectives; replicate the partition over
+            # its submesh so every device answers (the replica axis is the
+            # sharding — clusters split across replicas, not within)
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._sharding = NamedSharding(mesh, PartitionSpec())
+            put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
+        else:
+            self._sharding = None
+            put = jax.device_put
+        self._blocks = put(blocks)
+        self._row_ids = put(rids)
+        self._centroids = put(cents)
+        self._cl_start = put(start_p)
+        self._cl_count = put(count_p)
+        self._live_c = np.int32(self.n_clusters)
+        self._traces = {"count": 0}
+        fn = make_ivf_fn(self.k, self.nprobe_max, self.max_bpc)
+
+        def counting(*args):
+            self._traces["count"] += 1
+            return fn(*args)
+
+        self._fn = fn
+        self._fresh = jax.jit(counting)
+        self._loaded: dict[int, Callable] = {}
+        #: bucket -> "aot" | "miss" | "fallback" | "compile"
+        self.sources: dict[int, str] = {}
+
+    def trace_count(self) -> int:
+        return self._traces["count"]
+
+    # -- AOT keys ---------------------------------------------------------
+
+    def key_for(self, bucket: int):
+        from jimm_tpu.aot.keys import serve_forward_key
+        return serve_forward_key(
+            {"kind": "retrieval_ivf", "nblocks": self.nblocks,
+             "block_n": self.block_n, "dim": self.dim, "k": self.k,
+             "clusters_padded": int(self._centroids.shape[0]),
+             "nprobe_max": self.nprobe_max, "max_bpc": self.max_bpc,
+             "corpus_dtype": self._corpus_dtype},
+            method="retrieval_ivf", bucket=int(bucket),
+            item_shape=(self.dim,), in_dtype=np.float32,
+            param_dtype=self._corpus_dtype, mesh=self.mesh)
+
+    def _arg_specs(self, bucket: int):
+        import jax
+        cp = int(self._centroids.shape[0])
+        s = self._sharding
+        return (
+            jax.ShapeDtypeStruct(
+                (self.nblocks, self.block_n, self.dim),
+                self._blocks.dtype, sharding=s),
+            jax.ShapeDtypeStruct((self.nblocks, self.block_n), np.int32,
+                                 sharding=s),
+            jax.ShapeDtypeStruct((cp, self.dim), np.float32, sharding=s),
+            jax.ShapeDtypeStruct((cp,), np.int32, sharding=s),
+            jax.ShapeDtypeStruct((cp,), np.int32, sharding=s),
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((int(bucket), self.dim), np.float32),
+        )
+
+    # -- warm-start -------------------------------------------------------
+
+    def prepare(self, bucket: int) -> str:
+        """Store-first warm-start for one query bucket; never raises."""
+        bucket = int(bucket)
+        if bucket in self.sources:
+            return self.sources[bucket]
+        if self.store is None:
+            self.sources[bucket] = "compile"
+            return "compile"
+        from jimm_tpu import obs
+        from jimm_tpu.aot.warmup import _runtime_versions, aot_metrics
+        hit, miss, fallback = aot_metrics()
+        key = self.key_for(bucket)
+        fp = key.fingerprint()
+        existed = self.store.contains(fp)
+        source = "miss"
+        with obs.span("aot_load"):
+            payload = self.store.get(fp,
+                                     expect_versions=_runtime_versions())
+            if payload is not None:
+                try:
+                    self._loaded[bucket] = self._bind(payload)
+                    source = "aot"
+                except Exception as e:  # noqa: BLE001 — degrade, never die
+                    self.store.quarantine(fp,
+                                          f"deserialize/bind failed: {e}")
+                    source = "fallback"
+            elif existed:
+                source = "fallback"  # store.get already quarantined it
+        if source == "aot":
+            hit.inc()
+        elif source == "fallback":
+            fallback.inc()
+        else:
+            miss.inc()
+            if self.write_through:
+                self._export_and_put(bucket, key, fp)
+        self.sources[bucket] = source
+        return source
+
+    def _bind(self, payload: bytes) -> Callable:
+        import jax
+        from jax import export as jax_export
+        exported = jax_export.deserialize(bytearray(payload))
+        flat_avals = jax.tree.flatten(exported.in_avals)[0] \
+            if hasattr(exported, "in_avals") else []
+        if flat_avals and len(flat_avals) != 8:
+            raise ValueError(f"artifact expects {len(flat_avals)} input "
+                             f"leaves, retrieval_ivf provides 8")
+        return jax.jit(exported.call)
+
+    def _export_and_put(self, bucket: int, key, fp: str) -> None:
+        """Write-through on a miss so the next process (and every sibling
+        replica — same padded shapes, same fingerprint) starts warm.
+        Failure to serialize must not break search."""
+        try:
+            import jax
+            from jax import export as jax_export
+
+            from jimm_tpu.aot.keys import AOT_FORMAT_VERSION
+            exported = jax_export.export(jax.jit(self._fn))(
+                *self._arg_specs(bucket))
+            self.store.put(fp, exported.serialize(),
+                           meta={"label": self.label, **key.describe(),
+                                 "format_version": AOT_FORMAT_VERSION})
+        except Exception:  # noqa: BLE001
+            pass
+
+    def warmup(self) -> dict[int, str]:
+        """Prepare + prime every bucket; returns {bucket: source}."""
+        for bucket in self.buckets:
+            self.prepare(bucket)
+            zeros = np.zeros((bucket, self.dim), np.float32)
+            self.search_partial(zeros, self.nprobe_max)
+        return dict(self.sources)
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _bucket_for(self, batch: int) -> int:
+        for bucket in self.buckets:
+            if batch <= bucket:
+                return bucket
+        raise ValueError(f"query batch {batch} exceeds largest retrieval "
+                         f"bucket {self.buckets[-1]}")
+
+    def search_partial(self, queries: np.ndarray, nprobe: int
+                       ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Score a ``(B, D)`` f32 query batch against this partition's
+        clusters; returns host partials ``(values (B, k), indices (B, k)
+        global, cand_rows (B,))``. ``nprobe`` is a runtime scalar — any
+        value in ``[1, nprobe_max]`` reuses the same compiled program.
+        Batches past the largest bucket run as chunks of it."""
+        queries = np.asarray(queries, np.float32)
+        nprobe = np.int32(max(1, min(int(nprobe), self.nprobe_max)))
+        batch = queries.shape[0]
+        top = self.buckets[-1]
+        if batch > top:
+            outs = [self.search_partial(queries[i:i + top], int(nprobe))
+                    for i in range(0, batch, top)]
+            return (np.concatenate([o[0] for o in outs], axis=0),
+                    np.concatenate([o[1] for o in outs], axis=0),
+                    np.concatenate([o[2] for o in outs], axis=0))
+        bucket = self._bucket_for(batch)
+        if batch < bucket:
+            padded = np.zeros((bucket, self.dim), np.float32)
+            padded[:batch] = queries
+            queries = padded
+        args = (self._blocks, self._row_ids, self._centroids,
+                self._cl_start, self._cl_count, self._live_c, nprobe,
+                queries)
+        fn = self._loaded.get(bucket)
+        if fn is not None:
+            try:
+                vals, idx, rows = fn(*args)
+            except Exception:  # noqa: BLE001 — a bad artifact must not
+                # fail the query: quarantine, recompile fresh
+                from jimm_tpu.aot.warmup import aot_metrics
+                aot_metrics()[2].inc()
+                del self._loaded[bucket]
+                self.sources[bucket] = "fallback"
+                if self.store is not None:
+                    self.store.quarantine(
+                        self.key_for(bucket).fingerprint(),
+                        "loaded executable raised at call time")
+                vals, idx, rows = self._fresh(*args)
+        else:
+            vals, idx, rows = self._fresh(*args)
+        return (np.asarray(vals)[:batch],
+                np.asarray(idx, np.int64)[:batch],
+                np.asarray(rows, np.int64)[:batch])
+
+
+class IvfIndexSearcher:
+    """IVF-search one :class:`LoadedIndex` across the serving topology.
+
+    Clusters partition contiguously across the plan's replicas (a cluster
+    lives wholly in one partition, so probing is local); every replica
+    holds the full codebook, computes the identical coarse top-``nprobe``,
+    rescoring only the spans it owns, and the ``R * k`` partials fold
+    through the bounded host-side :func:`merge_partials`. All partitions
+    pad to common block counts, so they share one compiled program and one
+    AOT fingerprint. ``search`` accepts a per-call ``nprobe`` (a runtime
+    scalar — never a recompile) up to the compiled ``nprobe_max``.
+    """
+
+    def __init__(self, index: LoadedIndex, centroids: np.ndarray,
+                 assign: np.ndarray | None = None, *, k: int = 10,
+                 nprobe_max: int = 32, buckets: Sequence[int] = (1,),
+                 block_n: int | None = None, plan: Any = None,
+                 aot_store: Any = None, label: str | None = None):
+        from jimm_tpu.retrieval.ann.kmeans import assign_clusters
+        if len(index) == 0:
+            raise ValueError(f"index {index.name!r} is empty")
+        self.index = index
+        self.k = int(k)
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        centroids = np.asarray(centroids, np.float32)
+        n_clusters = int(centroids.shape[0])
+        self.n_clusters = n_clusters
+        self.nprobe_max = max(1, min(int(nprobe_max), n_clusters))
+        label = label or f"retrieval_ivf:{index.name}"
+        if assign is None:
+            assign = assign_clusters(index.matrix_f32(), centroids)
+        else:
+            assign = np.asarray(assign, np.int64).copy()
+            stale = np.flatnonzero(assign < 0)
+            if stale.size:
+                # rows from segments written before the codebook (or never
+                # re-clustered): assign them here so search stays exact
+                # over the probed set; `index stats` still advises a
+                # build-ivf to persist the assignment
+                assign[stale] = assign_clusters(
+                    index.matrix_f32()[stale], centroids)
+        assign = np.asarray(assign, np.int64)
+        corpus = index.vectors
+        resolved_bn = _resolve_block_n(
+            len(index), index.dim, corpus.dtype, self.buckets[-1], block_n)
+        counts = np.bincount(assign, minlength=n_clusters)
+        bpc = int(((counts + resolved_bn - 1) // resolved_bn)
+                  .max(initial=0)) or 1
+        if plan is not None and not plan.is_trivial:
+            replicas = plan.replicas
+            meshes = plan.meshes()
+            cc = math.ceil(n_clusters / replicas)
+            parts = [np.flatnonzero((assign >= r * cc)
+                                    & (assign < (r + 1) * cc))
+                     for r in range(replicas)]
+            part_blocks = []
+            for rows in parts:
+                pc = np.bincount(assign[rows], minlength=n_clusters)
+                part_blocks.append(
+                    int(((pc + resolved_bn - 1) // resolved_bn).sum()))
+            pad_blocks = max(max(part_blocks), 1)
+            self.searchers = [
+                IvfSearcher(corpus[rows], assign[rows], centroids,
+                            k=self.k, nprobe_max=self.nprobe_max,
+                            buckets=self.buckets, block_n=resolved_bn,
+                            mesh=meshes[r], row_ids=rows,
+                            pad_blocks=pad_blocks, max_bpc=bpc,
+                            aot_store=aot_store, label=label)
+                for r, rows in enumerate(parts)]
+        else:
+            self.searchers = [
+                IvfSearcher(corpus, assign, centroids, k=self.k,
+                            nprobe_max=self.nprobe_max,
+                            buckets=self.buckets, block_n=resolved_bn,
+                            max_bpc=bpc, aot_store=aot_store, label=label)]
+        #: {bucket: "aot"|"miss"|"compile"|"fallback"|"mixed"} after warmup
+        self.warmup_report: dict[int, str] = {}
+        #: stats of the most recent search (the ivf obs gauges read these)
+        self.last_stats: dict[str, float] = {}
+        self._dispatch_lock = threading.Lock()
+
+    @property
+    def block_n(self) -> int:
+        return self.searchers[0].block_n
+
+    def trace_count(self) -> int:
+        return sum(s.trace_count() for s in self.searchers)
+
+    def prepare(self, bucket: int) -> str:
+        sources = {s.prepare(bucket) for s in self.searchers}
+        return sources.pop() if len(sources) == 1 else "mixed"
+
+    def warmup(self) -> dict[int, str]:
+        """Warm every (replica, bucket); returns the aggregated
+        {bucket: source} map the serve ready line reports."""
+        for searcher in self.searchers:
+            searcher.warmup()
+        report: dict[int, str] = {}
+        for bucket in self.buckets:
+            sources = {s.sources.get(bucket) for s in self.searchers}
+            report[bucket] = (sources.pop() if len(sources) == 1
+                              else "mixed")
+        self.warmup_report = report
+        return report
+
+    def search(self, queries: np.ndarray, nprobe: int | None = None
+               ) -> tuple[np.ndarray, np.ndarray, list[list[str]]]:
+        """Approximate top-k for a ``(B, D)`` (or ``(D,)``) query batch at
+        the given probe width (default: the compiled ``nprobe_max``).
+        Returns ``(values (B, k'), indices (B, k'), ids)`` with ``k' =
+        min(k, N)``; when the probed clusters hold fewer than ``k'`` rows
+        a row's id list is shorter (indices carry ``-1`` tails)."""
+        nprobe = self.nprobe_max if nprobe is None else int(nprobe)
+        if not 1 <= nprobe <= self.nprobe_max:
+            raise ValueError(f"nprobe must be in [1, {self.nprobe_max}] "
+                             f"(the compiled probe width); got {nprobe}")
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if queries.ndim != 2 or queries.shape[1] != self.index.dim:
+            raise ValueError(
+                f"queries must be (B, {self.index.dim}); got "
+                f"{queries.shape}")
+        queries = normalize_rows(queries)
+        # one search on the device at a time — same rationale as the exact
+        # IndexSearcher: concurrent launches on shared submeshes interleave
+        with self._dispatch_lock:
+            partials = [s.search_partial(queries, nprobe)
+                        for s in self.searchers]
+        values = np.stack([p[0] for p in partials], axis=0)
+        indices = np.stack([p[1] for p in partials], axis=0)
+        cand_rows = np.sum([p[2] for p in partials], axis=0)
+        k_eff = min(self.k, len(self.index))
+        vals, idx = merge_partials(values, indices, k_eff)
+        ids = [[self.index.ids[j] for j in row if j >= 0] for row in idx]
+        found = float(np.mean([len(row) for row in ids])) if len(ids) \
+            else 0.0
+        self.last_stats = {
+            "nprobe": float(nprobe),
+            "candidate_frac": round(
+                float(np.mean(cand_rows)) / max(len(self.index), 1), 6),
+            "fill_ratio": round(found / max(k_eff, 1), 6),
+        }
+        return vals, idx, ids

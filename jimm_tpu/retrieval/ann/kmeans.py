@@ -1,0 +1,194 @@
+"""Mini-batch Lloyd's k-means: the IVF coarse quantizer's trainer.
+
+The codebook is a row-normalized ``(C, D)`` float32 matrix of unit
+centroids — cosine assignment is then a plain argmax over one small
+``(batch, C)`` matmul, the same dot-product contract ``retrieval/topk.py``
+scores on device. Training is deterministic given ``seed``: centroid init
+draws distinct corpus rows from a seeded generator, every mini-batch is
+drawn from the same stream, and the jit-compiled step (assign + per-center
+sums) has no data-dependent shapes, so two trainings of the same corpus
+produce bit-identical codebooks. Empty clusters never survive: a centroid
+that captures nothing in a batch is re-seeded onto a (seeded-random) member
+of that batch's largest cluster, and a final full-corpus pass re-splits any
+centroid that is still globally empty.
+
+Persistence reuses the segment framing idiom (header JSON line + raw row
+bytes) so a codebook is one content-addressed artifact in the same
+:class:`~jimm_tpu.aot.store.ArtifactStore` that holds segments — atomic
+writes, integrity on read, quarantine-never-delete.
+
+``assign_clusters`` is pure NumPy (chunked argmax, never a sort) so the
+store's write path and the jax-free ``jimm-tpu index`` CLI can assign rows
+without an accelerator stack; jax only materializes inside
+:func:`train_centroids`.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Sequence
+
+import numpy as np
+
+from jimm_tpu.retrieval.store import RetrievalStoreError, normalize_rows
+
+__all__ = ["CODEBOOK_FORMAT_VERSION", "assign_clusters", "clustered_rows",
+           "decode_codebook", "encode_codebook", "train_centroids"]
+
+#: bump when the codebook payload framing changes — old artifacts then
+#: fail loudly instead of decoding garbage
+CODEBOOK_FORMAT_VERSION = 1
+
+#: host-side assignment tile: bounds the (rows, C) score working set
+_ASSIGN_CHUNK = 8192
+
+
+def assign_clusters(vectors: np.ndarray,
+                    centroids: np.ndarray) -> np.ndarray:
+    """Nearest-centroid id per row (cosine == dot over unit rows), pure
+    NumPy and chunked so the host working set stays ``(chunk, C)`` — an
+    argmax (selection), never a sort. ``np.argmax`` ties resolve to the
+    lowest centroid index, deterministically."""
+    vecs = np.asarray(vectors, np.float32)
+    cents = np.asarray(centroids, np.float32)
+    if vecs.ndim != 2 or cents.ndim != 2 or vecs.shape[1] != cents.shape[1]:
+        raise ValueError(
+            f"vectors {vecs.shape} and centroids {cents.shape} must be "
+            f"(N, D) / (C, D) with one D")
+    out = np.empty(vecs.shape[0], np.int32)
+    for i in range(0, vecs.shape[0], _ASSIGN_CHUNK):
+        tile = vecs[i:i + _ASSIGN_CHUNK]
+        out[i:i + _ASSIGN_CHUNK] = np.argmax(tile @ cents.T, axis=1)
+    return out
+
+
+def train_centroids(vectors: np.ndarray, n_clusters: int, *,
+                    iters: int = 25, batch_rows: int = 4096,
+                    seed: int = 0) -> np.ndarray:
+    """Train a row-normalized ``(n_clusters, D)`` codebook with
+    jit-compiled mini-batch Lloyd's. Deterministic per ``seed``; empty
+    clusters re-split onto members of the batch's largest cluster (and a
+    final full pass guarantees no globally-empty centroid survives)."""
+    import jax
+    import jax.numpy as jnp
+
+    vecs = normalize_rows(np.asarray(vectors, np.float32))
+    n, _dim = vecs.shape
+    c = int(n_clusters)
+    if c < 1:
+        raise ValueError(f"n_clusters must be >= 1; got {c}")
+    if n < c:
+        raise ValueError(f"need at least n_clusters={c} rows; got {n}")
+    rng = np.random.default_rng(seed)
+    centroids = vecs[rng.choice(n, size=c, replace=False)].copy()
+    batch_rows = min(max(int(batch_rows), c), n)
+
+    @jax.jit
+    def step(cents, batch):
+        # the whole inner loop is one program: (b, C) assign scores,
+        # one-hot scatter into per-center sums/counts — no host sync
+        scores = batch @ cents.T
+        assign = jnp.argmax(scores, axis=1)
+        one_hot = jax.nn.one_hot(assign, c, dtype=jnp.float32)
+        return one_hot.T @ batch, one_hot.sum(axis=0), assign
+
+    for _ in range(max(1, int(iters))):
+        take = rng.choice(n, size=batch_rows, replace=False)
+        sums, counts, assign = (np.asarray(x)
+                                for x in step(centroids, vecs[take]))
+        moved = sums / np.maximum(counts[:, None], 1.0)
+        centroids = np.where(counts[:, None] > 0, moved, centroids)
+        empty = np.flatnonzero(counts == 0)
+        if empty.size:
+            donors = take[assign == int(np.argmax(counts))]
+            centroids[empty] = vecs[rng.choice(donors, size=empty.size)]
+        centroids = normalize_rows(centroids)
+
+    # a centroid can still be globally empty (its batch wins were stolen by
+    # later updates); one full assignment pass re-splits those too
+    full = assign_clusters(vecs, centroids)
+    sizes = np.bincount(full, minlength=c)
+    empty = np.flatnonzero(sizes == 0)
+    if empty.size:
+        donors = np.flatnonzero(full == int(np.argmax(sizes)))
+        centroids[empty] = vecs[rng.choice(donors, size=empty.size)]
+        centroids = normalize_rows(centroids)
+    return np.ascontiguousarray(centroids, dtype=np.float32)
+
+
+# ---------------------------------------------------------------------------
+# codebook persistence (one content-addressed artifact)
+# ---------------------------------------------------------------------------
+
+def encode_codebook(centroids: np.ndarray, *, trained_rows: int = 0,
+                    seed: int = 0) -> bytes:
+    """Frame a codebook payload: header JSON line + raw f32 row bytes."""
+    mat = np.ascontiguousarray(normalize_rows(centroids), np.float32)
+    header = {"codebook_format": CODEBOOK_FORMAT_VERSION,
+              "clusters": int(mat.shape[0]), "dim": int(mat.shape[1]),
+              "dtype": "float32", "trained_rows": int(trained_rows),
+              "seed": int(seed)}
+    return json.dumps(header, sort_keys=True,
+                      separators=(",", ":")).encode() + b"\n" + mat.tobytes()
+
+
+def decode_codebook(payload: bytes) -> tuple[np.ndarray, dict]:
+    """Inverse of :func:`encode_codebook`; raises
+    :class:`RetrievalStoreError` on framing/shape inconsistency (the
+    caller quarantines)."""
+    head, sep, body = payload.partition(b"\n")
+    if not sep:
+        raise RetrievalStoreError("codebook payload has no header line")
+    try:
+        header = json.loads(head)
+    except ValueError as e:
+        raise RetrievalStoreError(f"bad codebook header: {e}") from None
+    if header.get("codebook_format") != CODEBOOK_FORMAT_VERSION:
+        raise RetrievalStoreError(
+            f"codebook format {header.get('codebook_format')!r} != "
+            f"{CODEBOOK_FORMAT_VERSION}")
+    clusters, dim = int(header["clusters"]), int(header["dim"])
+    expected = clusters * dim * 4
+    if len(body) != expected:
+        raise RetrievalStoreError(
+            f"codebook body is {len(body)} bytes, header promises "
+            f"{expected}")
+    mat = np.frombuffer(body, np.float32).reshape(clusters, dim)
+    return mat, header
+
+
+# ---------------------------------------------------------------------------
+# synthetic clustered corpora (tests / smokes / frontier)
+# ---------------------------------------------------------------------------
+
+def clustered_rows(n: int, dim: int, centers: int, *, noise: float = 0.15,
+                   seed: int = 0,
+                   center_mat: np.ndarray | None = None
+                   ) -> tuple[np.ndarray, np.ndarray]:
+    """Seeded mixture-of-Gaussians unit rows — the workload IVF exists
+    for (real embedding corpora cluster; i.i.d. Gaussian rows do not).
+    Returns ``(rows (n, dim) f32 unit, center_mat (centers, dim))``; pass
+    ``center_mat`` back (with a different seed) to draw queries from the
+    same mixture."""
+    rng = np.random.default_rng(seed)
+    if center_mat is None:
+        center_mat = normalize_rows(
+            rng.standard_normal((int(centers), int(dim)),
+                                dtype=np.float32))
+    which = rng.integers(0, center_mat.shape[0], size=int(n))
+    rows = center_mat[which] + noise * rng.standard_normal(
+        (int(n), int(dim)), dtype=np.float32)
+    return normalize_rows(rows), center_mat
+
+
+def cluster_runs(assign_sorted: Sequence[int]) -> list[list[int]]:
+    """Run-length encode an already cluster-major assignment vector into
+    the manifest's ``[[cluster_id, count], ...]`` form."""
+    runs: list[list[int]] = []
+    for cid in assign_sorted:
+        cid = int(cid)
+        if runs and runs[-1][0] == cid:
+            runs[-1][1] += 1
+        else:
+            runs.append([cid, 1])
+    return runs

@@ -12,6 +12,14 @@ benches.
     jimm-tpu index ls     --store ./idx
     jimm-tpu index verify --store ./idx
     jimm-tpu index compact --store ./idx corpus
+    jimm-tpu index train-centroids --store ./idx corpus --clusters 256
+    jimm-tpu index build-ivf --store ./idx corpus
+    jimm-tpu index stats  --store ./idx corpus
+
+The one exception to "no jax" is ``train-centroids`` — the mini-batch
+Lloyd's step is a jit-compiled program by design. Everything else,
+including ``build-ivf`` (pure-NumPy assignment against the persisted
+codebook) and ``stats`` (manifest-only staleness/advice), stays jax-free.
 """
 
 from __future__ import annotations
@@ -131,6 +139,43 @@ def _cmd_compact(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_train_centroids(args: argparse.Namespace) -> int:
+    # the one jax-using index command: the Lloyd's step is a jit program
+    from jimm_tpu.retrieval.ann.kmeans import train_centroids
+    store = VectorStore(args.store)
+    index = store.load(args.name)
+    if len(index) < args.clusters:
+        raise SystemExit(f"index {args.name!r} has {len(index)} live rows "
+                         f"< --clusters {args.clusters}")
+    centroids = train_centroids(index.matrix_f32(), args.clusters,
+                                iters=args.iters,
+                                batch_rows=args.batch_rows,
+                                seed=args.seed)
+    fp = store.set_codebook(args.name, centroids,
+                            trained_rows=len(index), seed=args.seed)
+    print(json.dumps({"index": args.name, "codebook": fp[:12],
+                      "clusters": int(args.clusters),
+                      "trained_rows": len(index),
+                      "hint": "run `index build-ivf` to cluster existing "
+                              "segments"}))
+    return 0
+
+
+def _cmd_build_ivf(args: argparse.Namespace) -> int:
+    store = VectorStore(args.store)
+    report = store.build_ivf(args.name)
+    print(json.dumps({"index": args.name, **report}))
+    return 0
+
+
+def _cmd_stats(args: argparse.Namespace) -> int:
+    store = VectorStore(args.store)
+    names = [args.name] if args.name else store.names()
+    out = [store.stats(n) for n in names]
+    print(json.dumps(out if args.name is None else out[0], indent=1))
+    return 0
+
+
 def cmd_index(args: argparse.Namespace) -> int:
     try:
         return args.index_func(args)
@@ -199,6 +244,33 @@ def add_index_parser(subparsers) -> None:
     _store_flag(sp)
     sp.add_argument("name")
     sp.set_defaults(index_func=_cmd_compact)
+
+    sp = sub.add_parser("train-centroids",
+                        help="train the IVF coarse codebook over the live "
+                             "rows (jit-compiled k-means; needs jax)")
+    _store_flag(sp)
+    sp.add_argument("name")
+    sp.add_argument("--clusters", type=int, required=True,
+                    help="codebook size C (rule of thumb: ~sqrt(N))")
+    sp.add_argument("--iters", type=int, default=25)
+    sp.add_argument("--batch-rows", type=int, default=4096)
+    sp.add_argument("--seed", type=int, default=0)
+    sp.set_defaults(index_func=_cmd_train_centroids)
+
+    sp = sub.add_parser("build-ivf",
+                        help="cluster-order existing segments against the "
+                             "trained codebook (pure NumPy, no jax)")
+    _store_flag(sp)
+    sp.add_argument("name")
+    sp.set_defaults(index_func=_cmd_build_ivf)
+
+    sp = sub.add_parser("stats",
+                        help="row/segment/ann stats incl. IVF staleness "
+                             "and re-train advice (manifest-only, no jax)")
+    _store_flag(sp)
+    sp.add_argument("name", nargs="?", default=None,
+                    help="one index (default: all)")
+    sp.set_defaults(index_func=_cmd_stats)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -53,7 +53,8 @@ import numpy as np
 from jimm_tpu.aot.store import ArtifactStore
 from jimm_tpu.serve.cache import EmbeddingCache
 
-__all__ = ["LoadedIndex", "PersistentEmbeddingCache", "RetrievalStoreError",
+__all__ = ["ANN_STALENESS_RETRAIN", "LoadedIndex",
+           "PersistentEmbeddingCache", "RetrievalStoreError",
            "RETRIEVAL_FORMAT_VERSION", "VectorStore"]
 
 #: bump when the segment payload framing or manifest schema changes —
@@ -64,6 +65,11 @@ RETRIEVAL_FORMAT_VERSION = 1
 #: ArtifactStore's LRU eviction must effectively never fire, so the default
 #: cap is far above any realistic corpus (override via max_bytes for tests)
 VECTOR_STORE_MAX_BYTES = 1 << 40
+
+#: IVF staleness fraction (unassigned or post-training growth over live
+#: rows) at which ``ann_status``/``stats`` advise re-training the codebook
+#: instead of just re-assigning (`jimm-tpu index stats` surfaces the advice)
+ANN_STALENESS_RETRAIN = 0.25
 
 _DTYPES = ("float32", "bfloat16")
 
@@ -215,10 +221,15 @@ class VectorStore:
 
     @staticmethod
     def _state_hash(man: dict) -> str:
+        # the ann block rides in the state too: swapping the codebook (or
+        # re-clustering segments via build-ivf) changes what an IVF
+        # searcher would return, so it must invalidate anything keyed on
+        # the index state even though the row set is unchanged
         h = hashlib.sha256()
         h.update(json.dumps(
             {"segments": man.get("segments", []),
-             "tombstones": sorted(man.get("tombstones", []))},
+             "tombstones": sorted(man.get("tombstones", [])),
+             "ann": man.get("ann")},
             sort_keys=True, separators=(",", ":")).encode())
         return h.hexdigest()
 
@@ -278,7 +289,15 @@ class VectorStore:
                 f"(delete them first)")
         if not np.all(np.isfinite(np.asarray(mat, np.float32))):
             raise RetrievalStoreError("vectors contain non-finite values")
-        payload = encode_segment(ids, normalize_rows(mat), man["dtype"])
+        rows = normalize_rows(mat)
+        runs = None
+        if man.get("ann"):
+            # cluster-aware write path: assign each row to its nearest
+            # centroid now, store the segment cluster-major, and record the
+            # run-length map — delete/compact/load stay unchanged, and the
+            # IVF layout builder never re-scores old segments
+            ids, rows, runs = self._cluster_major(name, man, ids, rows)
+        payload = encode_segment(ids, rows, man["dtype"])
         fp = hashlib.sha256(payload).hexdigest()
         self.artifacts.put(fp, payload,
                            meta={"label": f"retrieval:{name}",
@@ -287,10 +306,12 @@ class VectorStore:
                                  "vector_dtype": man["dtype"],
                                  "retrieval_format":
                                      RETRIEVAL_FORMAT_VERSION})
-        man["segments"] = list(man.get("segments", [])) + [
-            {"fingerprint": fp, "rows": len(ids), "ids": ids}]
-        man["tombstones"] = sorted(set(man.get("tombstones", []))
-                                   - set(ids))
+        entry = {"fingerprint": fp, "rows": len(ids), "ids": ids}
+        if runs is not None:
+            entry["clusters"] = runs
+        man["segments"] = list(man.get("segments", [])) + [entry]
+        man["tombstones"] = sorted(  # jaxlint: disable=JL011 string ids
+            set(man.get("tombstones", [])) - set(ids))
         self._write_manifest(name, man)
         return fp
 
@@ -316,8 +337,15 @@ class VectorStore:
         reclaimed = 0
         new_segments = []
         if len(loaded):
-            payload = encode_segment(list(loaded.ids), loaded.vectors,
-                                     man["dtype"])
+            ids, rows = list(loaded.ids), np.asarray(loaded.vectors)
+            runs = None
+            if man.get("ann"):
+                # compaction must re-emit valid cluster runs: assignment
+                # is deterministic given the codebook, and the lexsort is
+                # stable, so per-cluster row order (hence IVF results)
+                # survives the fold byte-for-byte
+                ids, rows, runs = self._cluster_major(name, man, ids, rows)
+            payload = encode_segment(ids, rows, man["dtype"])
             fp = hashlib.sha256(payload).hexdigest()
             self.artifacts.put(fp, payload,
                                meta={"label": f"retrieval:{name}",
@@ -327,8 +355,10 @@ class VectorStore:
                                      "vector_dtype": man["dtype"],
                                      "retrieval_format":
                                          RETRIEVAL_FORMAT_VERSION})
-            new_segments = [{"fingerprint": fp, "rows": len(loaded),
-                             "ids": list(loaded.ids)}]
+            entry = {"fingerprint": fp, "rows": len(loaded), "ids": ids}
+            if runs is not None:
+                entry["clusters"] = runs
+            new_segments = [entry]
         man["segments"] = new_segments
         man["tombstones"] = []
         self._write_manifest(name, man)
@@ -344,6 +374,224 @@ class VectorStore:
         return {"segments_before": len(before),
                 "segments_after": len(new_segments),
                 "rows": len(loaded), "reclaimed_bytes": reclaimed}
+
+    # -- IVF coarse quantizer (cluster-aware segments) --------------------
+
+    def _cluster_major(self, name: str, man: dict, ids: Sequence[str],
+                       rows: np.ndarray
+                       ) -> tuple[list[str], np.ndarray, list[list[int]]]:
+        """Assign ``rows`` to the index codebook and reorder them
+        cluster-major (stable within a cluster, so relative row order is
+        preserved). Returns ``(ids, rows, runs)`` where ``runs`` is the
+        ``[[cluster_id, count], ...]`` run-length map the manifest
+        records per segment."""
+        from jimm_tpu.retrieval.ann.kmeans import (assign_clusters,
+                                                   cluster_runs)
+        cents, _meta = self._codebook_for(name, man)
+        assign = assign_clusters(np.asarray(rows, np.float32), cents)
+        # stable cluster-major order without a banned full argsort:
+        # lexsort keys (row position, cluster id) — last key is primary
+        order = np.lexsort((np.arange(len(assign)), assign))
+        ids = [ids[i] for i in order]
+        rows = np.asarray(rows)[order]
+        return ids, rows, cluster_runs(assign[order])
+
+    def set_codebook(self, name: str, centroids: np.ndarray, *,
+                     trained_rows: int | None = None,
+                     seed: int = 0) -> str:
+        """Persist a trained centroid codebook as one content-addressed
+        artifact and reference it from the manifest's ``ann`` block.
+        Existing segments keep their (now run-less) layout — run
+        ``build_ivf`` to re-cluster them; rows added afterwards are
+        assigned at write time. Returns the codebook fingerprint."""
+        from jimm_tpu.retrieval.ann.kmeans import encode_codebook
+        man = self.manifest(name)
+        cents = np.asarray(centroids, np.float32)
+        if cents.ndim != 2 or cents.shape[1] != int(man["dim"]):
+            raise RetrievalStoreError(
+                f"codebook must be (C, {man['dim']}); got "
+                f"{tuple(cents.shape)}")
+        if not np.all(np.isfinite(cents)):
+            raise RetrievalStoreError("codebook contains non-finite values")
+        if trained_rows is None:
+            trained_rows = len(self._live_ids(man))
+        payload = encode_codebook(cents, trained_rows=int(trained_rows),
+                                  seed=int(seed))
+        fp = hashlib.sha256(payload).hexdigest()
+        self.artifacts.put(fp, payload,
+                           meta={"label": f"retrieval:{name}",
+                                 "kind": "codebook",
+                                 "clusters": int(cents.shape[0]),
+                                 "dim": int(man["dim"]),
+                                 "retrieval_format":
+                                     RETRIEVAL_FORMAT_VERSION})
+        # a new codebook invalidates every old run-length map: drop the
+        # per-segment cluster metadata so staleness (and build_ivf) see
+        # those segments as unassigned under the *current* codebook
+        man["segments"] = [
+            {k: v for k, v in seg.items() if k != "clusters"}
+            for seg in man.get("segments", [])]
+        man["ann"] = {"codebook": fp, "clusters": int(cents.shape[0]),
+                      "trained_rows": int(trained_rows), "seed": int(seed)}
+        self._write_manifest(name, man)
+        return fp
+
+    def _codebook_for(self, name: str, man: dict
+                      ) -> tuple[np.ndarray, dict]:
+        ann = man.get("ann")
+        if not ann:
+            raise RetrievalStoreError(
+                f"index {name!r} has no codebook (run `jimm-tpu index "
+                f"train-centroids` first)")
+        from jimm_tpu.retrieval.ann.kmeans import decode_codebook
+        fp = ann["codebook"]
+        cached = self.hot.get(f"codebook:{fp}")
+        if cached is not None:
+            return cached
+        payload = self.artifacts.get(fp)
+        if payload is None:
+            raise RetrievalStoreError(
+                f"index {name!r} references codebook {fp[:12]}... which "
+                f"is missing or failed integrity checks")
+        try:
+            cents, meta = decode_codebook(payload)
+        except RetrievalStoreError:
+            self.artifacts.quarantine(fp,
+                                      "codebook payload failed to decode")
+            raise
+        if cents.shape[1] != int(man["dim"]):
+            raise RetrievalStoreError(
+                f"codebook dim {cents.shape[1]} != index dim {man['dim']}")
+        self.hot.put(f"codebook:{fp}", (cents, meta))  # type: ignore[arg-type]
+        return cents, meta
+
+    def codebook(self, name: str) -> tuple[np.ndarray, dict] | None:
+        """The index's ``(centroids (C, D) f32, header meta)`` codebook,
+        or None when the index has none."""
+        man = self.manifest(name)
+        if not man.get("ann"):
+            return None
+        return self._codebook_for(name, man)
+
+    def load_assignments(self, name: str) -> np.ndarray | None:
+        """Per-live-row cluster ids aligned with ``load(name)``'s row
+        order (same dead/owner filtering), ``-1`` for rows in segments
+        without cluster runs; None when the index has no codebook. Pure
+        manifest walk — no segment bytes are read."""
+        man = self.manifest(name)
+        if not man.get("ann"):
+            return None
+        dead = set(man.get("tombstones", []))
+        owner: dict[str, int] = {}
+        for si, seg in enumerate(man.get("segments", [])):
+            for sid in seg["ids"]:
+                owner[sid] = si
+        parts: list[np.ndarray] = []
+        for si, seg in enumerate(man.get("segments", [])):
+            runs = seg.get("clusters")
+            if runs is not None:
+                cids = np.repeat(
+                    np.asarray([int(r[0]) for r in runs], np.int32),
+                    np.asarray([int(r[1]) for r in runs], np.int64))
+                if cids.shape[0] != int(seg["rows"]):
+                    raise RetrievalStoreError(
+                        f"index {name!r}: segment cluster runs cover "
+                        f"{cids.shape[0]} rows, segment has {seg['rows']}")
+            else:
+                cids = np.full(int(seg["rows"]), -1, np.int32)
+            keep = [i for i, sid in enumerate(seg["ids"])
+                    if sid not in dead and owner.get(sid) == si]
+            if keep:
+                parts.append(cids[keep])
+        return (np.concatenate(parts) if parts
+                else np.zeros((0,), np.int32))
+
+    def ann_status(self, name: str) -> dict | None:
+        """IVF health for one index: live/unassigned row counts and the
+        staleness fraction (max of the unassigned share and the
+        post-training growth share) with re-train advice. None when the
+        index has no codebook. Manifest-only — jax-free and cheap."""
+        man = self.manifest(name)
+        ann = man.get("ann")
+        if not ann:
+            return None
+        dead = set(man.get("tombstones", []))
+        owner: dict[str, int] = {}
+        for si, seg in enumerate(man.get("segments", [])):
+            for sid in seg["ids"]:
+                owner[sid] = si
+        live = unassigned = 0
+        for si, seg in enumerate(man.get("segments", [])):
+            kept = sum(1 for sid in seg["ids"]
+                       if sid not in dead and owner.get(sid) == si)
+            live += kept
+            if "clusters" not in seg:
+                unassigned += kept
+        trained = int(ann.get("trained_rows", 0))
+        unassigned_frac = unassigned / live if live else 0.0
+        growth_frac = max(0, live - trained) / live if live else 0.0
+        staleness = round(max(unassigned_frac, growth_frac), 4)
+        if staleness >= ANN_STALENESS_RETRAIN:
+            advice = "retrain"
+        elif unassigned:
+            advice = "build-ivf"
+        else:
+            advice = "ok"
+        return {"clusters": int(ann["clusters"]),
+                "codebook": str(ann["codebook"])[:12],
+                "trained_rows": trained, "live_rows": live,
+                "unassigned_rows": unassigned, "staleness": staleness,
+                "advice": advice}
+
+    def build_ivf(self, name: str) -> dict:
+        """Re-cluster every segment that lacks run-length metadata:
+        decode, assign against the current codebook, rewrite
+        cluster-major, and swap the manifest entry in place (segment
+        order — hence id ownership — is preserved). Returns a
+        {segments, rewritten, reclaimed_bytes, staleness} report."""
+        man = self.manifest(name)
+        cents, _meta = self._codebook_for(name, man)
+        from jimm_tpu.retrieval.ann.kmeans import (assign_clusters,
+                                                   cluster_runs)
+        segments = list(man.get("segments", []))
+        rewritten = reclaimed = 0
+        for si, seg in enumerate(segments):
+            if "clusters" in seg:
+                continue
+            seg_ids, seg_mat = self._read_segment(name, seg["fingerprint"])
+            assign = assign_clusters(np.asarray(seg_mat, np.float32),
+                                     cents)
+            order = np.lexsort((np.arange(len(assign)), assign))
+            new_ids = [seg_ids[i] for i in order]
+            new_mat = seg_mat[order]
+            payload = encode_segment(new_ids, new_mat, man["dtype"])
+            fp = hashlib.sha256(payload).hexdigest()
+            self.artifacts.put(fp, payload,
+                               meta={"label": f"retrieval:{name}",
+                                     "kind": "segment",
+                                     "rows": len(new_ids),
+                                     "dim": int(man["dim"]),
+                                     "vector_dtype": man["dtype"],
+                                     "retrieval_format":
+                                         RETRIEVAL_FORMAT_VERSION})
+            old_fp = seg["fingerprint"]
+            segments[si] = {"fingerprint": fp, "rows": len(new_ids),
+                            "ids": new_ids,
+                            "clusters": cluster_runs(assign[order])}
+            rewritten += 1
+            if old_fp != fp:
+                entry = self.artifacts.entry_dir(old_fp)
+                if entry.exists():
+                    reclaimed += sum(p.stat().st_size
+                                     for p in entry.rglob("*")
+                                     if p.is_file())
+                    shutil.rmtree(entry, ignore_errors=True)
+        man["segments"] = segments
+        self._write_manifest(name, man)
+        status = self.ann_status(name) or {}
+        return {"segments": len(segments), "rewritten": rewritten,
+                "reclaimed_bytes": reclaimed,
+                "staleness": status.get("staleness", 0.0)}
 
     # -- read -------------------------------------------------------------
 
@@ -413,13 +661,17 @@ class VectorStore:
             art = entry / "artifact.bin"
             if art.is_file():
                 nbytes += art.stat().st_size
-        return {"name": name, "rows": live, "dim": int(man["dim"]),
-                "dtype": man["dtype"], "metric": man["metric"],
-                "segments": len(segs), "dead_rows": total_rows - live,
-                "tombstones": len(man.get("tombstones", [])),
-                "bytes": nbytes,
-                "updated": float(man.get("updated",
-                                         man.get("created", 0.0)))}
+        out = {"name": name, "rows": live, "dim": int(man["dim"]),
+               "dtype": man["dtype"], "metric": man["metric"],
+               "segments": len(segs), "dead_rows": total_rows - live,
+               "tombstones": len(man.get("tombstones", [])),
+               "bytes": nbytes,
+               "updated": float(man.get("updated",
+                                        man.get("created", 0.0)))}
+        ann = self.ann_status(name)
+        if ann is not None:
+            out["ann"] = ann
+        return out
 
     def ls(self) -> list[dict]:
         return [self.stats(name) for name in self.names()]
@@ -450,16 +702,29 @@ class VectorStore:
                         reason = str(e)
                         self.artifacts.quarantine(fp, reason)
                     else:
+                        runs = seg.get("clusters")
                         if seg_ids != [str(s) for s in seg["ids"]]:
                             reason = "segment ids disagree with manifest"
                         elif seg_mat.shape[1] != man["dim"]:
                             reason = (f"segment dim {seg_mat.shape[1]} != "
                                       f"index dim {man['dim']}")
+                        elif runs is not None and \
+                                sum(int(r[1]) for r in runs) != \
+                                int(seg["rows"]):
+                            reason = (f"cluster runs cover "
+                                      f"{sum(int(r[1]) for r in runs)} "
+                                      f"rows, segment has {seg['rows']}")
                         if reason:
                             self.artifacts.quarantine(fp, reason)
                 if reason:
                     problems.append({"index": nm, "segment": fp,
                                      "reason": reason})
+            ann = man.get("ann")
+            if ann and self.artifacts.get(ann["codebook"]) is None:
+                problems.append({"index": nm,
+                                 "segment": ann["codebook"],
+                                 "reason": "codebook artifact missing or "
+                                           "failed store integrity"})
         return problems
 
     # -- prompt-embedding tier --------------------------------------------

@@ -162,6 +162,14 @@ def merge_partials(values: np.ndarray, indices: np.ndarray,
     partials, batch, kk = values.shape
     flat_v = values.transpose(1, 0, 2).reshape(batch, partials * kk)
     flat_i = indices.transpose(1, 0, 2).reshape(batch, partials * kk)
+    if flat_v.shape[1] < k:
+        # k exceeds the total candidate fan-in (k > live rows, or every
+        # segment tombstoned): keep the (B, k) shape contract and let
+        # (-inf, -1) padding mark the underfill explicitly
+        pad = k - flat_v.shape[1]
+        flat_v = np.pad(flat_v, ((0, 0), (0, pad)),
+                        constant_values=-np.inf)
+        flat_i = np.pad(flat_i, ((0, 0), (0, pad)), constant_values=-1)
     # padding candidates (idx -1, val -inf) must lose every comparison,
     # including against real -inf scores, so push their index to +inf-ish
     sort_i = np.where(flat_i < 0, np.iinfo(np.int64).max, flat_i)

@@ -243,10 +243,13 @@ class ServeClient:
                              deadline_s=timeout_s)
 
     def search(self, *, vector=None, image=None, k: int | None = None,
+               nprobe: int | None = None,
                timeout_s: float | None = None) -> dict:
         """Top-k over the server's retrieval index. Pass a raw ``vector``
         (searched directly) or an ``image`` (embedded through the engine
-        first). Returns ``{"ids", "scores", "index", "k", "trace_id"}``."""
+        first). ``nprobe`` widens/narrows the probe per request when the
+        server runs ``--index-mode ivf`` (rejected in exact mode).
+        Returns ``{"ids", "scores", "index", "k", "trace_id"}``."""
         if (vector is None) == (image is None):
             raise ValueError("search needs exactly one of vector= or "
                              "image=")
@@ -258,6 +261,8 @@ class ServeClient:
             payload = encode_image_payload(image)
         if k is not None:
             payload["k"] = int(k)
+        if nprobe is not None:
+            payload["nprobe"] = int(nprobe)
         if timeout_s is not None:
             payload["timeout_s"] = timeout_s
         return self._request("POST", "/v1/search", payload,

@@ -422,12 +422,29 @@ class ServingServer:
                 self._submit(image, payload.get("timeout_s"), rid,
                              engine=engine, tenant=payload.get("tenant")),
                 np.float32)
-        values, ids = self.retrieval.search_blocking(query,
-                                                     k=payload.get("k"))
-        return {"index": self.retrieval.index.name,
-                "k": len(ids[0]), "ids": ids[0],
-                "scores": [round(float(v), 6) for v in values[0]],
-                "trace_id": rid}
+        nprobe = payload.get("nprobe")
+        if nprobe is not None:
+            try:
+                nprobe = int(nprobe)
+            except (TypeError, ValueError):
+                raise RequestError(
+                    f"'nprobe' must be an integer; got {nprobe!r}") \
+                    from None
+        values, ids = self.retrieval.search_blocking(
+            query, k=payload.get("k"), nprobe=nprobe)
+        # ivf rows can under-fill (probed clusters hold < k rows): the id
+        # list is the source of truth, scores truncate to match
+        out = {"index": self.retrieval.index.name,
+               "k": len(ids[0]), "ids": ids[0],
+               "scores": [round(float(v), 6)
+                          for v in values[0][:len(ids[0])]],
+               "trace_id": rid}
+        if self.retrieval.mode == "ivf":
+            out["index_mode"] = "ivf"
+            out["nprobe"] = int(
+                self.retrieval.searcher.last_stats.get(
+                    "nprobe", self.retrieval.default_nprobe))
+        return out
 
     def classify(self, payload: dict) -> dict:
         if self.zero_shot is None:

@@ -28,7 +28,7 @@ from jimm_tpu.tune.cache import TuneCache, TuneKey, tune_key
 from jimm_tpu.tune.measure import measure
 from jimm_tpu.tune.space import (bias_flash_space, flash_space,
                                  int8_flash_space, int8_matmul_space,
-                                 ln_space, masked_flash_space,
+                                 ivf_space, ln_space, masked_flash_space,
                                  retrieval_space, sigmoid_space)
 
 __all__ = ["KERNELS", "KernelSpec", "best_config", "configure", "get_cache",
@@ -206,6 +206,47 @@ def _retrieval_bench(shapes: Shapes, dtypes: Dtypes,
     return lambda: step(blocks, offsets, valid, queries)
 
 
+def _ivf_default(shapes: Shapes, dtypes: Dtypes) -> dict:
+    # the feasible set already accounts for the batch-multiplied gather;
+    # prefer the largest feasible block up to the exact kernel's default
+    # (fewer scan steps, less per-block top_k overhead)
+    from jimm_tpu.retrieval.topk import DEFAULT_BLOCK_N
+    feasible = {c["block_n"] for c in ivf_space(shapes, dtypes)}
+    capped = {b for b in feasible if b <= DEFAULT_BLOCK_N}
+    return {"block_n": max(capped) if capped else min(feasible)}
+
+
+def _ivf_bench(shapes: Shapes, dtypes: Dtypes,
+               config: Mapping[str, int]) -> Callable[[], Any]:
+    """Timed closure: one fused IVF pass (coarse scan + probe + rescore)
+    at the candidate block over a synthetic clustered corpus shaped like
+    the live one. Explicit block_n bypasses the tuner — no recursion."""
+    import jax
+    import numpy as np
+
+    from jimm_tpu.retrieval.ann.ivf import cluster_layout, make_ivf_fn
+    from jimm_tpu.retrieval.ann.kmeans import (assign_clusters,
+                                               clustered_rows)
+    batch, dim = int(shapes[0][-2]), int(shapes[0][-1])
+    n_rows = int(shapes[-1][-2])
+    dt = np.dtype(dtypes[-1]) if dtypes else np.dtype(np.float32)
+    clusters = max(1, min(64, n_rows // 64))
+    rows, cents = clustered_rows(n_rows, dim, clusters, seed=0)
+    corpus = np.asarray(rows, dt)
+    assign = assign_clusters(rows, cents)
+    blocks, rids, cl_start, cl_count = cluster_layout(
+        corpus, assign, clusters, block_n=int(config["block_n"]))
+    nprobe_max = max(1, min(8, clusters))
+    max_bpc = max(1, int(cl_count.max(initial=0)))
+    rng = np.random.default_rng(1)
+    queries = rng.standard_normal((batch, dim), dtype=np.float32)
+    step = jax.jit(make_ivf_fn(10, nprobe_max, max_bpc))
+    live_c = np.int32(clusters)
+    nprobe = np.int32(nprobe_max)
+    return lambda: step(blocks, rids, np.asarray(cents, np.float32),
+                        cl_start, cl_count, live_c, nprobe, queries)
+
+
 def _int8_matmul_default(shapes: Shapes, dtypes: Dtypes) -> dict:
     from jimm_tpu.ops.int8_matmul import DEFAULT_BLOCK_M, DEFAULT_BLOCK_N
     return {"block_m": DEFAULT_BLOCK_M, "block_n": DEFAULT_BLOCK_N}
@@ -290,6 +331,9 @@ KERNELS: dict[str, KernelSpec] = {
     "retrieval_topk": KernelSpec(version=1, space=retrieval_space,
                                  default=_retrieval_default,
                                  bench=_retrieval_bench),
+    "retrieval_ivf": KernelSpec(version=1, space=ivf_space,
+                                default=_ivf_default,
+                                bench=_ivf_bench),
     "int8_matmul": KernelSpec(version=1, space=int8_matmul_space,
                               default=_int8_matmul_default,
                               bench=_int8_matmul_bench),

@@ -17,7 +17,8 @@ __all__ = ["FLASH_BLOCKS", "INT8_FLASH_BLOCKS", "INT8_MATMUL_BLOCK_M",
            "VMEM_BUDGET", "bias_flash_space", "bias_flash_vmem_bytes",
            "flash_space", "flash_vmem_bytes", "int8_flash_space",
            "int8_flash_vmem_bytes", "int8_matmul_space",
-           "int8_matmul_vmem_bytes", "kernel_space", "ln_space",
+           "int8_matmul_vmem_bytes", "ivf_space", "ivf_vmem_bytes",
+           "kernel_space", "ln_space",
            "ln_vmem_bytes", "masked_flash_space", "masked_flash_vmem_bytes",
            "retrieval_space", "retrieval_vmem_bytes", "sigmoid_space",
            "sigmoid_vmem_bytes"]
@@ -176,6 +177,38 @@ def retrieval_space(shapes: Sequence[Sequence[int]],
     return out or [{"block_n": RETRIEVAL_BLOCK_N[0]}]
 
 
+def ivf_vmem_bytes(block_n: int, dim: int, batch: int = 64) -> int:
+    """Coarse resident working set of one IVF rescore step. Unlike the
+    exact scan — one shared block per step — the IVF scan gathers *each
+    query its own* candidate block, so the f32-upcast block tile and the
+    id row are batch-multiplied: feasible blocks shrink as the query
+    bucket grows. Doubled for the pipeline's in-flight gather."""
+    fp_d = _ceil_to(dim, _LANES)
+    return 2 * batch * (block_n * fp_d * 4   # gathered (B, bn, D) blocks
+                        + block_n * 4        # (B, bn) scores
+                        + block_n * 4        # (B, bn) row-id gather
+                        + fp_d * 4)          # query tile
+
+
+def ivf_space(shapes: Sequence[Sequence[int]],
+              dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Feasible ``{"block_n"}`` candidates for an IVF workload shaped
+    ``[(batch, dim), (n_rows, dim)]``. Same candidate grid as the exact
+    scan; the batch-multiplied VMEM model does the pruning. Smaller blocks
+    also waste less rescore work (a cluster pads to whole blocks), so the
+    feasibility floor returning the smallest block is the safe default."""
+    batch, dim = int(shapes[0][-2]), int(shapes[0][-1])
+    n_rows = int(shapes[-1][-2])
+    out = []
+    for bn in RETRIEVAL_BLOCK_N:
+        if bn > _ceil_to(max(n_rows, 1), _LANES) and out:
+            continue
+        if ivf_vmem_bytes(bn, dim, batch) > VMEM_BUDGET:
+            continue
+        out.append({"block_n": bn})
+    return out or [{"block_n": RETRIEVAL_BLOCK_N[0]}]
+
+
 #: int8 matmul grid tiles: rows align to the int8 32-sublane tile, columns
 #: to 128 lanes. The wrapper clamps to the padded M/N, so oversize
 #: candidates are pruned here as redundant.
@@ -260,6 +293,7 @@ _SPACES = {"flash_attention": flash_space,
            "sigmoid_attention": sigmoid_space,
            "layer_norm": ln_space,
            "retrieval_topk": retrieval_space,
+           "retrieval_ivf": ivf_space,
            "int8_matmul": int8_matmul_space,
            "flash_attention_int8": int8_flash_space}
 

@@ -1,0 +1,197 @@
+"""CI tier-1 smoke for IVF approximate retrieval (docs/retrieval.md).
+
+Forces 8 virtual CPU devices, builds a 50k-vector clustered index, and
+proves the ANN subsystem end to end in one process:
+
+1. **Store + codebook**: a tmp :class:`VectorStore` gets 40k clustered
+   unit rows, trains a 128-centroid codebook (seeded, deterministic),
+   cluster-orders the existing segment with ``build_ivf``, then appends
+   10k more rows through the cluster-aware write path (runs recorded at
+   add time — staleness stays 0).
+2. **Life 1**: an ivf-mode :class:`RetrievalService` over
+   ``plan_topology(2, 2)`` warms every (replica, bucket) against a tmp
+   AOT store; write-through populates it (one fingerprint per bucket —
+   equally-padded cluster partitions share programs).
+3. **Warm restart**: a second service reaches readiness with ZERO fresh
+   traces and every bucket sourced ``"aot"``.
+4. **Recall**: warm-service top-10 at the smoke ``nprobe`` vs the exact
+   NumPy oracle over 128 mixture queries — recall@10 must be ≥ 0.95.
+5. **Runtime nprobe**: sweeping nprobe across the compiled probe ceiling
+   on the warm service must add ZERO traces (nprobe is a runtime scalar;
+   every value shares the padded layout's one program).
+6. **jax-free stats**: ``jimm-tpu index stats`` in a subprocess must
+   report the ann block (clusters, staleness, advice) without importing
+   jax.
+
+Exits nonzero (with a JSON error line) on any violation.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m scripts.ann_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROWS_BASE = 40_000
+ROWS_ADD = 10_000
+DIM = 64
+CENTERS = 128          # mixture components in the synthetic corpus
+CLUSTERS = 128         # trained codebook size (~sqrt(50k) rounded up)
+K = 10
+BLOCK_N = 128
+NPROBE_SMOKE = 8
+NPROBE_MAX = 16
+REPLICAS = 2
+MODEL_PARALLEL = 2
+RECALL_QUERIES = 128
+RECALL_FLOOR = 0.95
+
+
+def fail(msg: str) -> int:
+    print(json.dumps({"metric": "ann_smoke", "value": 0.0,
+                      "error": msg}), flush=True)
+    return 1
+
+
+def main() -> int:
+    # must land before jax initializes its backends
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+
+    import jax
+    import numpy as np
+
+    from jimm_tpu.aot import ArtifactStore
+    from jimm_tpu.retrieval import RetrievalService, VectorStore
+    from jimm_tpu.retrieval.ann import clustered_rows, train_centroids
+    from jimm_tpu.serve import plan_topology
+
+    if jax.device_count() < REPLICAS * MODEL_PARALLEL:
+        return fail(f"need {REPLICAS * MODEL_PARALLEL} devices, have "
+                    f"{jax.device_count()} — was XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count=8 set before "
+                    f"another jax import?")
+
+    total = ROWS_BASE + ROWS_ADD
+    corpus, centers = clustered_rows(total, DIM, CENTERS, seed=3)
+    queries, _ = clustered_rows(RECALL_QUERIES, DIM, CENTERS, seed=11,
+                                center_mat=centers)
+    ids = [f"doc{i:05d}" for i in range(total)]
+    plan = plan_topology(REPLICAS, MODEL_PARALLEL)
+    buckets = (1, 8)
+
+    with tempfile.TemporaryDirectory(prefix="jimm-ann-smoke-") as root:
+        idx_root = os.path.join(root, "index")
+        vstore = VectorStore(idx_root)
+        vstore.create("corpus", DIM)
+        # segment 1 predates the codebook: build_ivf must retrofit it
+        vstore.add("corpus", ids[:ROWS_BASE], corpus[:ROWS_BASE])
+        codebook = train_centroids(corpus[:ROWS_BASE], CLUSTERS, seed=0)
+        vstore.set_codebook("corpus", codebook, trained_rows=ROWS_BASE)
+        report = vstore.build_ivf("corpus")
+        if report["rewritten"] != 1:
+            return fail(f"build_ivf should rewrite the pre-codebook "
+                        f"segment; report={report}")
+        # segment 2 rides the cluster-aware write path (runs at add time)
+        vstore.add("corpus", ids[ROWS_BASE:], corpus[ROWS_BASE:])
+        status = vstore.ann_status("corpus")
+        if status["unassigned_rows"]:
+            return fail(f"cluster-aware add left unassigned rows: "
+                        f"{status}")
+        store = ArtifactStore(os.path.join(root, "aot"))
+
+        # --- life 1: populate the AOT store through warmup ---------------
+        svc1 = RetrievalService.from_store(
+            vstore, "corpus", k=K, buckets=buckets, block_n=BLOCK_N,
+            plan=plan, aot_store=store, mode="ivf", nprobe=NPROBE_SMOKE,
+            nprobe_max=NPROBE_MAX)
+        svc1.warmup()
+        if not store.entries():
+            return fail("life-1 warmup wrote nothing to the AOT store")
+        fps = {s.key_for(b).fingerprint()
+               for s in svc1.searcher.searchers for b in buckets}
+        if len(fps) != len(buckets):
+            return fail(f"replica partitions must share one fingerprint "
+                        f"per bucket; got {len(fps)} for {len(buckets)} "
+                        f"buckets")
+
+        # --- warm restart: ivf executables round-trip ---------------------
+        service = RetrievalService.from_store(
+            vstore, "corpus", k=K, buckets=buckets, block_n=BLOCK_N,
+            plan=plan, aot_store=store, mode="ivf", nprobe=NPROBE_SMOKE,
+            nprobe_max=NPROBE_MAX)
+        warm = service.warmup()
+        if service.trace_count():
+            return fail(f"warm restart paid {service.trace_count()} fresh "
+                        f"traces; ivf artifacts did not round-trip")
+        bad = {b: s for b, s in warm.items() if s != "aot"}
+        if bad:
+            return fail(f"warm restart buckets not fully AOT-sourced: "
+                        f"{bad}")
+
+        # --- recall@10 vs the exact oracle --------------------------------
+        # (host argsort is the *oracle*, not the serving path)
+        oracle = np.argsort(-(queries @ corpus.T), axis=1,
+                            kind="stable")[:, :K]
+        oracle_ids = [{ids[j] for j in row} for row in oracle]
+        hits = 0
+        for start in range(0, RECALL_QUERIES, buckets[-1]):
+            batch = queries[start:start + buckets[-1]]
+            _vals, id_rows = service.search_blocking(batch)
+            for qi, row in enumerate(id_rows):
+                hits += len(set(row) & oracle_ids[start + qi])
+        recall = hits / (RECALL_QUERIES * K)
+        if recall < RECALL_FLOOR:
+            return fail(f"recall@{K} = {recall:.4f} < {RECALL_FLOOR} at "
+                        f"nprobe={NPROBE_SMOKE}")
+
+        # --- runtime nprobe: one padded layout, zero recompiles -----------
+        traces_before = service.trace_count()
+        for nprobe in (1, 2, NPROBE_SMOKE, NPROBE_MAX):
+            service.search_blocking(queries[:buckets[-1]], nprobe=nprobe)
+        nprobe_delta = service.trace_count() - traces_before
+        if nprobe_delta:
+            return fail(f"nprobe sweep retraced {nprobe_delta}x — nprobe "
+                        f"must be a runtime scalar on one program")
+
+        # --- `jimm-tpu index stats` stays jax-free ------------------------
+        code = (
+            "import json, sys\n"
+            "from jimm_tpu.retrieval.cli import main\n"
+            "rc = main(['stats', '--store', sys.argv[1], 'corpus'])\n"
+            "assert 'jax' not in sys.modules, 'index stats dragged in jax'\n"
+            "sys.exit(rc)\n")
+        proc = subprocess.run(
+            [sys.executable, "-c", code, idx_root],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": ""})
+        if proc.returncode != 0:
+            return fail(f"jax-free `index stats` failed: "
+                        f"{proc.stderr.strip()[-300:]}")
+        stats = json.loads(proc.stdout)
+        if stats.get("ann", {}).get("clusters") != CLUSTERS:
+            return fail(f"index stats ann block wrong: {stats.get('ann')}")
+
+        print(json.dumps({
+            "metric": "ann_smoke", "value": 1.0,
+            "rows": total, "dim": DIM, "clusters": CLUSTERS, "k": K,
+            "block_n": BLOCK_N, "nprobe": NPROBE_SMOKE,
+            "nprobe_max": NPROBE_MAX,
+            "topology": plan.describe(),
+            "recall_at_10": round(recall, 4),
+            "candidate_frac": service.searcher.last_stats.get(
+                "candidate_frac"),
+            "staleness": status["staleness"],
+            "warm_restart": {str(b): s for b, s in sorted(warm.items())},
+            "store_entries": len(store.entries()),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,7 +51,8 @@ def describe(rec: dict) -> str:
         parts.append(str(rec["case"]))
     if "metric" in rec and "variant" not in rec:
         parts.append(str(rec["metric"]))
-    for k in ("mfu", "images_per_sec", "step_time_ms"):
+    for k in ("mfu", "images_per_sec", "step_time_ms", "recall_at_10",
+              "nprobe"):
         if isinstance(rec.get(k), (int, float)):
             parts.append(f"{k}={rec[k]}")
     if "value" in rec and "mfu" not in rec:

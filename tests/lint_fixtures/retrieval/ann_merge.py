@@ -1,0 +1,22 @@
+"""JL011 fixture: the IVF merge-path temptation — candidate lists come
+back per probe, and the easy (wrong) move is a host argsort over them."""
+import numpy as np
+
+
+def merge_probed_candidates(cand_vals_dev, cand_idx_dev):
+    vals = np.asarray(cand_vals_dev)       # host copy of probe rescores
+    idx = np.asarray(cand_idx_dev)
+    order = np.argsort(-vals, axis=-1)     # JL011: full argsort on host
+    ranked = sorted(vals.ravel())          # JL011: sorted() on array data
+    return np.take_along_axis(idx, order, axis=-1), ranked
+
+
+def merge_probed_candidates_ok(cand_vals_dev, cand_idx_dev, k):
+    vals = np.asarray(cand_vals_dev)
+    idx = np.asarray(cand_idx_dev)
+    # ok: lexsort over the bounded nprobe*k candidate fan-in is the
+    # sanctioned final merge (score desc, global index asc)
+    sort_i = np.where(idx < 0, np.iinfo(np.int64).max, idx)
+    order = np.lexsort((sort_i, -vals), axis=-1)[:, :k]
+    return (np.take_along_axis(vals, order, axis=1),
+            np.take_along_axis(idx, order, axis=1))

@@ -1,0 +1,462 @@
+"""jimm_tpu.retrieval.ann: k-means trainer, cluster-major layout, and the
+fused two-stage IVF searcher.
+
+The parity tests pin IVF to the same stable NumPy argsort oracle the exact
+kernel answers to: a full probe (nprobe == clusters) must reproduce the
+oracle bit-exactly (indices AND tie order), partial probes must clear a
+recall floor on clustered data, and sweeping the runtime ``nprobe`` scalar
+must never retrace. The sharded tests mirror TestShardedParity: equal-
+padded cluster partitions over plan_topology(2, 2) share one AOT
+fingerprint and reach a zero-trace second life.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from jimm_tpu.retrieval import RetrievalService, RetrievalStoreError, \
+    VectorStore
+from jimm_tpu.retrieval.ann import (DEFAULT_NPROBE, CODEBOOK_FORMAT_VERSION,
+                                    IvfIndexSearcher, IvfSearcher,
+                                    assign_clusters, cluster_layout,
+                                    clustered_rows, decode_codebook,
+                                    encode_codebook, train_centroids)
+from jimm_tpu.retrieval.ann.kmeans import cluster_runs
+from jimm_tpu.retrieval.store import ANN_STALENESS_RETRAIN
+
+
+def oracle_topk(queries, corpus, k):
+    """Stable argsort reference (ties -> lowest global index first)."""
+    scores = (np.asarray(queries, np.float32)
+              @ np.asarray(corpus, np.float32).T)
+    order = np.argsort(-scores, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(scores, order, axis=1), order
+
+
+# ---------------------------------------------------------------------------
+# k-means trainer + codebook persistence
+# ---------------------------------------------------------------------------
+
+class TestKmeans:
+    def test_deterministic_unit_codebook_no_empty_clusters(self):
+        rows, _ = clustered_rows(600, 16, 12, seed=5)
+        a = train_centroids(rows, 8, iters=6, seed=1)
+        b = train_centroids(rows, 8, iters=6, seed=1)
+        assert np.array_equal(a, b)  # bit-identical per seed
+        assert a.shape == (8, 16) and a.dtype == np.float32
+        assert np.allclose(np.linalg.norm(a, axis=1), 1.0, atol=1e-5)
+        sizes = np.bincount(assign_clusters(rows, a), minlength=8)
+        assert np.all(sizes > 0)  # re-split leaves no empty centroid
+        c = train_centroids(rows, 8, iters=6, seed=2)
+        assert not np.array_equal(a, c)  # the seed actually matters
+
+    def test_train_rejects_degenerate_inputs(self):
+        rows, _ = clustered_rows(10, 8, 2, seed=0)
+        with pytest.raises(ValueError, match="n_clusters"):
+            train_centroids(rows, 0)
+        with pytest.raises(ValueError, match="at least"):
+            train_centroids(rows, 11)
+
+    def test_assign_is_chunk_invariant_lowest_tie(self):
+        rows, _ = clustered_rows(300, 8, 4, seed=2)
+        cents = train_centroids(rows, 4, iters=3, seed=0)
+        got = assign_clusters(rows, cents)
+        want = np.argmax(rows @ cents.T, axis=1)
+        assert np.array_equal(got, want)
+        dup = np.vstack([cents[0], cents[0]])  # exact tie -> lowest id
+        assert np.all(assign_clusters(rows[:5], dup) == 0)
+
+    def test_codebook_round_trip_and_framing_errors(self):
+        cents = train_centroids(clustered_rows(64, 8, 4, seed=1)[0], 4,
+                                iters=2, seed=0)
+        payload = encode_codebook(cents, trained_rows=64, seed=7)
+        mat, header = decode_codebook(payload)
+        assert np.array_equal(mat, cents)
+        assert header["codebook_format"] == CODEBOOK_FORMAT_VERSION
+        assert header["trained_rows"] == 64 and header["seed"] == 7
+        with pytest.raises(RetrievalStoreError, match="header"):
+            decode_codebook(b"not-json\n" + payload)
+        with pytest.raises(RetrievalStoreError, match="bytes"):
+            decode_codebook(payload[:-4])  # truncated body
+        head, _, _ = payload.partition(b"\n")
+        bad = json.loads(head)
+        bad["codebook_format"] = 99
+        with pytest.raises(RetrievalStoreError, match="format"):
+            decode_codebook(json.dumps(bad).encode() + b"\n")
+
+
+# ---------------------------------------------------------------------------
+# cluster-major device layout
+# ---------------------------------------------------------------------------
+
+class TestClusterLayout:
+    def test_no_block_spans_two_clusters(self):
+        rows, _ = clustered_rows(130, 8, 6, seed=3)
+        assign = assign_clusters(rows, train_centroids(rows, 6, iters=3,
+                                                       seed=0))
+        blocks, rids, cl_start, cl_count = cluster_layout(
+            rows, assign, 6, block_n=16)
+        counts = np.bincount(assign, minlength=6)
+        assert np.array_equal(cl_count, (counts + 15) // 16)
+        assert blocks.shape[0] == int(cl_count.sum())
+        for c in range(6):
+            span = rids[cl_start[c]:cl_start[c] + cl_count[c]].ravel()
+            live = span[span >= 0]
+            assert len(live) == counts[c]
+            assert np.all(assign[live] == c)  # block purity
+            # stable within a cluster: global row ids ascend
+            assert np.all(np.diff(live) > 0)
+        # padding rows are -1 ids over zero vectors
+        pad = rids < 0
+        assert np.all(blocks[pad] == 0)
+
+    def test_row_ids_carry_global_index_and_pad_blocks(self):
+        rows, _ = clustered_rows(40, 8, 3, seed=4)
+        assign = np.zeros(40, np.int64)  # all one cluster
+        global_ids = np.arange(100, 140)
+        blocks, rids, _, _ = cluster_layout(rows, assign, 3, block_n=16,
+                                            row_ids=global_ids,
+                                            pad_blocks=7)
+        assert blocks.shape == (7, 16, 8)  # padded past the 3 needed
+        live = rids[rids >= 0]
+        assert np.array_equal(np.sort(live), global_ids)
+        with pytest.raises(ValueError, match="pad_blocks"):
+            cluster_layout(rows, assign, 3, block_n=16, pad_blocks=2)
+
+    def test_run_length_encoding(self):
+        assert cluster_runs([0, 0, 2, 2, 2, 5]) == [[0, 2], [2, 3], [5, 1]]
+        assert cluster_runs([]) == []
+
+
+# ---------------------------------------------------------------------------
+# two-stage IVF vs the exact oracle
+# ---------------------------------------------------------------------------
+
+class TestIvfParity:
+    @pytest.fixture()
+    def corpus(self):
+        rows, centers = clustered_rows(900, 24, 16, seed=6)
+        queries, _ = clustered_rows(8, 24, 16, seed=7, center_mat=centers)
+        cents = train_centroids(rows, 16, iters=8, seed=0)
+        return rows, queries, cents
+
+    def test_full_probe_is_bit_exact(self, corpus):
+        rows, queries, cents = corpus
+        s = IvfSearcher(rows, assign_clusters(rows, cents), cents, k=10,
+                        nprobe_max=16, buckets=(8,), block_n=32)
+        vals, idx, cand = s.search_partial(queries, nprobe=16)
+        want_v, want_i = oracle_topk(queries, rows, 10)
+        assert np.array_equal(idx, want_i)  # incl. stable tie order
+        assert np.allclose(vals, want_v, atol=1e-5)
+        assert np.all(cand == 900)  # full probe rescored everything
+
+    def test_partial_probe_recall_and_candidate_frac(self, corpus):
+        rows, queries, cents = corpus
+        s = IvfSearcher(rows, assign_clusters(rows, cents), cents, k=10,
+                        nprobe_max=16, buckets=(8,), block_n=32)
+        _, idx, cand = s.search_partial(queries, nprobe=4)
+        _, want_i = oracle_topk(queries, rows, 10)
+        recall = np.mean([len(set(idx[b]) & set(want_i[b])) / 10
+                          for b in range(len(queries))])
+        assert recall >= 0.9  # clustered data, quarter of the clusters
+        assert np.all(cand < 900) and np.all(cand > 0)
+
+    def test_runtime_nprobe_never_retraces(self, corpus):
+        rows, queries, cents = corpus
+        s = IvfSearcher(rows, assign_clusters(rows, cents), cents, k=5,
+                        nprobe_max=16, buckets=(8,), block_n=32)
+        s.search_partial(queries, nprobe=1)
+        traces = s.trace_count()
+        assert traces == 1
+        widths = set()
+        for nprobe in (2, 4, 8, 16):
+            _, idx, cand = s.search_partial(queries, nprobe=nprobe)
+            widths.add(int(cand.sum()))
+        assert s.trace_count() == traces  # nprobe is a runtime scalar
+        assert len(widths) == 4  # and it really changes the probe set
+
+    def test_k_exceeds_probed_rows_pads_with_sentinels(self):
+        rows, _ = clustered_rows(30, 8, 4, seed=8)
+        cents = train_centroids(rows, 4, iters=3, seed=0)
+        assign = assign_clusters(rows, cents)
+        s = IvfSearcher(rows, assign, cents, k=20, nprobe_max=1,
+                        buckets=(2,), block_n=8)
+        q, _ = clustered_rows(2, 8, 4, seed=9)
+        vals, idx, _ = s.search_partial(q, nprobe=1)
+        for b in range(2):
+            live = idx[b][idx[b] >= 0]
+            probed = int(np.bincount(assign, minlength=4)[
+                assign_clusters(q[b:b + 1], cents)[0]])
+            assert len(live) == min(probed, 20)
+            assert np.all(idx[b][len(live):] == -1)
+            assert np.all(np.isneginf(vals[b][len(live):]))
+
+    def test_index_searcher_matches_oracle_and_fills_stats(self, corpus,
+                                                           tmp_path):
+        rows, queries, cents = corpus
+        store = VectorStore(tmp_path)
+        store.create("c", 24)
+        store.add("c", [f"r{i}" for i in range(900)], rows)
+        s = IvfIndexSearcher(store.load("c"), cents, k=10, nprobe_max=16,
+                             buckets=(8,), block_n=32)
+        vals, idx, ids = s.search(queries, nprobe=16)
+        want_v, want_i = oracle_topk(queries, rows, 10)  # already unit
+        assert np.array_equal(idx, want_i)
+        assert ids[0][0] == f"r{idx[0, 0]}"
+        assert s.last_stats["nprobe"] == 16.0
+        assert s.last_stats["candidate_frac"] == 1.0
+        assert s.last_stats["fill_ratio"] == 1.0
+        with pytest.raises(ValueError, match="nprobe"):
+            s.search(queries, nprobe=17)
+        with pytest.raises(ValueError, match="nprobe"):
+            s.search(queries, nprobe=0)
+
+    def test_stale_assignments_are_repaired_in_memory(self, corpus,
+                                                      tmp_path):
+        rows, queries, cents = corpus
+        store = VectorStore(tmp_path)
+        store.create("c", 24)
+        store.add("c", [f"r{i}" for i in range(900)], rows)
+        assign = assign_clusters(rows, cents).astype(np.int64)
+        stale = assign.copy()
+        stale[300:] = -1  # segments written before the codebook
+        full = IvfIndexSearcher(store.load("c"), cents, assign, k=10,
+                                nprobe_max=16, buckets=(8,), block_n=32)
+        patched = IvfIndexSearcher(store.load("c"), cents, stale, k=10,
+                                   nprobe_max=16, buckets=(8,), block_n=32)
+        fv, fi, _ = full.search(queries, nprobe=16)
+        pv, pi, _ = patched.search(queries, nprobe=16)
+        assert np.array_equal(fi, pi)
+        assert np.allclose(fv, pv, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sharded topology + AOT second life
+# ---------------------------------------------------------------------------
+
+class TestIvfSharded:
+    @pytest.fixture()
+    def built(self, tmp_path):
+        rows, centers = clustered_rows(800, 32, 12, seed=10)
+        store = VectorStore(tmp_path / "idx")
+        store.create("corpus", 32)
+        store.add("corpus", [f"v{i}" for i in range(800)], rows)
+        cents = train_centroids(rows, 12, iters=6, seed=0)
+        queries, _ = clustered_rows(4, 32, 12, seed=11, center_mat=centers)
+        return store.load("corpus"), cents, queries
+
+    def test_2x2_plan_matches_flat_bit_exact(self, built, eight_devices):
+        from jimm_tpu.serve.topology import plan_topology
+        index, cents, queries = built
+        plan = plan_topology(2, 2)
+        flat = IvfIndexSearcher(index, cents, k=10, nprobe_max=12,
+                                buckets=(4,), block_n=64)
+        sharded = IvfIndexSearcher(index, cents, k=10, nprobe_max=12,
+                                   buckets=(4,), block_n=64, plan=plan)
+        assert len(sharded.searchers) == 2
+        fv, fi, fids = flat.search(queries, nprobe=12)
+        sv, si, sids = sharded.search(queries, nprobe=12)
+        assert np.array_equal(fi, si)
+        assert np.allclose(fv, sv, atol=1e-5)
+        assert fids == sids
+
+    def test_partitions_share_fingerprint_and_aot_second_life(
+            self, built, eight_devices, tmp_path):
+        from jimm_tpu.aot import ArtifactStore
+        from jimm_tpu.serve.topology import plan_topology
+        index, cents, queries = built
+        plan = plan_topology(2, 2)
+        astore = ArtifactStore(tmp_path / "aot")
+        life1 = IvfIndexSearcher(index, cents, k=5, nprobe_max=12,
+                                 buckets=(4,), block_n=64, plan=plan,
+                                 aot_store=astore)
+        fps = {s.key_for(4).fingerprint() for s in life1.searchers}
+        assert len(fps) == 1  # equal-padded partitions, one program
+        assert life1.warmup()[4] in ("mixed", "miss")
+        life2 = IvfIndexSearcher(index, cents, k=5, nprobe_max=12,
+                                 buckets=(4,), block_n=64, plan=plan,
+                                 aot_store=astore)
+        assert life2.warmup() == {4: "aot"}
+        sv, si, _ = life2.search(queries, nprobe=12)
+        assert life2.trace_count() == 0
+        fv, fi, _ = IvfIndexSearcher(index, cents, k=5, nprobe_max=12,
+                                     buckets=(4,),
+                                     block_n=64).search(queries, nprobe=12)
+        assert np.array_equal(fi, si)
+        assert np.allclose(fv, sv, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# store integration: codebook lifecycle, cluster-aware writes, staleness
+# ---------------------------------------------------------------------------
+
+class TestStoreAnn:
+    def _build(self, tmp_path, n=400, dim=16, clusters=8):
+        rows, centers = clustered_rows(n, dim, clusters, seed=12)
+        store = VectorStore(tmp_path)
+        store.create("idx", dim)
+        store.add("idx", [f"r{i}" for i in range(n)], rows)
+        cents = train_centroids(rows, clusters, iters=5, seed=0)
+        return store, rows, cents, centers
+
+    def test_codebook_persists_and_build_ivf_retrofits(self, tmp_path):
+        store, rows, cents, _ = self._build(tmp_path)
+        assert store.codebook("idx") is None
+        assert store.ann_status("idx") is None
+        store.set_codebook("idx", cents, trained_rows=400)
+        loaded, meta = store.codebook("idx")
+        assert np.allclose(loaded, cents, atol=1e-6)
+        assert meta["trained_rows"] == 400
+        # the pre-codebook segment has no runs yet: fully unassigned,
+        # which is past the retrain threshold
+        status = store.ann_status("idx")
+        assert status["unassigned_rows"] == 400
+        assert status["staleness"] == 1.0
+        assert status["advice"] == "retrain"
+        report = store.build_ivf("idx")
+        assert report["rewritten"] == 1
+        status = store.ann_status("idx")
+        assert status["unassigned_rows"] == 0
+        assert status["staleness"] == 0.0 and status["advice"] == "ok"
+        # idempotent: a second pass finds nothing to rewrite
+        assert store.build_ivf("idx")["rewritten"] == 0
+
+    def test_cluster_aware_add_and_assignments_align(self, tmp_path):
+        store, rows, cents, centers = self._build(tmp_path)
+        store.set_codebook("idx", cents, trained_rows=400)
+        store.build_ivf("idx")
+        more, _ = clustered_rows(100, 16, 8, seed=13, center_mat=centers)
+        store.add("idx", [f"s{i}" for i in range(100)], more)
+        assert store.ann_status("idx")["unassigned_rows"] == 0
+        index = store.load("idx")
+        assign = store.load_assignments("idx")
+        assert assign.shape == (500,)
+        want = assign_clusters(index.matrix_f32(), cents)
+        assert np.array_equal(assign, want)
+
+    def test_small_unassigned_fraction_advises_build_ivf(self, tmp_path):
+        rows, centers = clustered_rows(40, 16, 8, seed=12)
+        store = VectorStore(tmp_path)
+        store.create("idx", 16)
+        store.add("idx", [f"a{i}" for i in range(40)], rows)  # run-less
+        cents = train_centroids(rows, 8, iters=5, seed=0)
+        store.set_codebook("idx", cents, trained_rows=400)
+        more, _ = clustered_rows(360, 16, 8, seed=19, center_mat=centers)
+        store.add("idx", [f"b{i}" for i in range(360)], more)  # assigned
+        status = store.ann_status("idx")
+        assert status["unassigned_rows"] == 40
+        assert status["staleness"] == pytest.approx(0.1)
+        assert status["advice"] == "build-ivf"
+
+    def test_growth_staleness_advises_retrain(self, tmp_path):
+        store, rows, cents, centers = self._build(tmp_path, n=100)
+        store.set_codebook("idx", cents, trained_rows=100)
+        store.build_ivf("idx")
+        more, _ = clustered_rows(60, 16, 8, seed=14, center_mat=centers)
+        store.add("idx", [f"s{i}" for i in range(60)], more)
+        status = store.ann_status("idx")
+        assert status["staleness"] == pytest.approx(60 / 160)
+        assert status["staleness"] > ANN_STALENESS_RETRAIN
+        assert status["advice"] == "retrain"
+        assert store.stats("idx")["ann"]["advice"] == "retrain"
+
+    def test_compact_preserves_cluster_metadata_and_ivf_parity(
+            self, tmp_path):
+        store, rows, cents, centers = self._build(tmp_path)
+        store.set_codebook("idx", cents, trained_rows=400)
+        store.build_ivf("idx")
+        more, _ = clustered_rows(80, 16, 8, seed=15, center_mat=centers)
+        store.add("idx", [f"s{i}" for i in range(80)], more)
+        store.delete("idx", [f"r{i}" for i in range(0, 400, 3)])
+        queries, _ = clustered_rows(6, 16, 8, seed=16, center_mat=centers)
+
+        def snapshot():
+            s = IvfIndexSearcher(store.load("idx"),
+                                 store.codebook("idx")[0],
+                                 store.load_assignments("idx"), k=10,
+                                 nprobe_max=8, buckets=(8,), block_n=32)
+            vals, _idx, ids = s.search(queries, nprobe=8)
+            return vals, ids
+
+        before_v, before_ids = snapshot()
+        report = store.compact("idx")
+        assert report["segments_after"] == 1
+        # the folded segment re-emits valid cluster runs: sorted cluster
+        # ids, positive counts, summing to the live row count
+        man = store.manifest("idx")
+        runs = man["segments"][0]["clusters"]
+        cids = [c for c, _n in runs]
+        assert cids == sorted(cids) and len(set(cids)) == len(cids)
+        assert all(n > 0 for _c, n in runs)
+        assert sum(n for _c, n in runs) == man["segments"][0]["rows"]
+        assert store.ann_status("idx")["unassigned_rows"] == 0
+        after_v, after_ids = snapshot()
+        assert before_ids == after_ids  # bit-parity across compaction
+        assert np.array_equal(before_v, after_v)
+
+
+# ---------------------------------------------------------------------------
+# service facade in ivf mode
+# ---------------------------------------------------------------------------
+
+class TestIvfService:
+    @pytest.fixture()
+    def built_store(self, tmp_path):
+        rows, centers = clustered_rows(500, 16, 8, seed=17)
+        store = VectorStore(tmp_path)
+        store.create("idx", 16)
+        store.add("idx", [f"r{i}" for i in range(500)], rows)
+        store.set_codebook("idx", train_centroids(rows, 8, iters=5, seed=0),
+                           trained_rows=500)
+        store.build_ivf("idx")
+        return store, centers
+
+    def test_from_store_requires_codebook(self, tmp_path):
+        store = VectorStore(tmp_path)
+        store.create("bare", 8)
+        store.add("bare", ["a"], np.ones((1, 8), np.float32))
+        with pytest.raises(RetrievalStoreError, match="train-centroids"):
+            RetrievalService.from_store(store, "bare", mode="ivf")
+
+    def test_ivf_mode_gauges_and_describe(self, built_store):
+        from jimm_tpu import obs
+        store, centers = built_store
+        svc = RetrievalService.from_store(store, "idx", k=5, block_n=32,
+                                          mode="ivf", nprobe=4,
+                                          nprobe_max=8)
+        d = svc.describe()
+        assert d["mode"] == "ivf" and d["nprobe"] == 4
+        assert d["nprobe_max"] == 8 and d["clusters"] == 8
+        queries, _ = clustered_rows(3, 16, 8, seed=18, center_mat=centers)
+        values, ids = svc.search_blocking(queries)
+        assert values.shape[0] == 3 and len(ids) == 3
+        snap = obs.snapshot()
+        assert snap["jimm_retrieval_ivf_nprobe"] == 4.0
+        assert 0.0 < snap["jimm_retrieval_ivf_candidate_frac"] <= 1.0
+        assert snap["jimm_retrieval_ivf_recall_proxy"] == 1.0
+        assert any("retrieval_ivf" in k for k in snap)
+        # per-request override moves the gauge
+        svc.search_blocking(queries, nprobe=8)
+        assert obs.snapshot()["jimm_retrieval_ivf_nprobe"] == 8.0
+
+    def test_nprobe_validation_both_modes(self, built_store):
+        from jimm_tpu.serve.admission import RequestError
+        store, _ = built_store
+        q = np.zeros((1, 16), np.float32)
+        q[0, 0] = 1.0
+        ivf = RetrievalService.from_store(store, "idx", k=5, block_n=32,
+                                          mode="ivf", nprobe_max=8)
+        with pytest.raises(RequestError, match="nprobe must be"):
+            ivf.search_blocking(q, nprobe=9)
+        with pytest.raises(RequestError, match="nprobe must be"):
+            ivf.search_blocking(q, nprobe=0)
+        exact = RetrievalService.from_store(store, "idx", k=5, block_n=32)
+        with pytest.raises(RequestError, match="ivf index mode"):
+            exact.search_blocking(q, nprobe=4)
+
+    def test_default_nprobe_caps_at_nprobe_max(self, built_store):
+        store, _ = built_store
+        svc = RetrievalService.from_store(store, "idx", k=5, block_n=32,
+                                          mode="ivf", nprobe_max=4)
+        assert svc.default_nprobe == min(DEFAULT_NPROBE, 4)

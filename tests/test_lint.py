@@ -219,6 +219,16 @@ class TestRuleFixtures:
         # np.lexsort over bounded candidates, sorted() on plain python
         # data, and the suppressed deliberate sort (lines 16-25) stay clean
 
+    def test_jl011_ivf_merge_fixture(self):
+        findings = findings_for("retrieval/ann_merge.py")
+        assert rules_and_lines(findings) == {
+            ("JL011", 9),   # np.argsort over probed candidate scores
+            ("JL011", 10),  # sorted() over array-derived candidates
+        }
+        assert all(f.severity == ERROR for f in findings)
+        # the lexsort-based bounded merge (merge_probed_candidates_ok)
+        # stays clean — it is the idiom ivf.py actually uses
+
     def test_jl011_scoped_to_serve_and_retrieval_paths(self):
         import ast
 
@@ -227,6 +237,13 @@ class TestRuleFixtures:
         tree = ast.parse(src)
         assert check_host_sort(tree, "jimm_tpu/serve/server.py") != []
         assert check_host_sort(tree, "jimm_tpu/retrieval/topk.py") != []
+        # retrieval/ann/ is covered by construction: the path test is
+        # "retrieval" anywhere in the parts, so the new subpackage (and
+        # any future one) inherits the rule without a lint change
+        assert check_host_sort(
+            tree, "jimm_tpu/retrieval/ann/ivf.py") != []
+        assert check_host_sort(
+            tree, "jimm_tpu/retrieval/ann/kmeans.py") != []
         # elsewhere a host sort is unexceptional (CLI display, training
         # eval), and test oracles *should* argsort
         assert check_host_sort(tree, "jimm_tpu/cli.py") == []

@@ -234,6 +234,27 @@ class TestStreamingTopkParity:
             assert list(got_i[b]) == [flat_i[b, j] for j in order]
             assert np.allclose(got_v[b], [flat_v[b, j] for j in order])
 
+    def test_merge_partials_k_exceeds_total_candidates(self):
+        # IVF regression: k > P * kk (few probed rows across few shards)
+        # must pad with (-inf, -1) tails, never underfill or raise
+        vals = np.array([[[3.0, 1.0]], [[2.0, 2.0]]], np.float32)
+        idx = np.array([[[7, 9]], [[4, 11]]], np.int64)
+        got_v, got_i = merge_partials(vals, idx, 6)
+        assert got_v.shape == (1, 6) and got_i.shape == (1, 6)
+        # exact tie at 2.0: lowest global index (4) outranks 11
+        assert list(got_i[0]) == [7, 4, 11, 9, -1, -1]
+        assert np.allclose(got_v[0, :4], [3.0, 2.0, 2.0, 1.0])
+        assert np.all(np.isneginf(got_v[0, 4:]))
+
+    def test_merge_partials_all_tombstoned_partials(self):
+        # every shard returned only padding (all candidates dead): the
+        # merged row must stay all-sentinel rather than promote padding
+        vals = np.full((3, 2, 4), -np.inf, np.float32)
+        idx = np.full((3, 2, 4), -1, np.int64)
+        got_v, got_i = merge_partials(vals, idx, 5)
+        assert np.all(got_i == -1)
+        assert np.all(np.isneginf(got_v))
+
 
 # ---------------------------------------------------------------------------
 # warm searchers: tune + AOT store integration
