@@ -61,9 +61,16 @@ def parse_args(argv=None, validate: bool = True) -> argparse.Namespace:
                         "[+layernorm][+activation][+attention-prob] outputs), "
                         "e.g. dots+ln+act")
     p.add_argument("--attn", default=None,
-                   choices=["auto", "xla", "flash", "saveable"],
-                   help="attention kernel (saveable = einsum with "
-                        "checkpoint-named probs, pair with --remat dots+attn)")
+                   choices=["auto", "xla", "flash", "flash_int8", "saveable"],
+                   help="attention kernel (flash_int8 = int8-QK flash "
+                        "fwd+bwd; saveable = einsum with checkpoint-named "
+                        "probs, pair with --remat dots+attn)")
+    p.add_argument("--precision", default=None,
+                   choices=["bf16", "fp8_hybrid", "int8_qk"],
+                   help="training precision policy applied to the bench "
+                        "model (quant.policy.apply_precision_policy); "
+                        "stamped on the JSON row so obs-regress baselines "
+                        "never conflate bf16 and low-precision runs")
     p.add_argument("--unroll", type=int, default=0,
                    help="layer-scan unroll factor; 0 = auto: full unroll for "
                         "the model's depth (12 ViT-B towers / 24 ViT-L — XLA "
@@ -158,9 +165,11 @@ def resolve_adopted_defaults(args: argparse.Namespace, on_tpu: bool) -> bool:
             if "remat" in adopted:
                 parse_remat(str(adopted["remat"]))
             ok = (str(adopted.get("attn", "auto"))
-                  in ("auto", "xla", "flash", "saveable")
+                  in ("auto", "xla", "flash", "flash_int8", "saveable")
                   and str(adopted.get("ln", "xla")) in ("xla", "fused")
                   and str(adopted.get("moment", "f32")) in ("f32", "bf16")
+                  and str(adopted.get("precision", "bf16"))
+                  in ("bf16", "fp8_hybrid", "int8_qk")
                   and int(adopted.get("unroll", 1)) >= 1
                   and int(adopted.get("batch", 1)) >= 1)
             if not ok:
@@ -172,6 +181,7 @@ def resolve_adopted_defaults(args: argparse.Namespace, on_tpu: bool) -> bool:
     fill("attn", "attn")
     fill("ln", "ln")
     fill("moment_dtype", "moment")
+    fill("precision", "precision")
     fill("unroll", "unroll", int)
     fill("batch_size", "batch", int)
     # store_true flags: an absent flag can adopt, a passed flag always wins
@@ -185,6 +195,7 @@ def resolve_adopted_defaults(args: argparse.Namespace, on_tpu: bool) -> bool:
     args.attn = args.attn or "auto"
     args.ln = args.ln or "xla"
     args.moment_dtype = args.moment_dtype or "f32"
+    args.precision = args.precision or "bf16"
     return used
 
 
@@ -452,6 +463,9 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
         from jimm_tpu.train import make_classifier_train_step
         model = VisionTransformer(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
                                   param_dtype=jnp.bfloat16)
+        if args.precision != "bf16":
+            from jimm_tpu.quant.policy import apply_precision_policy
+            apply_precision_policy(model, args.precision)
         optimizer = make_optimizer(model, opt_cfg)
         step_fn = make_classifier_train_step(donate=not args.no_donate)
         data = (
@@ -467,6 +481,9 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
         from jimm_tpu.train import make_contrastive_train_step
         model = SigLIP(cfg, rngs=nnx.Rngs(0), dtype=jnp.bfloat16,
                        param_dtype=jnp.bfloat16)
+        if args.precision != "bf16":
+            from jimm_tpu.quant.policy import apply_precision_policy
+            apply_precision_policy(model, args.precision)
         optimizer = make_optimizer(model, opt_cfg)
         step_fn = make_contrastive_train_step("siglip",
                                               donate=not args.no_donate)
@@ -555,6 +572,10 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
         "steps_timed": args.steps,
         "remat": args.remat,
         "attn": args.attn,
+        # explicit row-identity fields for obs-regress baselines: a bf16
+        # baseline must never gate (or be gated by) an fp8/int8 run
+        "attn_impl": args.attn,
+        "precision": args.precision,
         "unroll": unroll,
         "ln": args.ln,
         "fused_qkv": args.fused_qkv,

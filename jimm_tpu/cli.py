@@ -380,6 +380,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         rt["ln_impl"] = args.ln_impl
     if args.fused_qkv:
         rt["fused_qkv"] = True
+    if args.precision:
+        rt["precision"] = args.precision
     mesh = _parse_mesh(args.mesh, max_devices=args.max_devices)
     pp_extra = {}
     if args.pipeline_virtual > 1:
@@ -492,10 +494,23 @@ def cmd_train(args: argparse.Namespace) -> int:
     else:
         model = _model_cls(fam)(cfg, rngs=nnx.Rngs(args.seed), mesh=mesh,
                                 rules=rules, dtype=dtype, param_dtype=dtype)
+    # low-precision training surgery, BEFORE the optimizer is built: the
+    # optimizer tracks nnx.Param state, and the fp8 wrapper shares the
+    # Linear's kernel/bias Params (amax histories are plain Variables, so
+    # they never enter optimizer state)
+    precision = getattr(cfg.vision, "precision", "bf16")
+    if precision != "bf16":
+        from jimm_tpu.quant.policy import apply_precision_policy
+        n_lowp = apply_precision_policy(model, precision)
+        print(f"precision policy {precision}: {n_lowp} modules rewritten")
+    # --moment-dtype wins over the legacy --bf16-momentum sugar
+    moment_dtype = ({"f32": "float32", "bf16": "bfloat16"}[args.moment_dtype]
+                    if args.moment_dtype
+                    else ("bfloat16" if args.bf16_momentum else None))
     optimizer = make_optimizer(model, OptimizerConfig(
         learning_rate=args.lr, weight_decay=args.weight_decay,
         warmup_steps=args.warmup_steps, total_steps=args.steps,
-        moment_dtype="bfloat16" if args.bf16_momentum else None))
+        moment_dtype=moment_dtype))
 
     import jax
 
@@ -758,7 +773,14 @@ def cmd_train(args: argparse.Namespace) -> int:
     from jimm_tpu.train.metrics import mfu as _mfu, train_step_flops
     achieved_mfu = (None if dt is None
                     else _mfu(train_step_flops(cfg, args.batch_size), dt))
-    print("goodput: " + _json.dumps(acct.report(mfu=achieved_mfu)))
+    # precision + moment_dtype ride the goodput line so measurement
+    # consumers (lowp_train_smoke, window_report) can attribute MFU/img/s
+    # deltas to the policy that produced them
+    print("goodput: " + _json.dumps({
+        **acct.report(mfu=achieved_mfu),
+        "precision": precision,
+        "moment_dtype": moment_dtype or "param",
+    }))
     return 0
 
 
@@ -1846,14 +1868,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "(or synthetic mixed-aspect data) instead of "
                          "square images")
     sp.add_argument("--attn-impl", default=None,
-                    choices=["auto", "xla", "flash", "ring", "ulysses",
-                             "saveable"],
+                    choices=["auto", "xla", "flash", "flash_int8", "ring",
+                             "ulysses", "saveable"],
                     help="attention kernel for both towers "
                          "(ring/ulysses = sequence-parallel over a seq mesh "
                          "axis: ppermute kv ring vs all-to-all head "
                          "redistribution; "
+                         "flash_int8 = int8-QK flash, fwd+bwd; "
                          "saveable = checkpoint-named probs for --remat "
                          "dots+attn)")
+    sp.add_argument("--precision", default=None,
+                    choices=["bf16", "fp8_hybrid", "int8_qk"],
+                    help="training precision policy: bf16 (as built), "
+                         "fp8_hybrid (eligible Linears matmul in e4m3 fwd / "
+                         "e5m2 grad with delayed per-tensor scaling), "
+                         "int8_qk (attention via the int8-QK flash kernel)")
     sp.add_argument("--remat", default=None,
                     help="activation remat in the layer scan: none (off), "
                          "full (recompute all), or dots with +ln/+act/+attn "
@@ -1866,6 +1895,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--bf16-momentum", action="store_true",
                     help="keep Adam's first moment in bfloat16 (halves that "
                          "buffer's HBM footprint and traffic)")
+    sp.add_argument("--moment-dtype", default=None, choices=["f32", "bf16"],
+                    help="Adam first-moment dtype (OptimizerConfig."
+                         "moment_dtype); wins over --bf16-momentum and is "
+                         "stamped on the goodput line")
     sp.add_argument("--pipeline-microbatches", type=int, default=0,
                     help="enable pipeline parallelism with N microbatches "
                          "(needs a 'stage' mesh axis and --rules pp)")

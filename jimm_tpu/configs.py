@@ -20,7 +20,11 @@ from typing import Any, Literal
 Pooling = Literal["cls", "map", "last", "eot", "none"]
 Activation = Literal["gelu", "gelu_tanh", "quick_gelu"]
 AttnImpl = Literal["auto", "xla", "flash", "flash_masked", "flash_bias",
-                   "sigmoid", "ring", "ulysses", "saveable"]
+                   "flash_int8", "sigmoid", "ring", "ulysses", "saveable"]
+#: Training precision policy (`jimm_tpu/quant/policy.py`): "bf16" trains
+#: as built, "fp8_hybrid" swaps eligible Linears for e4m3-fwd/e5m2-grad
+#: fp8 matmuls, "int8_qk" switches attention to the int8-QK flash kernel.
+Precision = Literal["bf16", "fp8_hybrid", "int8_qk"]
 #: "dots" + optional "+ln"/"+act"/"+attn" save-list extensions
 RematPolicy = str
 
@@ -114,6 +118,7 @@ def act_to_hf(name: str) -> str:
 RUNTIME_FIELDS = frozenset({
     "attn_impl", "ln_impl", "fused_qkv", "remat", "remat_policy", "scan_unroll",
     "dropout", "pipeline", "pp_microbatches", "pp_virtual", "pp_stages",
+    "precision",
 })
 
 
@@ -189,6 +194,11 @@ class TransformerConfig:
     #: for schedule freedom: XLA turns the per-layer stacked-gradient
     #: dynamic-update-slices into statically-indexed updates it can fuse.
     scan_unroll: int = 1
+    #: Training precision policy, applied to the built model by
+    #: `quant.policy.apply_precision_policy` (trainer/CLI plumbing) — the
+    #: config field records intent so measurements and adopted runtimes
+    #: carry it; construction itself never reads it.
+    precision: Precision = "bf16"
 
     @property
     def head_dim(self) -> int:
@@ -229,6 +239,7 @@ class VisionConfig:
     ln_impl: Literal["xla", "fused"] = "xla"
     fused_qkv: bool = False
     scan_unroll: int = 1
+    precision: Precision = "bf16"
 
     @property
     def grid(self) -> int:
@@ -251,7 +262,7 @@ class VisionConfig:
             pp_virtual=self.pp_virtual, pp_stages=self.pp_stages,
             remat=self.remat, remat_policy=self.remat_policy,
             ln_impl=self.ln_impl, fused_qkv=self.fused_qkv,
-            scan_unroll=self.scan_unroll,
+            scan_unroll=self.scan_unroll, precision=self.precision,
         )
 
 
@@ -286,6 +297,7 @@ class TextConfig:
     ln_impl: Literal["xla", "fused"] = "xla"
     fused_qkv: bool = False
     scan_unroll: int = 1
+    precision: Precision = "bf16"
 
     def encoder(self) -> TransformerConfig:
         return TransformerConfig(
@@ -296,7 +308,7 @@ class TextConfig:
             pp_virtual=self.pp_virtual, pp_stages=self.pp_stages,
             remat=self.remat, remat_policy=self.remat_policy,
             ln_impl=self.ln_impl, fused_qkv=self.fused_qkv,
-            scan_unroll=self.scan_unroll,
+            scan_unroll=self.scan_unroll, precision=self.precision,
         )
 
 
@@ -473,6 +485,9 @@ def _check_runtime_fields(fields: Any) -> None:
             ok = _int_ge(v, 0)
         elif k == "dropout":
             ok = isinstance(v, (int, float)) and 0.0 <= v <= 1.0
+        elif k == "precision":
+            from typing import get_args
+            ok = v in get_args(Precision)
         if not ok:
             raise ValueError(f"bad value for runtime field {k!r}: {v!r}")
 

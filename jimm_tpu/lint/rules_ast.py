@@ -90,6 +90,18 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   (``cli.py``/``__main__.py``/``launch.py``) keep their sanctioned
   parseable ready-lines, and deliberate console sinks carry a
   ``# jaxlint: disable=JL015`` justification. Tests are exempt.
+- **JL016** bare low-precision cast (``.astype(jnp.float8_e4m3fn)`` /
+  ``.astype(jnp.float8_e5m2)`` / ``.astype(jnp.int8)`` or the
+  ``convert_element_type`` spelling) in ``ops/`` or ``train/`` code
+  outside a ``*quantize*``/``*scale*``-named function — a narrow-format
+  cast without an explicit scale silently saturates (e4m3 tops out at
+  448, int8 at 127): nothing crashes, the tensor just loses its top
+  octaves and training quality decays untraceably. Quantization lives in
+  the scaling helpers (``quantize_tensor`` / ``quantize_rows`` /
+  ``dynamic_scale``, docs/quantization.md) where amax -> scale -> clip
+  -> cast travel together; expression-derived dtypes
+  (``x.astype(k.dtype)``) stay legal, and deliberate unscaled casts
+  carry a ``# jaxlint: disable=JL016`` justification. Tests are exempt.
 """
 
 from __future__ import annotations
@@ -1148,6 +1160,92 @@ def check_journal_bypass(tree: ast.AST, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL016 — bare low-precision cast outside a scaling/quantization helper
+# ---------------------------------------------------------------------------
+
+#: dtype leaf names whose appearance as a cast target narrows precision on
+#: the training fast path — each has a sanctioned scaled home
+_LOWP_DTYPES = frozenset({"float8_e4m3fn", "float8_e5m2", "int8"})
+
+#: substrings that sanction an enclosing function as a scaling-aware
+#: quantization site (quantize_tensor / quantize_rows / _quantize_heads /
+#: dynamic_scale / delayed_scale ...)
+_SCALING_NAME_MARKS = ("quantize", "scale")
+
+
+def _path_is_precision_critical(path: str) -> bool:
+    """Kernel and trainer code: the two trees where a low-precision cast
+    is a numerics decision, not a storage format."""
+    parts = path.replace("\\", "/").split("/")
+    return bool({"ops", "train"} & set(parts[:-1]))
+
+
+def _lowp_target(node: ast.expr) -> str | None:
+    """The low-precision dtype name if ``node`` denotes one (dotted name
+    like ``jnp.float8_e4m3fn`` or an ``"int8"`` string constant), else
+    None. Expression-derived dtypes (``k.dtype``) resolve to the leaf
+    ``dtype`` and stay legal by construction."""
+    name = _dotted(node)
+    if name is not None:
+        leaf = name.rsplit(".", 1)[-1]
+        return leaf if leaf in _LOWP_DTYPES else None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value in _LOWP_DTYPES:
+        return node.value
+    return None
+
+
+def _in_scaling_function(node: ast.AST) -> bool:
+    cur: ast.AST | None = _parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+                mark in cur.name for mark in _SCALING_NAME_MARKS):
+            return True
+        cur = _parent(cur)
+    return False
+
+
+def check_bare_lowp_cast(tree: ast.AST, path: str) -> list[Finding]:
+    """JL016: fp8/int8 casts in ops/train code must travel with a scale.
+    A bare ``.astype(jnp.float8_e4m3fn)`` saturates everything past 448
+    (int8 past 127) — no crash, no NaN guard trips, the tensor just loses
+    its top octaves and the loss curve quietly degrades. The sanctioned
+    homes are functions whose names say they scale (``quantize_tensor``,
+    ``quantize_rows``, ``dynamic_scale``, ...) where the amax reduction,
+    the scale division, the clip, and the cast are one auditable unit."""
+    if not _path_is_precision_critical(path) or _path_is_test(path):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = None
+        how = None
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "astype" and node.args:
+            target = _lowp_target(node.args[0])
+            how = f".astype({target})"
+        else:
+            fname = _dotted(node.func)
+            if fname is not None \
+                    and fname.rsplit(".", 1)[-1] == "convert_element_type" \
+                    and len(node.args) >= 2:
+                target = _lowp_target(node.args[1])
+                how = f"convert_element_type(..., {target})"
+        if target is None or _in_scaling_function(node):
+            continue
+        findings.append(Finding(
+            "JL016", ERROR, path, node.lineno,
+            f"bare {how} outside a quantize/scale helper saturates at the "
+            f"format max with no scale to absorb the range — route the "
+            f"cast through a scaling helper (quantize_tensor / "
+            f"quantize_rows, docs/quantization.md) so amax -> scale -> "
+            f"clip -> cast stay together, or justify with "
+            f"# jaxlint: disable=JL016"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -1168,4 +1266,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_swallowed_exception(tree, path)
     findings += check_unbounded_tenant_table(tree, path)
     findings += check_journal_bypass(tree, path)
+    findings += check_bare_lowp_cast(tree, path)
     return findings

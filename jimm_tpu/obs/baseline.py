@@ -71,13 +71,25 @@ def _preset_of(rec: dict) -> str:
 
 
 def row_key(rec: dict) -> str | None:
-    """Stable ``workload/backend/preset`` identity for one row, or None
-    for rows that carry no workload identity at all."""
+    """Stable ``workload/backend/preset[/precision][/attn_impl]`` identity
+    for one row, or None for rows that carry no workload identity at all.
+
+    Precision/attn-impl segments append only when the row stamps them
+    (bench/train rows since the low-precision fast path landed), so legacy
+    rows keep their adopted keys — and a bf16 baseline can never be
+    compared against an fp8 or int8-attention run of the same preset."""
     workload = rec.get("phase") or rec.get("metric")
     if not workload:
         return None
     backend = rec.get("backend") or rec.get("device") or "unknown"
-    return f"{workload}/{backend}/{_preset_of(rec)}"
+    key = f"{workload}/{backend}/{_preset_of(rec)}"
+    precision = rec.get("precision")
+    if precision:
+        key += f"/{precision}"
+    attn_impl = rec.get("attn_impl")
+    if attn_impl:
+        key += f"/{attn_impl}"
+    return key
 
 
 def comparable_metrics(rec: dict) -> dict[str, float]:

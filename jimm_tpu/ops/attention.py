@@ -128,6 +128,14 @@ def dot_product_attention(
                              "impl='xla'")
         from jimm_tpu.ops.flash_attention import flash_attention_bias
         return flash_attention_bias(q, k, v, bias, is_causal=is_causal)
+    if impl == "flash_int8":
+        if mask is not None or bias is not None:
+            raise ValueError(
+                "flash_int8 does not support masks or biases — the int8 "
+                "score kernel has no mask/bias plumbing; use is_causal, "
+                "or impl='flash_masked' / 'xla' for masked batches")
+        from jimm_tpu.ops.flash_attention_int8 import flash_attention_int8
+        return flash_attention_int8(q, k, v, is_causal=is_causal)
     if impl == "sigmoid":
         if bias is not None:
             raise ValueError("sigmoid attention takes no additive bias "

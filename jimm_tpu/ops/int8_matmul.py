@@ -199,14 +199,58 @@ def quantize_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     return x_q.astype(jnp.int8), scale
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _quantized_linear(x, w_q, w_scale, bias, activation, block_m, block_n):
+    x_q, x_scale = quantize_rows(x)
+    return int8_matmul(x_q, x_scale, w_q, w_scale, bias,
+                       activation=activation, block_m=block_m,
+                       block_n=block_n)
+
+
+def _quantized_linear_fwd(x, w_q, w_scale, bias, activation, block_m,
+                          block_n):
+    y = _quantized_linear(x, w_q, w_scale, bias, activation, block_m,
+                          block_n)
+    # zero-size sentinels carry the primal dtypes (dtype objects are not
+    # valid pytree leaves for traced residuals)
+    return y, (w_q, w_scale, jnp.zeros((0,), x.dtype),
+               None if bias is None else jnp.zeros((0,), bias.dtype))
+
+
+def _quantized_linear_bwd(activation, block_m, block_n, res, dy):
+    if activation is not None:
+        raise NotImplementedError(
+            "gradients through a fused int8 activation epilogue are not "
+            "supported; run with activation=None when training")
+    w_q, w_scale, x_sent, b_sent = res
+    # straight-through past the per-row activation quantizer: dx contracts
+    # the incoming gradient against the dequantized frozen weights in full
+    # precision (the serving fast path trains nothing at int8 — fp8 is the
+    # training format, ops/fp8_matmul.py)
+    w_deq = w_q.astype(jnp.float32) * w_scale[None, :].astype(jnp.float32)
+    dx = (dy.astype(jnp.float32) @ w_deq.T).astype(x_sent.dtype)
+    # int8 weights + their scales are quantization artifacts, not trainable
+    # parameters — zero gradient keeps an optimizer from mutating them
+    dbias = (None if b_sent is None
+             else jnp.sum(dy.astype(jnp.float32), axis=0)
+             .astype(b_sent.dtype))
+    return dx, jnp.zeros_like(w_q), jnp.zeros_like(w_scale), dbias
+
+
+_quantized_linear.defvjp(_quantized_linear_fwd, _quantized_linear_bwd)
+
+
 def quantized_linear(x: jax.Array, w_q: jax.Array, w_scale: jax.Array,
                      bias: jax.Array | None = None, *,
                      activation: str | None = None,
                      block_m: int | None = None,
                      block_n: int | None = None) -> jax.Array:
     """One W8A8 linear layer over float ``(M, K)`` input: quantize the
-    activations per row, run the fused kernel, return f32 output."""
-    x_q, x_scale = quantize_rows(x)
-    return int8_matmul(x_q, x_scale, w_q, w_scale, bias,
-                       activation=activation, block_m=block_m,
-                       block_n=block_n)
+    activations per row, run the fused kernel, return f32 output.
+
+    Differentiable (``activation=None`` only): the backward is the
+    straight-through estimator — ``dx = dy @ dequant(w_q).T`` in f32, cast
+    back to ``x.dtype``; the int8 weights and their scales receive zero
+    gradient (they are frozen quantization artifacts)."""
+    return _quantized_linear(x, w_q, w_scale, bias, activation, block_m,
+                             block_n)

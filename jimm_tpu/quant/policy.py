@@ -1,0 +1,204 @@
+"""jimm_tpu.quant.policy — mixed-precision training policies.
+
+Where :func:`jimm_tpu.quant.quantize_model` rewrites a model for int8
+*serving* (weights frozen as int8, no gradient path), this module rewrites
+a model for low-precision *training*. A policy names which tensors drop
+precision and how their scales are managed; everything else — master
+weights, optimizer state, the loss — stays in the trainer's usual dtypes.
+
+Policies
+--------
+
+``bf16``
+    The identity policy: no surgery, the model trains exactly as built.
+
+``fp8_hybrid``
+    Every eligible ``nnx.Linear`` becomes an :class:`Fp8Linear`: forward
+    operands quantize to e4m3, gradients to e5m2 (the hybrid that gives
+    the scheme its name), via the custom-VJP Pallas matmul in
+    ``ops/fp8_matmul.py``. Master weights remain the Linear's original
+    ``kernel`` Param — the optimizer never sees fp8 — and per-tensor
+    scales ride as explicit amax-history state (delayed scaling).
+
+``int8_qk``
+    Attention-only: every ``Attention`` module switches its ``impl`` to
+    ``"flash_int8"``, the differentiable int8-QK flash kernel
+    (``ops/flash_attention_int8.py``). Linears are untouched.
+
+Eligibility mirrors ``quantize_model``: q/k/v under ``fused_qkv`` are
+skipped (that path concatenates raw ``.kernel`` params), and non-Linear
+modules are never rewritten. Surgery is plain attribute replacement, so
+stacked blocks built under ``nnx.vmap`` keep their leading ``layers``
+axis — ``Fp8Linear`` carries its amax histories with the same lead dims
+as the kernel, and ``nnx.scan`` slices them per layer exactly as it
+slices the kernel itself.
+
+Delayed scaling degrades safely: a cold (all-zero) amax history resolves
+to scale 1.0 and :func:`~jimm_tpu.ops.fp8_matmul.quantize_tensor`
+saturates at the format max, so the first steps are merely clipped, not
+wrong. Paths that drop state mutations (the pipelined lax.scan trainer
+path) therefore still train — they just never warm the history.
+
+Counted in the ``jimm_quant`` registry
+(``jimm_quant_layers_fp8_total`` / ``jimm_quant_attn_int8_total``) and
+timed under the ``apply_precision_policy`` span.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from jimm_tpu import obs
+from jimm_tpu.ops.fp8_matmul import (
+    delayed_scale,
+    fp8_matmul,
+    tensor_amax,
+    update_amax_history,
+)
+
+__all__ = [
+    "POLICIES",
+    "DEFAULT_AMAX_HISTORY",
+    "Fp8Linear",
+    "fp8_linear",
+    "apply_precision_policy",
+]
+
+POLICIES = ("bf16", "fp8_hybrid", "int8_qk")
+
+# Steps of amax history kept per tensor for delayed scaling. 16 is the
+# common transformer-engine default: long enough to ride out a single
+# outlier batch, short enough to track post-warmup amax drift.
+DEFAULT_AMAX_HISTORY = 16
+
+
+class Fp8Linear(nnx.Module):
+    """An ``nnx.Linear`` replacement that matmuls in fp8 but *owns* no
+    fp8 weights.
+
+    ``kernel`` / ``bias`` are the original Linear's Params — master
+    weights in their original dtype, updated by the optimizer as usual.
+    What this module adds is scale state: ``x_amax`` and ``w_amax`` are
+    rolling amax histories (``nnx.Variable``, lead dims matching the
+    kernel's stacked lead dims) from which delayed per-tensor e4m3
+    scales are derived each forward. The forward quantizes both
+    operands, runs the custom-VJP Pallas fp8 matmul (e5m2 gradients
+    with dynamic scaling on the backward), and rolls both histories
+    with the step's observed amax.
+    """
+
+    def __init__(self, kernel: nnx.Param, bias, *, dtype=None,
+                 amax_history: int = DEFAULT_AMAX_HISTORY):
+        self.kernel = kernel
+        self.bias = bias
+        self.dtype = dtype
+        lead = kernel[...].shape[:-2]
+        self.x_amax = nnx.Variable(
+            jnp.zeros(lead + (amax_history,), jnp.float32))
+        self.w_amax = nnx.Variable(
+            jnp.zeros(lead + (amax_history,), jnp.float32))
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        w = self.kernel[...]
+        bias = self.bias[...] if self.bias is not None else None
+        x_scale = delayed_scale(self.x_amax[...], jnp.float8_e4m3fn)
+        w_scale = delayed_scale(self.w_amax[...], jnp.float8_e4m3fn)
+        lead = x.shape[:-1]
+        y = fp8_matmul(x.reshape(-1, x.shape[-1]), w, bias,
+                       x_scale=x_scale, w_scale=w_scale)
+        self.x_amax.value = update_amax_history(
+            self.x_amax[...], tensor_amax(x))
+        self.w_amax.value = update_amax_history(
+            self.w_amax[...], tensor_amax(w))
+        out_dtype = self.dtype if self.dtype is not None else x.dtype
+        return y.reshape(lead + (w.shape[-1],)).astype(out_dtype)
+
+
+def fp8_linear(lin: nnx.Linear, *,
+               amax_history: int = DEFAULT_AMAX_HISTORY) -> Fp8Linear:
+    """Wrap one Linear for fp8 training. Shares the Linear's ``kernel``
+    and ``bias`` Params (no copy — the optimizer keeps updating them);
+    only the amax histories are new state."""
+    bias = getattr(lin, "bias", None)
+    # nnx.Linear(use_bias=False) keeps a Param whose value is None
+    if bias is not None and getattr(bias, "value", None) is None:
+        bias = None
+    return Fp8Linear(lin.kernel, bias,
+                     dtype=getattr(lin, "dtype", None),
+                     amax_history=amax_history)
+
+
+def _skip(parent: nnx.Module, name: str) -> bool:
+    from jimm_tpu.nn.transformer import Attention
+    return (isinstance(parent, Attention)
+            and getattr(parent, "fused_qkv", False)
+            and name in ("q", "k", "v"))
+
+
+def _walk_fp8(module: nnx.Module, seen: set[int],
+              amax_history: int) -> int:
+    if id(module) in seen:
+        return 0
+    seen.add(id(module))
+    count = 0
+    for name, child in list(vars(module).items()):
+        if isinstance(child, nnx.Linear):
+            if _skip(module, name):
+                continue
+            setattr(module, name,
+                    fp8_linear(child, amax_history=amax_history))
+            count += 1
+        elif isinstance(child, nnx.Module):
+            count += _walk_fp8(child, seen, amax_history)
+        elif isinstance(child, (list, tuple)):
+            for item in child:
+                if isinstance(item, nnx.Module):
+                    count += _walk_fp8(item, seen, amax_history)
+    return count
+
+
+def _walk_int8_qk(module: nnx.Module, seen: set[int]) -> int:
+    from jimm_tpu.nn.transformer import Attention
+    if id(module) in seen:
+        return 0
+    seen.add(id(module))
+    count = 0
+    if isinstance(module, Attention):
+        module.impl = "flash_int8"
+        count += 1
+    for child in list(vars(module).values()):
+        if isinstance(child, nnx.Module):
+            count += _walk_int8_qk(child, seen)
+        elif isinstance(child, (list, tuple)):
+            for item in child:
+                if isinstance(item, nnx.Module):
+                    count += _walk_int8_qk(item, seen)
+    return count
+
+
+def apply_precision_policy(model: nnx.Module, policy: str, *,
+                           amax_history: int = DEFAULT_AMAX_HISTORY) -> int:
+    """Rewrite ``model`` in place for the named precision policy.
+
+    Returns the number of modules rewritten (0 for ``bf16``). Raises
+    ``ValueError`` on an unknown policy so CLI typos fail before any
+    surgery happens.
+    """
+    if policy not in POLICIES:
+        raise ValueError(
+            f"unknown precision policy {policy!r}; expected one of "
+            f"{', '.join(POLICIES)}")
+    if policy == "bf16":
+        return 0
+    with obs.span("apply_precision_policy"):
+        if policy == "fp8_hybrid":
+            count = _walk_fp8(model, set(), amax_history)
+            obs.get_registry("jimm_quant").counter(
+                "layers_fp8_total").inc(count)
+        else:  # int8_qk
+            count = _walk_int8_qk(model, set())
+            obs.get_registry("jimm_quant").counter(
+                "attn_int8_total").inc(count)
+    return count

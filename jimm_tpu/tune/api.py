@@ -27,9 +27,10 @@ from jimm_tpu import obs
 from jimm_tpu.tune.cache import TuneCache, TuneKey, tune_key
 from jimm_tpu.tune.measure import measure
 from jimm_tpu.tune.space import (bias_flash_space, flash_space,
-                                 int8_flash_space, int8_matmul_space,
-                                 ivf_space, ln_space, masked_flash_space,
-                                 retrieval_space, sigmoid_space)
+                                 fp8_matmul_space, int8_flash_space,
+                                 int8_matmul_space, ivf_space, ln_space,
+                                 masked_flash_space, retrieval_space,
+                                 sigmoid_space)
 
 __all__ = ["KERNELS", "KernelSpec", "best_config", "configure", "get_cache",
            "tune_kernel"]
@@ -284,8 +285,11 @@ def _int8_flash_default(shapes: Shapes, dtypes: Dtypes) -> dict:
 
 def _int8_flash_bench(shapes: Shapes, dtypes: Dtypes,
                       config: Mapping[str, int]) -> Callable[[], Any]:
-    """Timed closure: int8 flash forward at the candidate blocks (the
-    variant is forward-only by design — serving is the consumer)."""
+    """Timed closure: int8 flash fwd+bwd at the candidate blocks (since
+    the int8_qk training policy landed the backward, training is a
+    consumer too — a fwd-only winner that loses the backward would be a
+    false economy). Explicit block kwargs bypass the tuner — no
+    recursion."""
     import jax
     import jax.numpy as jnp
 
@@ -297,9 +301,42 @@ def _int8_flash_bench(shapes: Shapes, dtypes: Dtypes,
     v = jax.random.normal(kv, tuple(shapes[2]), dt)
     bq, bk = int(config["block_q"]), int(config["block_k"])
 
-    step = jax.jit(lambda q, k, v: flash_attention_int8(
-        q, k, v, block_q=bq, block_k=bk))
+    def loss(q, k, v):
+        o = flash_attention_int8(q, k, v, block_q=bq, block_k=bk)
+        return jnp.sum(o.astype(jnp.float32))
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
     return lambda: step(q, k, v)
+
+
+def _fp8_matmul_default(shapes: Shapes, dtypes: Dtypes) -> dict:
+    from jimm_tpu.ops.fp8_matmul import DEFAULT_BLOCK_M, DEFAULT_BLOCK_N
+    return {"block_m": DEFAULT_BLOCK_M, "block_n": DEFAULT_BLOCK_N}
+
+
+def _fp8_matmul_bench(shapes: Shapes, dtypes: Dtypes,
+                      config: Mapping[str, int]) -> Callable[[], Any]:
+    """Timed closure: fp8 matmul fwd+bwd (training is the kernel's whole
+    consumer — the backward's two e5m2 contractions dominate). Explicit
+    block kwargs bypass the tuner — no recursion."""
+    import jax
+    import jax.numpy as jnp
+
+    from jimm_tpu.ops.fp8_matmul import fp8_matmul
+    m, k = (int(d) for d in shapes[0][-2:])
+    n = int(shapes[1][-1])
+    kx, kw = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(kx, (m, k), jnp.float32)
+    w = jax.random.normal(kw, (k, n), jnp.float32)
+    bias = jnp.zeros((n,), jnp.float32)
+    bm, bn = int(config["block_m"]), int(config["block_n"])
+
+    def loss(x, w, bias):
+        y = fp8_matmul(x, w, bias, block_m=bm, block_n=bn)
+        return jnp.sum(y)
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return lambda: step(x, w, bias)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -337,9 +374,14 @@ KERNELS: dict[str, KernelSpec] = {
     "int8_matmul": KernelSpec(version=1, space=int8_matmul_space,
                               default=_int8_matmul_default,
                               bench=_int8_matmul_bench),
-    "flash_attention_int8": KernelSpec(version=1, space=int8_flash_space,
+    # version 2: the backward landed (lse output changed the fwd cell's
+    # working set; blocks must now fit the dq/dkv cells too)
+    "flash_attention_int8": KernelSpec(version=2, space=int8_flash_space,
                                        default=_int8_flash_default,
                                        bench=_int8_flash_bench),
+    "fp8_matmul": KernelSpec(version=1, space=fp8_matmul_space,
+                             default=_fp8_matmul_default,
+                             bench=_fp8_matmul_bench),
 }
 
 

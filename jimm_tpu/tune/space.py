@@ -12,11 +12,13 @@ from __future__ import annotations
 
 from typing import Any, Sequence
 
-__all__ = ["FLASH_BLOCKS", "INT8_FLASH_BLOCKS", "INT8_MATMUL_BLOCK_M",
+__all__ = ["FLASH_BLOCKS", "FP8_MATMUL_BLOCK_M", "FP8_MATMUL_BLOCK_N",
+           "INT8_FLASH_BLOCKS", "INT8_MATMUL_BLOCK_M",
            "INT8_MATMUL_BLOCK_N", "LN_BLOCK_ROWS", "RETRIEVAL_BLOCK_N",
            "VMEM_BUDGET", "bias_flash_space", "bias_flash_vmem_bytes",
-           "flash_space", "flash_vmem_bytes", "int8_flash_space",
-           "int8_flash_vmem_bytes", "int8_matmul_space",
+           "flash_space", "flash_vmem_bytes", "fp8_matmul_space",
+           "fp8_matmul_vmem_bytes", "int8_flash_bwd_vmem_bytes",
+           "int8_flash_space", "int8_flash_vmem_bytes", "int8_matmul_space",
            "int8_matmul_vmem_bytes", "ivf_space", "ivf_vmem_bytes",
            "kernel_space", "ln_space",
            "ln_vmem_bytes", "masked_flash_space", "masked_flash_vmem_bytes",
@@ -253,10 +255,51 @@ def int8_matmul_space(shapes: Sequence[Sequence[int]],
                     "block_n": INT8_MATMUL_BLOCK_N[0]}]
 
 
+#: fp8 matmul grid tiles: same alignment story as int8 (fp8 Mosaic tiles
+#: are (32, 128) too), so the candidate grids coincide
+FP8_MATMUL_BLOCK_M = (32, 64, 128, 256, 512)
+FP8_MATMUL_BLOCK_N = (128, 256, 512)
+
+
+def fp8_matmul_vmem_bytes(block_m: int, block_n: int, k: int) -> int:
+    """jax-free mirror of ``ops.fp8_matmul._per_cell_vmem_bytes``
+    (sync-tested): fp8 a/b tiles at 128-padded K, the lane-broadcast
+    per-tensor scale, bias, f32 acc + out."""
+    kp = _ceil_to(k, _LANES)
+    return (block_m * kp
+            + kp * block_n
+            + _LANES * 4
+            + block_n * 4
+            + 2 * block_m * block_n * 4)
+
+
+def fp8_matmul_space(shapes: Sequence[Sequence[int]],
+                     dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Feasible ``{"block_m", "block_n"}`` candidates for an fp8 matmul
+    shaped ``[(M, K), (K, N)]``. Same pruning story as the int8 space:
+    blocks past the tile-padded M/N are redundant (the wrapper clamps),
+    VMEM-infeasible cells are dropped."""
+    m, k = int(shapes[0][-2]), int(shapes[0][-1])
+    n = int(shapes[1][-1])
+    out = []
+    for bm in FP8_MATMUL_BLOCK_M:
+        if bm > _ceil_to(m, _INT8_SUBLANES):
+            continue
+        for bn in FP8_MATMUL_BLOCK_N:
+            if bn > _ceil_to(n, _LANES):
+                continue
+            if fp8_matmul_vmem_bytes(bm, bn, k) > VMEM_BUDGET:
+                continue
+            out.append({"block_m": bm, "block_n": bn})
+    return out or [{"block_m": FP8_MATMUL_BLOCK_M[0],
+                    "block_n": FP8_MATMUL_BLOCK_N[0]}]
+
+
 def int8_flash_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
     """jax-free mirror of ``ops.flash_attention_int8._per_head_vmem_bytes``
     (sync-tested): int8 q/k at the 128-padded head dim, storage-dtype v and
-    out, f32 stats/accumulator, lse-layout scale tiles."""
+    out, f32 stats/accumulator, lse-layout scale tiles, and the f32 lse
+    out row the backward consumes."""
     dp = _ceil_to(d, _LANES)
     return (block_q * dp + block_k * dp
             + 2 * block_k * d * 2
@@ -264,13 +307,32 @@ def int8_flash_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
             + 2 * block_q * _LANES * 4
             + block_q * d * 4
             + (block_q + block_k) * 4
+            + block_q * 4
             + block_q * block_k * 6)
+
+
+def int8_flash_bwd_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
+    """jax-free mirror of
+    ``ops.flash_attention_int8._per_head_bwd_vmem_bytes`` (sync-tested):
+    the dq / dkv backward cells' shared upper bound — int8 q/k tiles,
+    storage-dtype v/do, scale + lse + delta rows, f32 dq and dk/dv
+    scratch, and the recomputed s/p/ds f32 temporaries."""
+    dp = _ceil_to(d, _LANES)
+    return (block_q * dp + block_k * dp
+            + block_k * d * 2 + block_q * d * 2
+            + (block_q + block_k) * 4
+            + 2 * block_q * 4
+            + (block_k * dp + block_k * d) * 4
+            + block_q * dp * 4
+            + 3 * block_q * block_k * 4)
 
 
 def int8_flash_space(shapes: Sequence[Sequence[int]],
                      dtypes: Sequence[Any] = ()) -> list[dict]:
     """Feasible ``{"block_q", "block_k"}`` candidates for int8 flash
-    attention over q/k/v shapes ``(B, S, N, D)`` (or head-flattened)."""
+    attention over q/k/v shapes ``(B, S, N, D)`` (or head-flattened).
+    Blocks are shared between forward and backward, so a candidate must
+    fit both cells' working sets."""
     q, k = shapes[0], shapes[1]
     sq, sk, d = int(q[-3]), int(k[-3]), int(q[-1])
     out = []
@@ -280,7 +342,8 @@ def int8_flash_space(shapes: Sequence[Sequence[int]],
         for bk in INT8_FLASH_BLOCKS:
             if bk > _ceil_to(sk, _LANES):
                 continue
-            if int8_flash_vmem_bytes(bq, bk, d) > VMEM_BUDGET:
+            if max(int8_flash_vmem_bytes(bq, bk, d),
+                   int8_flash_bwd_vmem_bytes(bq, bk, d)) > VMEM_BUDGET:
                 continue
             out.append({"block_q": bq, "block_k": bk})
     return out or [{"block_q": INT8_FLASH_BLOCKS[0],
@@ -295,6 +358,7 @@ _SPACES = {"flash_attention": flash_space,
            "retrieval_topk": retrieval_space,
            "retrieval_ivf": ivf_space,
            "int8_matmul": int8_matmul_space,
+           "fp8_matmul": fp8_matmul_space,
            "flash_attention_int8": int8_flash_space}
 
 

@@ -1,0 +1,28 @@
+"""JL016 fixture: bare low-precision casts outside scaling helpers."""
+import jax
+import jax.numpy as jnp
+
+
+def fp8_forward(x, w, g):
+    x_q = x.astype(jnp.float8_e4m3fn)                       # JL016: bare e4m3
+    w_q = jax.lax.convert_element_type(w, jnp.float8_e5m2)  # JL016: CET e5m2
+    g_q = g.astype("int8")                                  # JL016: string
+    return x_q, w_q, g_q
+
+
+def quantize_tensor(x, scale):
+    # ok: quantization helper — the cast rides an explicit scale and clip
+    return jnp.clip(x / scale, -448.0, 448.0).astype(jnp.float8_e4m3fn)
+
+
+def dynamic_scale_roundtrip(dy):
+    # ok: "scale" in the enclosing name sanctions the e5m2 cast
+    return (dy / jnp.max(jnp.abs(dy))).astype(jnp.float8_e5m2)
+
+
+def epilogue(y, k):
+    # ok: expression-derived dtype is not a literal low-precision cast
+    half = y.astype(k.dtype)
+    # ok: a justified deliberate unscaled cast
+    probe = y.astype(jnp.int8)  # jaxlint: disable=JL016 saturation probe
+    return half, probe

@@ -1,0 +1,216 @@
+"""Pallas fp8 matmul: e4m3 forward / e5m2 backward custom VJP parity.
+
+CPU runs exercise interpret-mode Pallas (the same wrapper/padding code the
+TPU path uses); the TPU contract is held by cross-lowering. Two oracle
+tiers, because fp8 precision caps what cosine can promise:
+
+- **quantization-aware XLA oracle** (f32 allclose): the same quantize /
+  dequantize helpers composed in plain jnp. The kernel must agree to f32
+  rounding — this pins padding, indexing, and the fused dequant epilogue.
+- **full-precision oracle** (cosine): e4m3 forwards hold >= 0.999; e5m2
+  round-trips of iid-normal cotangents cap near ~0.9986 (2 mantissa
+  bits), so gradient-vs-f32 checks assert the honest >= 0.99 floor and
+  the allclose tier above carries the correctness burden.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jimm_tpu.ops.fp8_matmul import (E4M3_MAX, E5M2_MAX, delayed_scale,
+                                     dynamic_scale, fp8_matmul,
+                                     quantize_tensor, tensor_amax,
+                                     update_amax_history)
+
+#: (M, K, N) triples off the tile grid — exercises every padding branch
+ODD_MATMUL_SHAPES = [(1, 7, 5), (5, 100, 33), (33, 64, 128),
+                     (257, 769, 129), (16, 768, 768)]
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def _cos(a, b):
+    a, b = np.asarray(a, np.float64).ravel(), np.asarray(b,
+                                                         np.float64).ravel()
+    return (a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b))
+
+
+def _dequant(x, scale, dtype):
+    """Round-trip a tensor through fp8 at the given scale, back to f32 —
+    the quantization-aware oracle's only primitive."""
+    return quantize_tensor(x, scale, dtype).astype(jnp.float32) * scale
+
+
+class TestScalingHelpers:
+    def test_quantize_tensor_saturates(self):
+        x = jnp.asarray([0.0, 1.0, 1e6, -1e6], jnp.float32)
+        q = quantize_tensor(x, jnp.asarray(1.0), jnp.float8_e4m3fn)
+        out = np.asarray(q, np.float32)
+        assert np.all(np.isfinite(out))
+        assert out[2] == E4M3_MAX and out[3] == -E4M3_MAX
+        q2 = quantize_tensor(x, jnp.asarray(1.0), jnp.float8_e5m2)
+        out2 = np.asarray(q2, np.float32)
+        assert out2[2] == E5M2_MAX and out2[3] == -E5M2_MAX
+
+    def test_dynamic_scale_maps_amax_to_format_max(self, rng):
+        x = jnp.asarray(rng.normal(size=(32, 32)).astype(np.float32))
+        s = dynamic_scale(x, jnp.float8_e4m3fn)
+        np.testing.assert_allclose(
+            float(s), float(tensor_amax(x)) / E4M3_MAX, rtol=1e-6)
+        # the amax element round-trips to exactly the format max
+        q = quantize_tensor(x, s, jnp.float8_e4m3fn)
+        assert np.max(np.abs(np.asarray(q, np.float32))) == E4M3_MAX
+
+    def test_dynamic_scale_of_zeros_is_one(self):
+        assert float(dynamic_scale(jnp.zeros((4, 4)),
+                                   jnp.float8_e4m3fn)) == 1.0
+
+    def test_delayed_scale_cold_history_is_one(self):
+        # a fresh (all-zero) amax history must not blow up dequantization:
+        # scale 1.0 + saturating quantization degrades, never overflows
+        assert float(delayed_scale(jnp.zeros((16,)),
+                                   jnp.float8_e4m3fn)) == 1.0
+
+    def test_delayed_scale_uses_window_max(self):
+        hist = jnp.asarray([1.0, 448.0, 2.0, 0.5], jnp.float32)
+        np.testing.assert_allclose(
+            float(delayed_scale(hist, jnp.float8_e4m3fn)), 1.0, rtol=1e-6)
+
+    def test_update_amax_history_rolls(self):
+        hist = jnp.asarray([1.0, 2.0, 3.0], jnp.float32)
+        new = update_amax_history(hist, jnp.asarray(7.0))
+        np.testing.assert_array_equal(np.asarray(new), [2.0, 3.0, 7.0])
+
+
+class TestFp8MatmulForward:
+    @pytest.mark.parametrize("m,k,n", ODD_MATMUL_SHAPES)
+    def test_matches_quantization_aware_oracle(self, rng, m, k, n):
+        # the kernel's only liberties vs this oracle are f32 summation
+        # order — any real disagreement means wrong padding or a broken
+        # dequant epilogue
+        x = jnp.asarray(rng.normal(size=(m, k)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(k, n)).astype(np.float32))
+        bias = jnp.asarray(rng.normal(size=(n,)).astype(np.float32))
+        xs = dynamic_scale(x, jnp.float8_e4m3fn)
+        ws = dynamic_scale(w, jnp.float8_e4m3fn)
+        got = fp8_matmul(x, w, bias, x_scale=xs, w_scale=ws)
+        ref = (_dequant(x, xs, jnp.float8_e4m3fn)
+               @ _dequant(w, ws, jnp.float8_e4m3fn)) + bias
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-3 * max(1, k // 64))
+
+    @pytest.mark.parametrize("m,k,n", [(5, 100, 33), (257, 769, 129)])
+    def test_close_to_full_precision(self, rng, m, k, n):
+        x = jnp.asarray(rng.normal(size=(m, k)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(k, n)).astype(np.float32))
+        got = np.asarray(fp8_matmul(x, w))
+        ref = np.asarray(x) @ np.asarray(w)
+        assert _cos(got, ref) > 0.999  # e4m3 holds 3 mantissa bits
+
+    def test_output_is_f32_and_explicit_blocks_agree(self, rng):
+        x = jnp.asarray(rng.normal(size=(40, 64)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(64, 40)).astype(np.float32))
+        auto = fp8_matmul(x, w)
+        assert auto.dtype == jnp.float32
+        pinned = fp8_matmul(x, w, block_m=32, block_n=128)
+        np.testing.assert_allclose(np.asarray(pinned), np.asarray(auto),
+                                   atol=1e-5)
+
+
+class TestFp8MatmulBackward:
+    def _grads(self, x, w, bias):
+        def loss(x, w, bias, dy):
+            return jnp.sum(fp8_matmul(x, w, bias) * dy)
+        return loss
+
+    @pytest.mark.parametrize("m,k,n", ODD_MATMUL_SHAPES)
+    def test_grads_match_quantization_aware_oracle(self, rng, m, k, n):
+        x = jnp.asarray(rng.normal(size=(m, k)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(k, n)).astype(np.float32))
+        bias = jnp.asarray(rng.normal(size=(n,)).astype(np.float32))
+        dy = jnp.asarray(rng.normal(size=(m, n)).astype(np.float32))
+        xs = dynamic_scale(x, jnp.float8_e4m3fn)
+        ws = dynamic_scale(w, jnp.float8_e4m3fn)
+        f = lambda x, w, bias: jnp.sum(
+            fp8_matmul(x, w, bias, x_scale=xs, w_scale=ws) * dy)
+        dx, dw, dbias = jax.grad(f, argnums=(0, 1, 2))(x, w, bias)
+        # the oracle replays the VJP's exact quantization decisions in
+        # plain XLA: e5m2 dynamic-scaled cotangent against the saved e4m3
+        # residuals, straight-through the quantizer
+        ds = dynamic_scale(dy, jnp.float8_e5m2)
+        dy_deq = _dequant(dy, ds, jnp.float8_e5m2)
+        x_deq = _dequant(x, xs, jnp.float8_e4m3fn)
+        w_deq = _dequant(w, ws, jnp.float8_e4m3fn)
+        tol = dict(rtol=1e-5, atol=1e-3 * max(1, max(k, m, n) // 64))
+        np.testing.assert_allclose(np.asarray(dx),
+                                   np.asarray(dy_deq @ w_deq.T), **tol)
+        np.testing.assert_allclose(np.asarray(dw),
+                                   np.asarray(x_deq.T @ dy_deq), **tol)
+        # dbias sums the *unquantized* cotangent — it never went through
+        # the fp8 dot, so it is exact
+        np.testing.assert_allclose(np.asarray(dbias),
+                                   np.asarray(jnp.sum(dy, axis=0)),
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_grads_close_to_full_precision(self, rng):
+        # e5m2 keeps 2 mantissa bits: round-tripping an iid-normal
+        # cotangent caps cosine near ~0.9986, so >= 0.99 is the honest
+        # gate here; exactness lives in the oracle test above
+        x = jnp.asarray(rng.normal(size=(33, 64)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(64, 128)).astype(np.float32))
+        dy = jnp.asarray(rng.normal(size=(33, 128)).astype(np.float32))
+        f = lambda x, w: jnp.sum(fp8_matmul(x, w) * dy)
+        dx, dw = jax.grad(f, argnums=(0, 1))(x, w)
+        assert _cos(dx, np.asarray(dy) @ np.asarray(w).T) > 0.99
+        assert _cos(dw, np.asarray(x).T @ np.asarray(dy)) > 0.99
+
+    def test_no_gradient_flows_to_scales(self, rng):
+        # scales are statistics, not parameters — a leaked gradient would
+        # let the optimizer chase its own quantization noise
+        x = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+        f = lambda xs, ws: jnp.sum(fp8_matmul(x, w, x_scale=xs, w_scale=ws))
+        gxs, gws = jax.grad(f, argnums=(0, 1))(jnp.asarray(0.01),
+                                               jnp.asarray(0.02))
+        assert float(gxs) == 0.0 and float(gws) == 0.0
+
+    def test_cotangents_preserve_primal_dtypes(self, rng):
+        # bf16 models under remat fail stablehlo verification if the VJP
+        # hands back f32 cotangents for bf16 primals
+        x = jnp.asarray(rng.normal(size=(8, 16))).astype(jnp.bfloat16)
+        w = jnp.asarray(rng.normal(size=(16, 8))).astype(jnp.bfloat16)
+        bias = jnp.asarray(rng.normal(size=(8,)).astype(np.float32))
+        f = lambda x, w, bias: jnp.sum(fp8_matmul(x, w, bias))
+        dx, dw, dbias = jax.grad(f, argnums=(0, 1, 2))(x, w, bias)
+        assert dx.dtype == jnp.bfloat16 and dw.dtype == jnp.bfloat16
+        assert dbias.dtype == jnp.float32
+        xf, wf = x.astype(jnp.float32), w.astype(jnp.float32)
+        dxf, dwf, _ = jax.grad(f, argnums=(0, 1, 2))(xf, wf, bias)
+        assert dxf.dtype == jnp.float32 and dwf.dtype == jnp.float32
+
+    def test_no_bias_yields_no_bias_grad(self, rng):
+        x = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+        (dx,) = jax.grad(lambda x: jnp.sum(fp8_matmul(x, w)),
+                         argnums=(0,))(x)
+        assert dx.shape == x.shape and np.all(np.isfinite(np.asarray(dx)))
+
+
+class TestFp8Lowering:
+    def test_forward_lowers_on_tpu_backend(self, rng):
+        # odd shape: every pad/clamp path must produce Mosaic-legal blocks
+        x = jnp.asarray(rng.normal(size=(5, 100)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(100, 33)).astype(np.float32))
+        fn = jax.jit(fp8_matmul)
+        fn.trace(x, w).lower(lowering_platforms=("tpu",))  # must not raise
+
+    def test_backward_lowers_on_tpu_backend(self, rng):
+        x = jnp.asarray(rng.normal(size=(5, 100)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(100, 33)).astype(np.float32))
+        fn = jax.jit(jax.grad(
+            lambda x, w: jnp.sum(fp8_matmul(x, w)), argnums=(0, 1)))
+        fn.trace(x, w).lower(lowering_platforms=("tpu",))  # must not raise

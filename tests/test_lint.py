@@ -383,6 +383,43 @@ class TestRuleFixtures:
         assert check_journal_bypass(
             tree, "jimm_tpu/obs/registry.py") == []
 
+    def test_jl016_bare_lowp_cast(self):
+        findings = findings_for("ops/lowp_bad_cast.py")
+        assert rules_and_lines(findings) == {
+            ("JL016", 7),   # bare .astype(jnp.float8_e4m3fn)
+            ("JL016", 8),   # jax.lax.convert_element_type(..., e5m2)
+            ("JL016", 9),   # string dtype spelling .astype("int8")
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert any("quantize_tensor" in f.message for f in findings)
+        # the quantize/scale sanctioned sites, the expression-derived
+        # dtype, and the suppressed deliberate cast (lines 13-28) stay
+        # clean
+
+    def test_jl016_scoped_to_ops_and_train_paths(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_bare_lowp_cast
+        src = "y = x.astype(jnp.float8_e4m3fn)\n"
+        tree = ast.parse(src)
+        assert check_bare_lowp_cast(
+            tree, "jimm_tpu/ops/fp8_matmul.py") != []
+        assert check_bare_lowp_cast(
+            tree, "jimm_tpu/train/trainer.py") != []
+        # checkpoint rewrite code stores int8 as a format, not a numerics
+        # decision; tests compare against raw casts on purpose
+        assert check_bare_lowp_cast(
+            tree, "jimm_tpu/weights/quantize.py") == []
+        assert check_bare_lowp_cast(tree, "tests/test_fp8_ops.py") == []
+        # the quantizer's own cast is sanctioned by its enclosing name
+        from jimm_tpu.lint.rules_ast import _annotate_parents
+        src_ok = ("def quantize_rows(x, s):\n"
+                  "    return (x / s).astype(jnp.int8)\n")
+        tree_ok = ast.parse(src_ok)
+        _annotate_parents(tree_ok)
+        assert check_bare_lowp_cast(
+            tree_ok, "jimm_tpu/ops/int8_matmul.py") == []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings

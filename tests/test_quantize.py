@@ -154,6 +154,69 @@ class TestQuantizeModel:
         assert np.asarray(attn(x)).shape == (1, 8, 64)
 
 
+class TestPrecisionPolicy:
+    """Training-precision surgery (quant/policy.py): the bf16 / fp8_hybrid
+    / int8_qk axis the train CLI exposes as --precision."""
+
+    def test_policy_literal_matches_policies(self):
+        from typing import get_args
+
+        from jimm_tpu.configs import Precision
+        from jimm_tpu.quant.policy import POLICIES
+        assert tuple(get_args(Precision)) == POLICIES
+
+    def test_bf16_is_identity(self):
+        from jimm_tpu.nn.transformer import Attention
+        from jimm_tpu.quant.policy import apply_precision_policy
+        attn = Attention(64, 2, nnx.Rngs(0))
+        assert apply_precision_policy(attn, "bf16") == 0
+        assert isinstance(attn.q, nnx.Linear)
+
+    def test_fp8_hybrid_shares_master_weights(self):
+        from jimm_tpu.nn.transformer import Attention
+        from jimm_tpu.quant.policy import Fp8Linear, apply_precision_policy
+        attn = Attention(64, 2, nnx.Rngs(0))
+        kernel = attn.q.kernel
+        n = apply_precision_policy(attn, "fp8_hybrid")
+        assert n == 4  # q/k/v/out
+        assert isinstance(attn.q, Fp8Linear)
+        # the optimizer keeps updating the ORIGINAL Param — surgery must
+        # share it, never copy
+        assert attn.q.kernel is kernel
+        assert attn.q.x_amax[...].shape == (16,)
+        x = np.random.RandomState(0).randn(1, 8, 64).astype(np.float32)
+        out = np.asarray(attn(x))
+        assert out.shape == (1, 8, 64) and np.all(np.isfinite(out))
+        # the forward rolled the delayed-scaling histories
+        assert float(attn.q.w_amax[...][-1]) > 0
+
+    def test_fused_qkv_projections_stay_linear(self):
+        from jimm_tpu.nn.transformer import Attention
+        from jimm_tpu.quant.policy import Fp8Linear, apply_precision_policy
+        attn = Attention(64, 2, nnx.Rngs(0), fused_qkv=True)
+        n = apply_precision_policy(attn, "fp8_hybrid")
+        # fused_qkv reads raw .kernel params for the (H, 3H) concat —
+        # same eligibility rule as quantize_model
+        assert n == 1
+        assert isinstance(attn.out, Fp8Linear)
+        assert all(isinstance(getattr(attn, p), nnx.Linear)
+                   for p in ("q", "k", "v"))
+
+    def test_int8_qk_flips_attention_impl_only(self):
+        from jimm_tpu.nn.transformer import Attention
+        from jimm_tpu.quant.policy import apply_precision_policy
+        attn = Attention(64, 2, nnx.Rngs(0))
+        n = apply_precision_policy(attn, "int8_qk")
+        assert n == 1 and attn.impl == "flash_int8"
+        assert isinstance(attn.q, nnx.Linear)  # linears untouched
+
+    def test_unknown_policy_raises(self):
+        from jimm_tpu.quant.policy import apply_precision_policy
+        with pytest.raises(ValueError, match="unknown precision policy"):
+            apply_precision_policy(nnx.Linear(4, 4, rngs=nnx.Rngs(0)),
+                                   "fp4")
+
+
 class TestServeDtypeAxis:
     def test_bucket_table_carries_dtype(self):
         from jimm_tpu.serve import SERVE_DTYPES, BucketTable

@@ -277,11 +277,45 @@ class TestSpace:
                     assert int8_flash_vmem_bytes(bq, bk, d) == \
                         fi._per_head_vmem_bytes(bq, bk, d)
 
+    def test_int8_flash_bwd_vmem_formula_matches_ops(self):
+        # blocks are shared between the fwd and bwd kernels, so the pruner
+        # must model BOTH working sets — this pins the bwd one
+        from jimm_tpu.ops import flash_attention_int8 as fi
+        from jimm_tpu.tune.space import int8_flash_bwd_vmem_bytes
+        for bq in (128, 512):
+            for bk in (128, 512):
+                for d in (64, 128):
+                    assert int8_flash_bwd_vmem_bytes(bq, bk, d) == \
+                        fi._per_head_bwd_vmem_bytes(bq, bk, d)
+
+    def test_fp8_matmul_vmem_formula_matches_ops(self):
+        from jimm_tpu.ops import fp8_matmul as fm
+        from jimm_tpu.tune.space import VMEM_BUDGET, fp8_matmul_vmem_bytes
+        assert VMEM_BUDGET == fm._VMEM_BUDGET
+        for bm in (32, 64, 256):
+            for bn in (128, 512):
+                for k in (64, 768):
+                    assert fp8_matmul_vmem_bytes(bm, bn, k) == \
+                        fm._per_cell_vmem_bytes(bm, bn, k)
+
+    def test_fp8_matmul_space_prunes_to_shape(self):
+        cands = kernel_space("fp8_matmul", ((40, 64), (64, 40)),
+                             ("float8_e4m3fn", "float8_e4m3fn"))
+        assert cands
+        for c in cands:
+            # m=40 -> 64-row ceiling; n=40 -> one 128-lane tile
+            assert c["block_m"] <= 64 and c["block_n"] <= 128
+
     def test_int8_kernels_registered(self):
-        for name in ("int8_matmul", "flash_attention_int8"):
+        for name in ("int8_matmul", "flash_attention_int8", "fp8_matmul"):
             assert name in KERNELS
             assert KERNELS[name].version >= 1
             assert callable(KERNELS[name].bench)
+
+    def test_int8_flash_version_bumped_for_backward(self):
+        # the lse output changed the fwd working set and the bwd added new
+        # feasibility constraints — configs tuned for version 1 must miss
+        assert KERNELS["flash_attention_int8"].version >= 2
 
     def test_attention_variant_vmem_formulas_match_ops(self):
         # one formula per family member: the pruner's model must BE the
