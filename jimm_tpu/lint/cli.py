@@ -1,9 +1,11 @@
 """Command-line interface: ``python -m jimm_tpu.lint [paths] [--trace]
-[--json] [--vmem-budget BYTES]``.
+[--concurrency] [--jaxpr] [--json] [--sarif OUT] [--suppressions]``.
 
 Exit status is 1 when any **error**-severity finding survives suppression;
 warnings are reported but never block. ``--json`` emits a machine-readable
-report (one object per finding: rule, severity, path, line, message) for CI.
+report (one object per finding: rule, severity, path, line, message) and
+``--sarif OUT`` writes a SARIF 2.1.0 log for code-scanning upload — both
+carry findings from every enabled layer.
 """
 
 from __future__ import annotations
@@ -12,26 +14,55 @@ import argparse
 import json
 import sys
 
-from jimm_tpu.lint.core import ERROR, Finding, lint_paths
+from jimm_tpu.lint.core import (ERROR, Finding, lint_paths,
+                                suppression_audit)
 from jimm_tpu.lint.rules_ast import DEFAULT_VMEM_BUDGET
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m jimm_tpu.lint",
         description="TPU-correctness static analyzer for jimm_tpu "
-                    "(AST rules JL0xx; --trace adds lowered-HLO checks "
-                    "JLT1xx)")
+                    "(AST rules JL0xx; --concurrency adds whole-program "
+                    "lock-discipline checks; --jaxpr adds trace-level "
+                    "JLT104-106; --trace adds lowered-HLO checks JLT1xx)")
     parser.add_argument("paths", nargs="*", default=["jimm_tpu", "tests"],
                         help="files or directories to lint "
                              "(default: jimm_tpu tests)")
+    parser.add_argument("--concurrency", action="store_true",
+                        help="build the project-wide call/flow graph and run "
+                             "the lock-discipline race detector (JL017-019) "
+                             "plus interprocedural escalations of "
+                             "JL006/JL008/JL013 and JL014 inheritance "
+                             "waivers")
+    parser.add_argument("--jaxpr", action="store_true",
+                        help="abstractly trace registered entry points (no "
+                             "compile) and check jaxpr invariants: f32 "
+                             "promotion drift, baked host constants, "
+                             "collective count drift vs goldens "
+                             "(JLT104-106; imports JAX, a few seconds)")
     parser.add_argument("--trace", action="store_true",
                         help="also lower registered model entry points on "
                              "tiny shapes and check donation aliasing, FSDP "
                              "gather behavior, and batch-bucket stability "
                              "(imports JAX, takes ~a minute)")
+    parser.add_argument("--update-goldens", action="store_true",
+                        help="with --jaxpr: re-trace entry points and "
+                             "rewrite jaxpr_goldens.json instead of "
+                             "checking against it")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit findings as a JSON array on stdout")
+    parser.add_argument("--sarif", metavar="OUT",
+                        help="also write findings as a SARIF 2.1.0 log to "
+                             "OUT (for code-scanning upload)")
+    parser.add_argument("--suppressions", action="store_true",
+                        help="print an audit table of every `# jaxlint: "
+                             "disable=` directive (path, line, rules, "
+                             "justification) and exit 0")
     parser.add_argument("--vmem-budget", type=int,
                         default=DEFAULT_VMEM_BUDGET, metavar="BYTES",
                         help="VMEM budget for the JL005 block-size estimate "
@@ -39,15 +70,92 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def to_sarif(findings: list[Finding]) -> dict:
+    """Render findings as a minimal SARIF 2.1.0 log (one run, one result
+    per finding; trace/jaxpr pseudo-paths pass through as URIs)."""
+    rules_seen: dict[str, dict] = {}
+    results = []
+    for f in findings:
+        rules_seen.setdefault(f.rule, {"id": f.rule})
+        results.append({
+            "ruleId": f.rule,
+            "level": "error" if f.severity == ERROR else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                }
+            }],
+        })
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "jaxlint",
+                "informationUri":
+                    "docs/static_analysis.md",
+                "rules": sorted(rules_seen.values(),
+                                key=lambda r: r["id"]),
+            }},
+            "results": results,
+        }],
+    }
+
+
+def _print_suppression_audit(paths: list[str]) -> None:
+    rows = suppression_audit(paths)
+    if not rows:
+        print("no suppression directives found")
+        return
+    widths = (max(len(r[0]) for r in rows),
+              max(len(str(r[1])) for r in rows),
+              max(len(r[2]) for r in rows))
+    for path, line, rules, justification in rows:
+        print(f"{path:<{widths[0]}}  {line:>{widths[1]}}  "
+              f"{rules:<{widths[2]}}  "
+              f"{justification or '(no justification -- JL020)'}")
+    bare = sum(1 for r in rows if not r[3])
+    print(f"{len(rows)} directive(s), {bare} without justification")
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.suppressions:
+        _print_suppression_audit(args.paths)
+        return 0
+    if args.update_goldens:
+        from jimm_tpu.lint.jaxpr import GOLDENS_PATH, update_goldens
+        written = update_goldens()
+        print(f"wrote {len(written)} entry golden(s) to {GOLDENS_PATH}")
+        return 0
+
     findings: list[Finding] = lint_paths(args.paths,
                                          vmem_budget=args.vmem_budget)
+    if args.concurrency:
+        from jimm_tpu.lint.concurrency import (apply_jl014_waivers,
+                                               run_concurrency_checks)
+        from jimm_tpu.lint.core import collect_files
+        from jimm_tpu.lint.graph import ProjectGraph
+        files = collect_files(args.paths)
+        graph = ProjectGraph.build(files)
+        extra = run_concurrency_checks(files, graph=graph)
+        seen = {(f.rule, f.path, f.line) for f in findings}
+        findings.extend(f for f in extra
+                        if (f.rule, f.path, f.line) not in seen)
+        findings = apply_jl014_waivers(findings, graph)
+    if args.jaxpr:
+        from jimm_tpu.lint.jaxpr import run_jaxpr_checks
+        findings.extend(run_jaxpr_checks())
     if args.trace:
         from jimm_tpu.lint.trace import run_trace_checks
         findings.extend(run_trace_checks())
 
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if args.sarif:
+        with open(args.sarif, "w") as fh:
+            json.dump(to_sarif(findings), fh, indent=2)
     if args.as_json:
         print(json.dumps([f.to_dict() for f in findings], indent=2))
     else:

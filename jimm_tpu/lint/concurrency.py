@@ -1,0 +1,317 @@
+"""Lock-discipline race detection over the project call graph (``--concurrency``).
+
+Three rules run on :class:`~jimm_tpu.lint.graph.ProjectGraph` facts rather
+than per-file patterns:
+
+- **JL017** — a class attribute written from two or more distinct thread
+  entry points (event loop, HTTP handler thread, ``threading.Thread``
+  target, executor worker, metrics scrape) with no single lock held at
+  every write site. This is the lost-update/torn-read precursor: the
+  guard set is *inferred* (lexical ``with self._lock:`` plus locks every
+  direct caller provably holds), so a helper only ever invoked under the
+  lock still counts as guarded.
+- **JL018** — a lock-acquisition-order cycle: somewhere lock A is held
+  while B is acquired, and elsewhere B is held while A is acquired. With
+  the two sites on different threads this deadlocks; the rule fires on
+  the ordering evidence so the freeze never ships. asyncio locks
+  participate (a loop task awaiting an asyncio lock while holding a
+  threading lock starves handler threads just as hard).
+- **JL019** — a known-blocking call (``time.sleep``, ``queue.get``,
+  ``.block_until_ready()``, HTTP/subprocess) while holding a threading
+  lock: every other thread touching that lock stalls for the full wait.
+  ``Condition.wait`` on the *held* lock is exempt (it releases it).
+
+The same graph also upgrades four Layer-1 rules from path-name heuristics
+to interprocedural facts: JL006 (device sync reachable from an async def
+through sync helpers), JL008 (jit construction reachable from a request
+handler), JL013 (swallowed excepts in functions that actually run on
+worker threads, wherever the file lives), and JL014 (eviction in a base
+class in another file waives the per-file finding).
+
+False-positive stance: every rule requires *resolved* evidence — an
+unresolvable receiver produces no edge, an unreachable function defaults
+to the single ``main`` root — so silence is cheap and a report is worth
+reading.
+"""
+
+from __future__ import annotations
+
+from jimm_tpu.lint.core import (ERROR, Finding, is_suppressed,
+                                parse_suppressions)
+from jimm_tpu.lint.graph import FunctionInfo, ProjectGraph
+from jimm_tpu.lint.rules_ast import _path_is_test
+
+__all__ = ["run_concurrency_checks", "jl014_waivers"]
+
+
+def _roots_of(fn: FunctionInfo) -> frozenset:
+    """Thread roots of a function; never-called code runs (at most) on the
+    importing thread."""
+    return frozenset(fn.roots) if fn.roots else frozenset({"main"})
+
+
+def _fmt_locks(locks) -> str:
+    return ", ".join(sorted(locks))
+
+
+# ---------------------------------------------------------------------------
+# JL017 — unguarded shared attribute write
+# ---------------------------------------------------------------------------
+
+def _jl017(graph: ProjectGraph) -> list[Finding]:
+    findings = []
+    for (owner, attr), sites in sorted(graph.write_sites().items()):
+        sites = [w for w in sites if not _path_is_test(w.func.path)]
+        if not sites:
+            continue
+        roots: set[str] = set()
+        common = None
+        for w in sites:
+            roots |= _roots_of(w.func)
+            eff = w.func.effective_guards(w.guards)
+            common = eff if common is None else common & eff
+        if len(roots) < 2 or common:
+            continue
+        first = min(sites, key=lambda w: (w.func.path, w.lineno))
+        where = ", ".join(
+            f"{w.func.qual}:{w.lineno}"
+            for w in sorted(sites, key=lambda w: (w.func.path, w.lineno)))
+        findings.append(Finding(
+            "JL017", ERROR, first.func.path, first.lineno,
+            f"`{owner}.{attr}` is written from {len(roots)} thread entry "
+            f"points ({_fmt_locks(roots)}) with no lock held at every "
+            f"write ({where}) — lost updates/torn reads; guard all writes "
+            f"with one lock or confine mutation to a single thread"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JL018 — lock-acquisition-order cycle
+# ---------------------------------------------------------------------------
+
+def _jl018(graph: ProjectGraph) -> list[Finding]:
+    edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+    for fn in graph.functions.values():
+        if _path_is_test(fn.path):
+            continue
+        for acq in fn.acquires:
+            held = acq.held | (fn.entry_guards or frozenset())
+            for h in held:
+                if h != acq.lock:
+                    edges.setdefault((h, acq.lock),
+                                     (fn.path, acq.lineno, fn.qual))
+    adj: dict[str, list[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+
+    cycles: dict[tuple, tuple[str, str]] = {}
+
+    def dfs(node: str, stack: list[str], on_stack: set[str]):
+        for nxt in adj.get(node, ()):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                cycles.setdefault(tuple(sorted(set(cyc))), ("->".join(cyc),
+                                                            node))
+            elif (node, nxt) not in visited_edges:
+                visited_edges.add((node, nxt))
+                stack.append(nxt)
+                on_stack.add(nxt)
+                dfs(nxt, stack, on_stack)
+                on_stack.discard(nxt)
+                stack.pop()
+
+    visited_edges: set[tuple[str, str]] = set()
+    for start in sorted(adj):
+        dfs(start, [start], {start})
+
+    findings = []
+    for key, (order, last) in sorted(cycles.items()):
+        locks = sorted(key)
+        evidence = []
+        for a, b in edges:
+            if a in key and b in key:
+                path, line, qual = edges[(a, b)]
+                evidence.append((path, line, f"{qual} holds {a} then "
+                                             f"takes {b}"))
+        evidence.sort()
+        path, line, _ = evidence[0]
+        detail = "; ".join(e for _, _, e in evidence[:4])
+        findings.append(Finding(
+            "JL018", ERROR, path, line,
+            f"lock-acquisition-order cycle {order} — two threads entering "
+            f"from opposite ends deadlock permanently ({detail}); pick one "
+            f"global order for {_fmt_locks(locks)} and acquire in that "
+            f"order everywhere"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JL019 — blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+def _jl019(graph: ProjectGraph) -> list[Finding]:
+    findings = []
+    for fn in graph.functions.values():
+        if _path_is_test(fn.path):
+            continue
+        for site in fn.blocking:
+            held = fn.effective_guards(site.guards)
+            if not held:
+                continue
+            findings.append(Finding(
+                "JL019", ERROR, fn.path, site.lineno,
+                f"blocking call {site.what} in `{fn.qual}` while holding "
+                f"{_fmt_locks(held)} — every thread contending on that "
+                f"lock stalls for the full wait; move the blocking "
+                f"operation outside the critical section or snapshot "
+                f"state under the lock and wait after releasing it"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# interprocedural escalations of Layer-1 rules
+# ---------------------------------------------------------------------------
+
+def _sync_reaches_device_sync(graph: ProjectGraph) -> dict[str, tuple]:
+    """fid -> (sync line, dotted name) for sync functions that perform (or
+    transitively, via direct same-thread calls, reach) a device sync."""
+    out: dict[str, tuple] = {}
+    for fn in graph.functions.values():
+        if not fn.is_async and fn.device_syncs:
+            name, line = fn.device_syncs[0]
+            out[fn.fid] = (line, name)
+    changed = True
+    while changed:
+        changed = False
+        for fn in graph.functions.values():
+            if fn.fid in out or fn.is_async:
+                continue
+            for site in fn.calls:
+                if site.ctx == "direct" and site.callee in out:
+                    out[fn.fid] = out[site.callee]
+                    changed = True
+                    break
+    return out
+
+
+def _jl006_interproc(graph: ProjectGraph) -> list[Finding]:
+    syncing = _sync_reaches_device_sync(graph)
+    findings = []
+    for fn in graph.functions.values():
+        if not fn.is_async or _path_is_test(fn.path):
+            continue
+        for site in fn.calls:
+            if site.ctx != "direct" or site.callee not in syncing:
+                continue
+            callee = graph.functions.get(site.callee)
+            if callee is None or callee.is_async:
+                continue
+            line, what = syncing[site.callee]
+            findings.append(Finding(
+                "JL006", ERROR, fn.path, site.lineno,
+                f"async `{fn.name}` calls `{callee.qual}` which reaches "
+                f"{what} ({callee.path}:{line}) — a device wait on the "
+                f"event loop through a sync helper; run the helper via "
+                f"run_in_executor instead of calling it inline"))
+    return findings
+
+
+def _jl008_interproc(graph: ProjectGraph) -> list[Finding]:
+    findings = []
+    for fn in graph.functions.values():
+        if _path_is_test(fn.path) or not fn.jit_sites:
+            continue
+        if "http-handler" not in fn.roots:
+            continue
+        for line in fn.jit_sites:
+            findings.append(Finding(
+                "JL008", ERROR, fn.path, line,
+                f"`{fn.qual}` constructs a jit wrapper and is reachable "
+                f"from an HTTP request handler — a fresh compile cache "
+                f"per request; hoist the jit to module or __init__ scope"))
+    return findings
+
+
+def _jl013_interproc(graph: ProjectGraph) -> list[Finding]:
+    findings = []
+    for fn in graph.functions.values():
+        if _path_is_test(fn.path) or not fn.swallow_lines:
+            continue
+        worker_roots = {r for r in fn.roots
+                        if r.startswith("thread:") or r == "executor"}
+        if not worker_roots:
+            continue
+        for line in fn.swallow_lines:
+            findings.append(Finding(
+                "JL013", ERROR, fn.path, line,
+                f"broad exception swallowed silently in `{fn.qual}`, "
+                f"which runs on {_fmt_locks(worker_roots)} — a worker "
+                f"thread dying here is invisible to the supervisor and "
+                f"watchdog regardless of which package the file lives in; "
+                f"handle, log, or narrow it"))
+    return findings
+
+
+def jl014_waivers(graph: ProjectGraph) -> set[tuple[str, str]]:
+    """(path, attr) pairs whose per-file JL014 finding is waived because a
+    *base class in another file* evicts the attribute — the per-file rule
+    cannot see cross-file inheritance, the graph can."""
+    waived: set[tuple[str, str]] = set()
+    for ci in graph.classes.values():
+        inherited = graph.inherited_evictions(ci) - ci.evict_attrs
+        for attr in inherited:
+            waived.add((ci.path, attr))
+    return waived
+
+
+def apply_jl014_waivers(findings: list[Finding],
+                        graph: ProjectGraph) -> list[Finding]:
+    waived = jl014_waivers(graph)
+    if not waived:
+        return findings
+    out = []
+    for f in findings:
+        if f.rule == "JL014":
+            attr = f.message.split(" ", 1)[0].removeprefix("self.")
+            if (f.path, attr) in waived:
+                continue
+        out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def run_concurrency_checks(paths: list[str],
+                           graph: ProjectGraph | None = None
+                           ) -> list[Finding]:
+    """Build the project graph over ``paths`` and run JL017–JL019 plus the
+    interprocedural JL006/JL008/JL013 escalations. Suppression comments
+    apply exactly as for per-file rules."""
+    if graph is None:
+        graph = ProjectGraph.build(paths)
+    findings = (_jl017(graph) + _jl018(graph) + _jl019(graph)
+                + _jl006_interproc(graph) + _jl008_interproc(graph)
+                + _jl013_interproc(graph))
+    by_path: dict[str, list[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    kept: list[Finding] = []
+    for path, group in by_path.items():
+        try:
+            with open(path, encoding="utf-8") as fh:
+                suppressions = parse_suppressions(fh.read())
+        except (OSError, UnicodeDecodeError):
+            suppressions = {}
+        kept.extend(f for f in group if not is_suppressed(f, suppressions))
+    # one finding per (rule, path, line): the per-file layer may have
+    # reported the same site already
+    seen: set[tuple[str, str, int]] = set()
+    out = []
+    for f in sorted(kept, key=lambda f: (f.path, f.line, f.rule)):
+        key = (f.rule, f.path, f.line)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out

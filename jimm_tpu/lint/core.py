@@ -45,23 +45,35 @@ class Finding:
                f"{self.message}"
 
 
-def parse_suppressions(source: str) -> dict[int, frozenset[str]]:
-    """Map line number -> rule IDs suppressed there.
+@dataclasses.dataclass(frozen=True)
+class Directive:
+    """One ``# jaxlint: disable=...`` comment: where it sits, which line it
+    applies to, which rules it waives, and the trailing justification text
+    (empty string = a bare, unjustified disable — JL020)."""
+    lineno: int
+    col: int
+    target: int
+    rules: frozenset[str]
+    justification: str
+
+
+def parse_directives(source: str) -> list[Directive]:
+    """Every suppression directive in ``source``, in file order.
 
     ``# jaxlint: disable=JL001`` (comma-separate for several rules) on a code
     line suppresses those rules on that line; on a standalone comment line it
     suppresses them on the next line. ``disable=all`` suppresses every rule.
     Comments are found with ``tokenize`` so strings containing the marker
-    don't count.
+    don't count. Text after the rule list is the human justification.
     """
-    suppressed: dict[int, set[str]] = {}
+    out: list[Directive] = []
     try:
         tokens = tokenize.generate_tokens(io.StringIO(source).readline)
-        comments = [(t.start[0], t.start[1], t.string)
+        comments = [(t.start[0], t.start[1], t.string, t.line)
                     for t in tokens if t.type == tokenize.COMMENT]
     except (tokenize.TokenError, SyntaxError, IndentationError):
-        return {}
-    for lineno, col, text in comments:
+        return []
+    for lineno, col, text, line in comments:
         body = text.lstrip("#").strip()
         if not body.startswith(SUPPRESS_TAG):
             continue
@@ -70,11 +82,44 @@ def parse_suppressions(source: str) -> dict[int, frozenset[str]]:
             continue
         # everything after "disable=" up to the first space is the rule list;
         # the rest of the comment is the human justification
-        rules = directive[len("disable="):].split(None, 1)[0]
+        parts = directive[len("disable="):].split(None, 1)
+        rules = parts[0]
+        justification = parts[1].strip() if len(parts) > 1 else ""
         ids = frozenset(r.strip() for r in rules.split(",") if r.strip())
-        target = lineno + 1 if col == 0 else lineno
-        suppressed.setdefault(target, set()).update(ids)
+        # a comment-only line (any indentation) targets the next line; a
+        # trailing comment targets its own
+        standalone = not line[:col].strip()
+        target = lineno + 1 if standalone else lineno
+        out.append(Directive(lineno, col, target, ids, justification))
+    return out
+
+
+def parse_suppressions(source: str) -> dict[int, frozenset[str]]:
+    """Map line number -> rule IDs suppressed there (see
+    :func:`parse_directives` for the comment grammar)."""
+    suppressed: dict[int, set[str]] = {}
+    for d in parse_directives(source):
+        suppressed.setdefault(d.target, set()).update(d.rules)
     return {ln: frozenset(ids) for ln, ids in suppressed.items()}
+
+
+def check_bare_suppressions(source: str, path: str) -> list[Finding]:
+    """JL020: a ``# jaxlint: disable=...`` with no trailing justification.
+    A suppression is a standing exception to a correctness rule; the
+    reviewer three PRs later needs the *why* next to the waiver, not in
+    the commit that introduced it."""
+    findings = []
+    for d in parse_directives(source):
+        if d.justification:
+            continue
+        findings.append(Finding(
+            "JL020", WARNING, path, d.lineno,
+            f"bare suppression of {', '.join(sorted(d.rules))} with no "
+            f"justification — append the reason to the comment "
+            f"(# jaxlint: disable={','.join(sorted(d.rules))} <why>); "
+            f"audit all waivers with `python -m jimm_tpu.lint "
+            f"--suppressions`"))
+    return findings
 
 
 def is_suppressed(finding: Finding,
@@ -116,7 +161,25 @@ def lint_file(path: str, *, vmem_budget: int | None = None) -> list[Finding]:
                         f"syntax error: {e.msg}")]
     suppressions = parse_suppressions(source)
     findings = rules_ast.run_all(tree, path, vmem_budget=vmem_budget)
+    findings += check_bare_suppressions(source, path)
     return [f for f in findings if not is_suppressed(f, suppressions)]
+
+
+def suppression_audit(paths: list[str]) -> list[tuple[str, int, str, str]]:
+    """Every suppression directive under ``paths``:
+    (path, line, comma-joined rules, justification) in path order — the
+    data behind ``--suppressions``."""
+    rows: list[tuple[str, int, str, str]] = []
+    for path in collect_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        for d in parse_directives(source):
+            rows.append((path, d.lineno, ",".join(sorted(d.rules)),
+                         d.justification))
+    return rows
 
 
 def lint_paths(paths: list[str], *,

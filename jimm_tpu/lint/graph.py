@@ -1,0 +1,983 @@
+"""Project-wide symbol table and call graph for whole-program lint checks.
+
+Layer 1 rules see one file at a time; everything here exists so rules can
+ask questions that cross file boundaries:
+
+- *who calls this function, and from which thread?* Entry points are
+  discovered structurally — ``threading.Thread(target=...)``, executor
+  ``submit``/``run_in_executor``, ``asyncio.run_coroutine_threadsafe``,
+  ``do_VERB`` HTTP handlers, gauge/done callbacks, ``async def`` bodies —
+  and propagated through resolved call edges, so "this attribute is
+  written from the event loop AND an HTTP handler thread" is a query, not
+  a guess.
+- *which locks protect this statement?* Lexical ``with <lock>:`` contexts
+  are tracked per statement, and a callee inherits the locks every one of
+  its (direct, same-thread) callers holds, so a helper that is only ever
+  invoked under ``self._lock`` counts as guarded.
+- *what type is this expression?* A deliberately small inferencer —
+  parameter/attribute annotations, ``self.x = ClassName(...)``,
+  container element types, function return annotations — resolves enough
+  receivers (``self.engine.submit``, ``get_journal().emit``) to build a
+  useful edge set without import-time execution. Unresolvable calls are
+  dropped, never guessed wide: every analysis downstream is tuned to
+  prefer a false negative over a false positive.
+
+Everything is stdlib ``ast`` — like the per-file rules, building the graph
+imports nothing from the analyzed project.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from jimm_tpu.lint.core import collect_files
+
+__all__ = ["ProjectGraph", "FunctionInfo", "ClassInfo", "ModuleInfo",
+           "WriteSite", "CallSite", "AcquireSite", "BlockSite"]
+
+#: method names that mark a function as an HTTP-request thread entry
+DO_VERBS = frozenset({"do_GET", "do_POST", "do_PUT", "do_DELETE", "do_HEAD"})
+
+#: lock constructors, by discipline (asyncio locks guard tasks, not threads)
+_THREAD_LOCK_CTORS = frozenset({"threading.Lock", "threading.RLock",
+                                "threading.Condition", "Lock", "RLock",
+                                "Condition"})
+_ASYNC_LOCK_CTORS = frozenset({"asyncio.Lock", "asyncio.Condition"})
+
+#: callback registrars: attr name -> the thread root the callback runs on
+_CALLBACK_ROOTS = {
+    "bind_gauge": "metrics-scrape",  # evaluated inside snapshot()/scrapes
+    "gauge": "metrics-scrape",       # MetricRegistry.gauge(name, fn)
+    "add_done_callback": "loop",     # asyncio task callbacks run on the loop
+}
+
+#: method names too generic to resolve by name alone (dict.get, list.append,
+#: Queue.put, Executor.submit... a name-match here would wire half the tree
+#: together); typed receivers still resolve these precisely
+_COMMON_METHOD_NAMES = frozenset({
+    "get", "put", "pop", "items", "keys", "values", "append", "appendleft",
+    "add", "close", "open", "read", "write", "update", "copy", "start",
+    "stop", "run", "join", "wait", "set", "clear", "result", "done",
+    "cancel", "send", "recv", "acquire", "release", "submit", "snapshot",
+    "emit", "reset", "flush", "count", "observe", "inc", "tail", "events",
+    "describe", "search", "encode", "decode", "render", "log", "select",
+    "next", "extend", "index", "sort", "split", "merge", "setdefault",
+    "serve_forever", "shutdown", "server_close",
+})
+
+#: dotted call names that block the calling thread (JL019's vocabulary);
+#: file writes/flushes are deliberately absent — writing under a lock is
+#: the journal's correctness mechanism, not a hazard
+_BLOCKING_DOTTED = frozenset({
+    "time.sleep", "urllib.request.urlopen", "urlopen", "requests.get",
+    "requests.post", "requests.request", "socket.create_connection",
+    "subprocess.run", "subprocess.check_output", "subprocess.check_call",
+})
+
+#: receiver type -> method names that block on it
+_BLOCKING_METHODS = {
+    "queue.Queue": frozenset({"get", "put", "join"}),
+    "threading.Thread": frozenset({"join"}),
+    "threading.Event": frozenset({"wait"}),
+    "threading.Condition": frozenset({"wait", "wait_for"}),
+}
+
+#: attribute calls that block regardless of receiver type
+_BLOCKING_ATTRS = frozenset({"block_until_ready"})
+
+#: device-sync calls for the interprocedural JL006 escalation (narrower
+#: than HOST_SYNC_CALLS: np.asarray of a host list is legitimate loop work,
+#: a device wait never is)
+_DEVICE_SYNC_DOTTED = frozenset({"jax.device_get", "device_get"})
+
+_EVICTION_METHODS = frozenset({"pop", "popitem", "popleft", "clear"})
+
+
+@dataclasses.dataclass
+class WriteSite:
+    """One instance-attribute mutation: ``obj.attr = ...``, ``obj.attr +=``,
+    or ``next(obj.attr)`` (advancing a stateful iterator IS a write)."""
+    owner: str                 # resolved class name of ``obj``
+    attr: str
+    func: "FunctionInfo"
+    lineno: int
+    guards: frozenset         # lexical thread-lock ids held at the write
+    in_init: bool
+    kind: str                  # "store" | "aug" | "next"
+
+
+@dataclasses.dataclass
+class CallSite:
+    callee: str | None         # resolved function id (None: unresolved)
+    raw: str                   # best-effort dotted descriptor
+    lineno: int
+    guards: frozenset         # thread-lock ids lexically held at the call
+    ctx: str                   # "direct" | "thread:<name>" | "executor"
+    #                          # | "loop" | callback root name
+
+
+@dataclasses.dataclass
+class AcquireSite:
+    lock: str
+    lineno: int
+    held: frozenset           # every lock id (thread + async) held before
+    kind: str                  # "threading" | "asyncio"
+
+
+@dataclasses.dataclass
+class BlockSite:
+    what: str
+    lineno: int
+    guards: frozenset         # thread-lock ids lexically held
+
+
+class FunctionInfo:
+    """One function/method/lambda-callback: its collected facts plus the
+    propagation results (thread roots, locks held at entry)."""
+
+    def __init__(self, fid: str, name: str, qual: str, path: str,
+                 node: ast.AST, cls: "ClassInfo | None", module: "ModuleInfo",
+                 is_async: bool):
+        self.fid = fid
+        self.name = name
+        self.qual = qual
+        self.path = path
+        self.node = node
+        self.cls = cls
+        self.module = module
+        self.is_async = is_async
+        self.lineno = getattr(node, "lineno", 0)
+        self.writes: list[WriteSite] = []
+        self.calls: list[CallSite] = []
+        self.acquires: list[AcquireSite] = []
+        self.blocking: list[BlockSite] = []
+        self.device_syncs: list[tuple[str, int]] = []
+        self.jit_sites: list[int] = []
+        self.swallow_lines: list[int] = []
+        self.param_types: dict[str, str] = {}
+        self.local_types: dict[str, str] = {}
+        self.return_type: str | None = None
+        #: thread roots this function is reachable from (propagated)
+        self.roots: set[str] = set()
+        #: thread-lock ids held on EVERY same-thread path into this
+        #: function (None until some caller is seen; resolves to set())
+        self.entry_guards: frozenset | None = None
+
+    def effective_guards(self, lexical: frozenset) -> frozenset:
+        return lexical | (self.entry_guards or frozenset())
+
+    def __repr__(self):
+        return f"<fn {self.qual} roots={sorted(self.roots)}>"
+
+
+class ClassInfo:
+    def __init__(self, name: str, path: str, node: ast.ClassDef,
+                 module: "ModuleInfo"):
+        self.name = name
+        self.path = path
+        self.node = node
+        self.module = module
+        self.bases: list[str] = []
+        self.methods: dict[str, FunctionInfo] = {}
+        #: lock-valued attributes: attr -> "threading" | "asyncio"
+        self.lock_attrs: dict[str, str] = {}
+        #: attr -> class-name (annotations + ``self.x = ClassName(...)``)
+        self.attr_types: dict[str, str] = {}
+        #: attr -> element class-name for list-of-instances containers
+        self.elem_types: dict[str, str] = {}
+        #: attrs with an eviction path somewhere in THIS class body
+        self.evict_attrs: set[str] = set()
+
+    def __repr__(self):
+        return f"<class {self.name} locks={sorted(self.lock_attrs)}>"
+
+
+class ModuleInfo:
+    def __init__(self, path: str, name: str):
+        self.path = path
+        self.name = name
+        self.imports: dict[str, str] = {}       # alias -> dotted module
+        self.from_imports: dict[str, str] = {}  # name -> "module.name"
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.locks: dict[str, str] = {}         # module-global lock -> kind
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _ann_type(ann: ast.AST | None) -> str | None:
+    """Class name out of an annotation: ``Foo``, ``"Foo"``, ``Foo | None``,
+    ``Optional[Foo]``, ``module.Foo``. Returns the dotted name."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        for side in (ann.left, ann.right):
+            got = _ann_type(side)
+            if got is not None and got != "None":
+                return got
+        return None
+    if isinstance(ann, ast.Subscript):
+        base = _dotted(ann.value)
+        if base in ("Optional", "typing.Optional"):
+            return _ann_type(ann.slice)
+        return base  # "queue.Queue" from queue.Queue[int], list[...] -> list
+    name = _dotted(ann)
+    return None if name in (None, "None") else name
+
+
+def _ann_elem(ann: ast.AST | None) -> str | None:
+    """Element/value type of a container annotation: ``list[X]`` -> X,
+    ``dict[K, V]`` -> V (what subscripting/iterating values() yields)."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if not isinstance(ann, ast.Subscript):
+        return None
+    base = (_dotted(ann.value) or "").rsplit(".", 1)[-1]
+    sl = ann.slice
+    if base in ("list", "List", "set", "Set", "deque"):
+        return _ann_type(sl)
+    if base in ("dict", "Dict") and isinstance(sl, ast.Tuple) \
+            and len(sl.elts) == 2:
+        return _ann_type(sl.elts[1])
+    return None
+
+
+def _lock_ctor_kind(value: ast.AST) -> str | None:
+    if not isinstance(value, ast.Call):
+        return None
+    name = _dotted(value.func)
+    if name in _THREAD_LOCK_CTORS:
+        return "threading"
+    if name in _ASYNC_LOCK_CTORS:
+        return "asyncio"
+    return None
+
+
+def _module_name(path: str) -> str:
+    p = path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [seg for seg in p.split("/") if seg not in ("", ".", "..")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts[-4:])  # tail is plenty for resolution + display
+
+
+class ProjectGraph:
+    """The whole-program index: modules, classes, functions, call edges,
+    thread roots, and inferred guard sets."""
+
+    def __init__(self):
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self._methods_by_name: dict[str, list[FunctionInfo]] = {}
+        self._lambda_n = 0
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, paths: list[str]) -> "ProjectGraph":
+        """Parse every ``.py`` file under ``paths`` and run resolution,
+        root propagation, and entry-guard inference. Unparseable files are
+        skipped (JL000 already reports them per-file)."""
+        graph = cls()
+        for path in collect_files(paths):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (OSError, UnicodeDecodeError, SyntaxError):
+                continue
+            graph._collect_module(path, tree)
+        graph._link_http_handlers()
+        graph._scan_bodies()
+        graph._propagate_roots()
+        graph._propagate_entry_guards()
+        return graph
+
+    # -- pass 1: symbols ---------------------------------------------------
+
+    def _collect_module(self, path: str, tree: ast.Module) -> None:
+        mod = ModuleInfo(path, _module_name(path))
+        self.modules[path] = mod
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mod.imports[alias.asname or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    mod.from_imports[alias.asname or alias.name] = \
+                        f"{node.module or ''}.{alias.name}"
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(mod, node, cls=None)
+            elif isinstance(node, ast.ClassDef):
+                self._add_class(mod, node)
+            elif isinstance(node, ast.Assign):
+                kind = _lock_ctor_kind(node.value)
+                if kind is not None:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            mod.locks[tgt.id] = kind
+
+    def _add_function(self, mod: ModuleInfo, node, cls: ClassInfo | None,
+                      parent_qual: str = "") -> FunctionInfo:
+        if parent_qual:
+            qual = f"{parent_qual}.{node.name}"
+        elif cls is not None:
+            qual = f"{cls.name}.{node.name}"
+        else:
+            qual = node.name
+        fid = f"{mod.path}::{qual}"
+        info = FunctionInfo(fid, node.name, qual, mod.path, node, cls, mod,
+                            isinstance(node, ast.AsyncFunctionDef))
+        args = node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            t = _ann_type(a.annotation)
+            if t is not None:
+                info.param_types[a.arg] = t
+        info.return_type = _ann_type(node.returns)
+        self.functions[fid] = info
+        if parent_qual:
+            pass  # a closure, not a method/module function
+        elif cls is not None:
+            cls.methods[node.name] = info
+            self._methods_by_name.setdefault(node.name, []).append(info)
+        else:
+            mod.functions.setdefault(node.name, info)
+        # nested defs are separate functions (they may run on other threads
+        # via Thread(target=run)); `self` inside them closes over the
+        # enclosing method's instance, so they keep the same class context
+        for stmt in ast.walk(node):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt is not node and self._direct_parent_fn(
+                        node, stmt) is node:
+                self._add_function(mod, stmt, cls=cls, parent_qual=qual)
+        return info
+
+    @staticmethod
+    def _direct_parent_fn(root, target) -> ast.AST | None:
+        """The innermost function node enclosing ``target`` within
+        ``root`` (``root`` itself when un-nested further)."""
+        parent = root
+        stack = [(root, root)]
+        while stack:
+            node, owner = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if child is target:
+                    return owner
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) and \
+                        child is not target:
+                    stack.append((child, child))
+                else:
+                    stack.append((child, owner))
+        return parent
+
+    def _add_class(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        ci = ClassInfo(node.name, mod.path, node, mod)
+        ci.bases = [b for b in (_dotted(base) for base in node.bases)
+                    if b is not None]
+        mod.classes[node.name] = ci
+        self.classes.setdefault(node.name, ci)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(mod, stmt, cls=ci)
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                t = _ann_type(stmt.annotation)
+                if t is not None:
+                    ci.attr_types[stmt.target.id] = t
+                elem = _ann_elem(stmt.annotation)
+                if elem is not None:
+                    ci.elem_types[stmt.target.id] = elem
+        # attribute facts come from every method body: lock attrs, attr
+        # types from annotated-parameter assignment / direct construction,
+        # container element types, and eviction evidence
+        for meth in ast.walk(node):
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_class_attrs(ci, meth)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _EVICTION_METHODS:
+                attr = self._self_attr(sub.func.value)
+                if attr is not None:
+                    ci.evict_attrs.add(attr)
+            elif isinstance(sub, ast.Delete):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        attr = self._self_attr(tgt.value)
+                        if attr is not None:
+                            ci.evict_attrs.add(attr)
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> str | None:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _scan_class_attrs(self, ci: ClassInfo, meth) -> None:
+        params = {}
+        args = meth.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            t = _ann_type(a.annotation)
+            if t is not None:
+                params[a.arg] = t
+        for node in ast.walk(meth):
+            targets = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+                attr = self._self_attr(node.target)
+                if attr is not None:
+                    ann = _ann_type(node.annotation)
+                    if ann is not None:
+                        ci.attr_types.setdefault(attr, ann)
+                    elem = _ann_elem(node.annotation)
+                    if elem is not None:
+                        ci.elem_types.setdefault(attr, elem)
+            for tgt in targets:
+                attr = self._self_attr(tgt)
+                if attr is None:
+                    continue
+                kind = _lock_ctor_kind(value)
+                if kind is not None:
+                    ci.lock_attrs[attr] = kind
+                    continue
+                if isinstance(value, ast.Call):
+                    name = _dotted(value.func)
+                    if name is not None:
+                        ci.attr_types.setdefault(attr, name)
+                elif isinstance(value, ast.Name) and value.id in params:
+                    ci.attr_types.setdefault(attr, params[value.id])
+                elif isinstance(value, (ast.ListComp, ast.List)):
+                    elts = ([value.elt] if isinstance(value, ast.ListComp)
+                            else value.elts)
+                    for elt in elts:
+                        if isinstance(elt, ast.Call):
+                            name = _dotted(elt.func)
+                            if name is not None:
+                                ci.elem_types.setdefault(attr, name)
+                                break
+
+    def _link_http_handlers(self) -> None:
+        """``BaseHTTPRequestHandler`` subclasses see the server instance as
+        ``self.server`` (set by the stdlib, invisible to annotation-driven
+        inference). When a module pairs a ``do_VERB`` handler class with an
+        ``*HTTPServer`` subclass, wire the attribute so ``self.server.app``
+        chains resolve."""
+        for mod in self.modules.values():
+            server_cls = next(
+                (ci for ci in mod.classes.values()
+                 if any(b.rsplit(".", 1)[-1].endswith("HTTPServer")
+                        for b in ci.bases)), None)
+            if server_cls is None:
+                continue
+            for ci in mod.classes.values():
+                if any(name in DO_VERBS for name in ci.methods):
+                    ci.attr_types.setdefault("server", server_cls.name)
+
+    # -- type resolution ---------------------------------------------------
+
+    def _class_named(self, name: str | None) -> ClassInfo | None:
+        if name is None:
+            return None
+        return self.classes.get(name.rsplit(".", 1)[-1])
+
+    def inherited_evictions(self, ci: ClassInfo) -> set[str]:
+        """Evicted attrs of ``ci`` including its project base classes —
+        the interprocedural complement to JL014's per-class scan."""
+        out, stack, seen = set(), [ci], set()
+        while stack:
+            cur = stack.pop()
+            if cur.name in seen:
+                continue
+            seen.add(cur.name)
+            out |= cur.evict_attrs
+            for base in cur.bases:
+                bi = self._class_named(base)
+                if bi is not None:
+                    stack.append(bi)
+        return out
+
+    def _expr_type(self, expr: ast.AST, fn: FunctionInfo,
+                   depth: int = 0) -> str | None:
+        """Best-effort static type (a dotted class name) of ``expr``."""
+        if depth > 4:
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and fn.cls is not None:
+                return fn.cls.name
+            return fn.local_types.get(expr.id) or fn.param_types.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            owner = self._expr_type(expr.value, fn, depth + 1)
+            oc = self._class_named(owner)
+            if oc is not None:
+                return oc.attr_types.get(expr.attr)
+            return None
+        if isinstance(expr, ast.Subscript):
+            owner = self._expr_type(expr.value, fn, depth + 1)
+            # container element type: self._replicas[i] -> _Replica
+            if isinstance(expr.value, ast.Attribute):
+                oc = self._class_named(
+                    self._expr_type(expr.value.value, fn, depth + 1))
+                if oc is not None:
+                    elem = oc.elem_types.get(expr.value.attr)
+                    if elem is not None:
+                        return elem
+            return None if owner in (None, "list", "dict") else None
+        if isinstance(expr, ast.Call):
+            name = _dotted(expr.func)
+            if self._class_named(name) is not None:
+                return name  # constructor call
+            callee = self._resolve_call_target(expr.func, fn)
+            if callee is not None:
+                return callee.return_type
+            return None
+        return None
+
+    def _resolve_call_target(self, func: ast.AST,
+                             fn: FunctionInfo) -> FunctionInfo | None:
+        """Resolve a call expression's target to a project function."""
+        if isinstance(func, ast.Name):
+            mod = fn.module
+            if func.id in mod.functions:
+                return mod.functions[func.id]
+            # nested def in the same enclosing scope
+            nested = self.functions.get(f"{fn.path}::{fn.qual}.{func.id}")
+            if nested is not None:
+                return nested
+            imported = mod.from_imports.get(func.id)
+            if imported is not None:
+                leaf = imported.rsplit(".", 1)[-1]
+                for other in self.modules.values():
+                    if leaf in other.functions and \
+                            other.name.endswith(
+                                imported.rsplit(".", 1)[0].split(".")[-1]):
+                        return other.functions[leaf]
+                for other in self.modules.values():
+                    if leaf in other.functions:
+                        return other.functions[leaf]
+            ctor = self._class_named(func.id)
+            if ctor is not None:
+                return ctor.methods.get("__init__")
+            return None
+        if isinstance(func, ast.Attribute):
+            recv_type = self._expr_type(func.value, fn)
+            ci = self._class_named(recv_type)
+            if ci is not None:
+                return self._method_on(ci, func.attr)
+            # module.function via plain imports
+            base = _dotted(func.value)
+            if base is not None and base in fn.module.imports:
+                target_mod = fn.module.imports[base]
+                for other in self.modules.values():
+                    if other.name.endswith(target_mod.split(".")[-1]) and \
+                            func.attr in other.functions:
+                        return other.functions[func.attr]
+            # last resort, ONLY for receivers with no inferred type: a
+            # method name that is project-unique and not generic —
+            # `state.bucket.try_take(...)` resolves, `.get()` never; a
+            # known non-project receiver (asyncio.Queue, an executor)
+            # never falls through to this, so stdlib methods that happen
+            # to share a project method's name don't create false edges
+            if recv_type is None and func.attr not in _COMMON_METHOD_NAMES:
+                cands = self._methods_by_name.get(func.attr, [])
+                if len(cands) == 1:
+                    return cands[0]
+            return None
+        return None
+
+    def _method_on(self, ci: ClassInfo, name: str) -> FunctionInfo | None:
+        seen: set[str] = set()
+        stack = [ci]
+        while stack:
+            cur = stack.pop()
+            if cur.name in seen:
+                continue
+            seen.add(cur.name)
+            if name in cur.methods:
+                return cur.methods[name]
+            for base in cur.bases:
+                bi = self._class_named(base)
+                if bi is not None:
+                    stack.append(bi)
+        return None
+
+    def _methods_named(self, name: str) -> list[FunctionInfo]:
+        return list(self._methods_by_name.get(name, []))
+
+    # -- pass 2: bodies ----------------------------------------------------
+
+    def _scan_bodies(self) -> None:
+        # local types first (two rounds so x = self.attr chains settle),
+        # then the guard-context body walk
+        for info in list(self.functions.values()):
+            self._infer_locals(info)
+        for info in list(self.functions.values()):
+            self._infer_locals(info)
+        for info in list(self.functions.values()):
+            body = getattr(info.node, "body", None)
+            if body is not None:
+                self._walk_stmts(info, body, frozenset(), frozenset())
+
+    def _infer_locals(self, fn: FunctionInfo) -> None:
+        for node in ast.walk(fn.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn.node:
+                continue
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                t = self._expr_type(node.value, fn)
+                if t is not None:
+                    fn.local_types.setdefault(node.targets[0].id, t)
+            elif isinstance(node, ast.For) and \
+                    isinstance(node.target, ast.Name) and \
+                    isinstance(node.iter, ast.Attribute):
+                oc = self._class_named(self._expr_type(node.iter.value, fn))
+                if oc is not None:
+                    elem = oc.elem_types.get(node.iter.attr)
+                    if elem is not None:
+                        fn.local_types.setdefault(node.target.id, elem)
+
+    def _lock_id(self, expr: ast.AST, fn: FunctionInfo
+                 ) -> tuple[str, str] | None:
+        """(lock id, kind) when ``expr`` denotes a known lock object."""
+        if isinstance(expr, ast.Name):
+            kind = fn.module.locks.get(expr.id)
+            if kind is not None:
+                return f"{fn.module.name}.{expr.id}", kind
+            t = fn.local_types.get(expr.id) or fn.param_types.get(expr.id)
+            if t in _THREAD_LOCK_CTORS:
+                return f"{fn.qual}.{expr.id}", "threading"
+            return None
+        if isinstance(expr, ast.Attribute):
+            owner = self._class_named(self._expr_type(expr.value, fn))
+            if owner is not None and expr.attr in owner.lock_attrs:
+                return (f"{owner.name}.{expr.attr}",
+                        owner.lock_attrs[expr.attr])
+        return None
+
+    def _walk_stmts(self, fn: FunctionInfo, stmts, held_thread: frozenset,
+                    held_all: frozenset) -> None:
+        for stmt in stmts:
+            self._walk_stmt(fn, stmt, held_thread, held_all)
+
+    def _walk_stmt(self, fn: FunctionInfo, stmt: ast.AST,
+                   held_thread: frozenset, held_all: frozenset) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # separate FunctionInfo scans its own body
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_thread, new_all = set(held_thread), set(held_all)
+            for item in stmt.items:
+                self._scan_expr(fn, item.context_expr, held_thread, held_all)
+                got = self._lock_id(item.context_expr, fn)
+                if got is not None:
+                    lock, kind = got
+                    fn.acquires.append(AcquireSite(
+                        lock, stmt.lineno, frozenset(held_all), kind))
+                    new_all.add(lock)
+                    if kind == "threading":
+                        new_thread.add(lock)
+            self._walk_stmts(fn, stmt.body, frozenset(new_thread),
+                             frozenset(new_all))
+            return
+        # non-with statements: scan this node's own expressions, then
+        # recurse into child statements with the same lock context
+        for field in stmt._fields:
+            value = getattr(stmt, field, None)
+            for child in (value if isinstance(value, list) else [value]):
+                if isinstance(child, ast.stmt):
+                    self._walk_stmt(fn, child, held_thread, held_all)
+                elif isinstance(child, ast.expr):
+                    self._scan_expr(fn, child, held_thread, held_all)
+                elif isinstance(child, (ast.excepthandler,)):
+                    self._note_swallow(fn, child)
+                    self._walk_stmts(fn, child.body, held_thread, held_all)
+                elif isinstance(child, (ast.withitem, ast.keyword)):
+                    pass
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._note_writes(fn, stmt, held_thread)
+
+    def _note_swallow(self, fn: FunctionInfo, h: ast.excepthandler) -> None:
+        broad = h.type is None or (isinstance(h.type, ast.Name)
+                                   and h.type.id in ("Exception",
+                                                     "BaseException"))
+        if broad and all(isinstance(s, ast.Pass) for s in h.body):
+            fn.swallow_lines.append(h.lineno)
+
+    def _note_writes(self, fn: FunctionInfo, stmt,
+                     held_thread: frozenset) -> None:
+        if isinstance(stmt, ast.Assign):
+            targets, kind = stmt.targets, "store"
+        elif isinstance(stmt, ast.AugAssign):
+            targets, kind = [stmt.target], "aug"
+        else:
+            targets, kind = [stmt.target], "store"
+        for tgt in targets:
+            nodes = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+            for node in nodes:
+                if not isinstance(node, ast.Attribute):
+                    continue
+                owner = self._expr_type(node.value, fn)
+                oc = self._class_named(owner)
+                if oc is None:
+                    continue
+                fn.writes.append(WriteSite(
+                    oc.name, node.attr, fn, node.lineno, held_thread,
+                    fn.name == "__init__" and oc is fn.cls, kind))
+
+    def _scan_expr(self, fn: FunctionInfo, expr: ast.AST | None,
+                   held_thread: frozenset, held_all: frozenset) -> None:
+        """Walk an expression tree noting calls. Calls consumed by a
+        special form (a thread target, an executor submission, the
+        coroutine handed to ``run_coroutine_threadsafe``) must NOT also be
+        recorded as plain same-thread edges, so the walk descends manually
+        and skips whatever :meth:`_note_call` claims."""
+        if expr is None:
+            return
+        stack: list[ast.AST] = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue  # callbacks are handled at registrar call sites
+            if isinstance(node, ast.Call):
+                consumed = self._note_call(fn, node, held_thread, held_all)
+                stack.extend(c for c in ast.iter_child_nodes(node)
+                             if all(c is not skip for skip in consumed))
+            else:
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _callable_arg(self, fn: FunctionInfo, arg: ast.AST,
+                      lineno: int, ctx: str) -> None:
+        """An expression passed somewhere it will be *invoked* on another
+        thread/root: resolve it (or scan a lambda as a synthetic fn)."""
+        if isinstance(arg, ast.Lambda):
+            self._lambda_n += 1
+            lam = FunctionInfo(
+                f"{fn.path}::{fn.qual}.<lambda@{lineno}.{self._lambda_n}>",
+                "<lambda>", f"{fn.qual}.<lambda@{lineno}>", fn.path,
+                arg, fn.cls, fn.module, False)
+            lam.param_types = dict(fn.param_types)
+            lam.local_types = dict(fn.local_types)
+            self.functions[lam.fid] = lam
+            self._scan_expr(lam, arg.body, frozenset(), frozenset())
+            fn.calls.append(CallSite(lam.fid, lam.qual, lineno,
+                                     frozenset(), ctx))
+            return
+        target = self._resolve_call_target(arg, fn) if isinstance(
+            arg, (ast.Name, ast.Attribute)) else None
+        if target is not None:
+            fn.calls.append(CallSite(target.fid, target.qual, lineno,
+                                     frozenset(), ctx))
+
+    def _note_call(self, fn: FunctionInfo, node: ast.Call,
+                   held_thread: frozenset, held_all: frozenset
+                   ) -> list[ast.AST]:
+        """Record whatever ``node`` means for the graph; returns the child
+        expressions the caller must NOT descend into (already consumed as
+        spawn targets / callbacks / loop-dispatched coroutines)."""
+        name = _dotted(node.func) or ""
+        attr = node.func.attr if isinstance(node.func, ast.Attribute) else ""
+        lineno = node.lineno
+
+        # thread/executor/loop/callback entry discovery ---------------------
+        if name.endswith("threading.Thread") or name == "Thread":
+            target = next((kw.value for kw in node.keywords
+                           if kw.arg == "target"), None)
+            if target is not None:
+                label = _dotted(target) or "<lambda>"
+                self._callable_arg(fn, target, lineno,
+                                   f"thread:{label.rsplit('.', 1)[-1]}")
+                return [target]
+            return []
+        if attr == "run_in_executor" and len(node.args) >= 2:
+            self._callable_arg(fn, node.args[1], lineno, "executor")
+            return [node.args[1]]
+        if attr == "submit" and node.args and isinstance(
+                node.args[0], (ast.Name, ast.Attribute, ast.Lambda)):
+            # executor.submit(fn, ...) — only when arg0 IS a callable ref
+            # AND the receiver is not a project class with its own submit
+            # (engine.submit(image) resolves as a plain method call below)
+            target = self._resolve_call_target(node.args[0], fn) \
+                if not isinstance(node.args[0], ast.Lambda) else None
+            if target is not None or isinstance(node.args[0], ast.Lambda):
+                recv = self._expr_type(node.func.value, fn)
+                if self._class_named(recv) is None:
+                    self._callable_arg(fn, node.args[0], lineno, "executor")
+                    return [node.args[0]]
+        if name.endswith("run_coroutine_threadsafe") and node.args and \
+                isinstance(node.args[0], ast.Call):
+            # the coroutine runs on the event loop thread, with none of
+            # this caller's locks held
+            inner = node.args[0]
+            target = self._resolve_call_target(inner.func, fn)
+            if target is not None:
+                fn.calls.append(CallSite(target.fid, target.qual,
+                                         inner.lineno, frozenset(), "loop"))
+            for arg in list(inner.args) + [kw.value
+                                           for kw in inner.keywords]:
+                self._scan_expr(fn, arg, held_thread, held_all)
+            return [inner]
+        if attr in _CALLBACK_ROOTS:
+            consumed: list[ast.AST] = []
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Lambda) or (
+                        isinstance(arg, (ast.Name, ast.Attribute)) and
+                        self._resolve_call_target(arg, fn) is not None):
+                    self._callable_arg(fn, arg, lineno, _CALLBACK_ROOTS[attr])
+                    consumed.append(arg)
+            return consumed
+
+        # next(obj.attr): advancing a shared iterator is a write ------------
+        if name == "next" and node.args and \
+                isinstance(node.args[0], ast.Attribute):
+            tgt = node.args[0]
+            oc = self._class_named(self._expr_type(tgt.value, fn))
+            if oc is not None:
+                fn.writes.append(WriteSite(
+                    oc.name, tgt.attr, fn, lineno, held_thread,
+                    fn.name == "__init__", "next"))
+
+        # blocking calls ----------------------------------------------------
+        blocked = None
+        if name in _BLOCKING_DOTTED:
+            blocked = name
+        elif attr in _BLOCKING_ATTRS:
+            blocked = f".{attr}()"
+        elif attr and isinstance(node.func, ast.Attribute):
+            recv = self._expr_type(node.func.value, fn)
+            if recv is not None:
+                for rtype, meths in _BLOCKING_METHODS.items():
+                    if recv.endswith(rtype.rsplit(".", 1)[-1]) and \
+                            recv.split(".")[0] == rtype.split(".")[0] and \
+                            attr in meths:
+                        blocked = f"{recv}.{attr}()"
+                # Condition.wait releases ITS OWN lock while waiting: only
+                # *other* held locks make it a hazard
+                if blocked and attr in ("wait", "wait_for"):
+                    own = self._lock_id(node.func.value, fn)
+                    if own is not None and held_thread <= {own[0]}:
+                        blocked = None
+        if blocked is not None:
+            fn.blocking.append(BlockSite(blocked, lineno, held_thread))
+
+        # device syncs + jit construction (interprocedural JL006/JL008) ----
+        if name in _DEVICE_SYNC_DOTTED or attr in _BLOCKING_ATTRS:
+            fn.device_syncs.append((name or f".{attr}()", lineno))
+        if name == "jit" or name.endswith(".jit"):
+            fn.jit_sites.append(lineno)
+
+        # plain resolved edge ----------------------------------------------
+        target = self._resolve_call_target(node.func, fn)
+        if target is not None:
+            fn.calls.append(CallSite(target.fid, target.qual, lineno,
+                                     held_thread, "direct"))
+        return []
+
+    # -- pass 3: propagation ----------------------------------------------
+
+    def _propagate_roots(self) -> None:
+        for info in self.functions.values():
+            if info.is_async:
+                info.roots.add("loop")
+            if info.name in DO_VERBS:
+                info.roots.add("http-handler")
+        changed = True
+        while changed:
+            changed = False
+            for info in self.functions.values():
+                for site in info.calls:
+                    if site.callee is None:
+                        continue
+                    callee = self.functions.get(site.callee)
+                    if callee is None:
+                        continue
+                    if site.ctx == "direct":
+                        contrib = info.roots
+                    elif site.ctx == "loop":
+                        contrib = {"loop"}
+                    else:
+                        contrib = {site.ctx}  # thread:<n>/executor/metrics
+                    if not contrib <= callee.roots:
+                        callee.roots |= contrib
+                        changed = True
+
+    def _propagate_entry_guards(self) -> None:
+        for _round in range(12):
+            changed = False
+            for info in self.functions.values():
+                own = info.entry_guards or frozenset()
+                for site in info.calls:
+                    if site.callee is None or site.ctx != "direct":
+                        continue
+                    callee = self.functions.get(site.callee)
+                    if callee is None or callee is info:
+                        continue
+                    g = frozenset(site.guards | own)
+                    new = g if callee.entry_guards is None \
+                        else callee.entry_guards & g
+                    if new != callee.entry_guards:
+                        callee.entry_guards = new
+                        changed = True
+            if not changed:
+                break
+
+    # -- queries -----------------------------------------------------------
+
+    def function(self, qual: str) -> FunctionInfo | None:
+        """Look up by ``Class.method`` / function name (first match)."""
+        for info in self.functions.values():
+            if info.qual == qual:
+                return info
+        return None
+
+    def write_sites(self) -> dict[tuple[str, str], list[WriteSite]]:
+        """(class, attr) -> every non-``__init__`` write site."""
+        out: dict[tuple[str, str], list[WriteSite]] = {}
+        for info in self.functions.values():
+            for w in info.writes:
+                if not w.in_init:
+                    out.setdefault((w.owner, w.attr), []).append(w)
+        return out
+
+    def guard_sets(self, class_name: str) -> dict[str, frozenset]:
+        """Inferred guard set per attribute of ``class_name``: the
+        thread-lock ids held (lexically or at entry of the writing
+        function) at EVERY non-init write. Empty set = unguarded."""
+        out: dict[str, frozenset] = {}
+        for (owner, attr), sites in self.write_sites().items():
+            if owner != class_name:
+                continue
+            guards = None
+            for w in sites:
+                eff = w.func.effective_guards(w.guards)
+                guards = eff if guards is None else guards & eff
+            out[attr] = guards or frozenset()
+        return out
+
+    def roots_of(self, qual: str) -> set[str]:
+        info = self.function(qual)
+        return set(info.roots) if info is not None else set()

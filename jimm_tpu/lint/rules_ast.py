@@ -260,9 +260,33 @@ def check_version_gated_config(tree: ast.AST, path: str) -> list[Finding]:
 # JL002 — host-device sync inside jitted code
 # ---------------------------------------------------------------------------
 
+def _only_static_uses(value: ast.expr, tainted: set[str]) -> bool:
+    """True when every tainted name in ``value`` is reached only through a
+    static-metadata attribute (``x.dtype``, ``x.shape``, ...) or
+    ``len``/``isinstance`` — such an expression is trace-time static, so
+    a local assigned from it (``dtype = x.dtype``) must NOT be tainted:
+    branching on it later is as legal as branching on ``x.dtype``
+    directly."""
+    found_any = False
+    for node in ast.walk(value):
+        if not (isinstance(node, ast.Name) and node.id in tainted):
+            continue
+        found_any = True
+        parent = _parent(node)
+        if isinstance(parent, ast.Attribute) and parent.attr in STATIC_ATTRS:
+            continue
+        if isinstance(parent, ast.Call) and _dotted(parent.func) in (
+                "len", "isinstance"):
+            continue
+        return False
+    return found_any
+
+
 def _tainted_names(fn: ast.FunctionDef) -> set[str]:
     """Function parameters plus locals assigned from expressions that use
-    them — a one-pass, forward-only approximation of 'traced value'."""
+    them — a one-pass, forward-only approximation of 'traced value'.
+    Locals assigned purely from static metadata of traced values
+    (``dtype = x.dtype``; ``n = len(x)``) stay untainted."""
     args = fn.args
     tainted = {a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)
                if a.arg not in ("self", "cls")}
@@ -273,6 +297,8 @@ def _tainted_names(fn: ast.FunctionDef) -> set[str]:
         if isinstance(node, ast.Assign) and any(
                 isinstance(n, ast.Name) and n.id in tainted
                 for n in ast.walk(node.value)):
+            if _only_static_uses(node.value, tainted):
+                continue
             for target in node.targets:
                 for t in ast.walk(target):
                     if isinstance(t, ast.Name):

@@ -126,8 +126,14 @@ class GoodputAccounter:
         for name, s in secs.items():
             out[f"{name}_s"] = round(s, 4)
             out[f"{name}_frac"] = round(s / wall, 4) if wall > 0 else 0.0
-        out["goodput"] = round(self.goodput(), 4)
+        # derive goodput from the same wall sample as the fracs instead of
+        # calling goodput() (which resamples the clock): every field in one
+        # report must describe the same instant, or goodput and
+        # mfu_adjusted_goodput drift apart whenever the scheduler preempts
+        # between reads
+        g = min(1.0, secs["step"] / wall) if wall > 0 else 0.0
+        out["goodput"] = round(g, 4)
         if mfu is not None:
             out["mfu"] = round(mfu, 4)
-            out["mfu_adjusted_goodput"] = round(self.goodput() * mfu, 4)
+            out["mfu_adjusted_goodput"] = round(g * mfu, 4)
         return out

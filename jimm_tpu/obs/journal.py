@@ -130,7 +130,7 @@ class EventJournal:
         retired; default follows the journal-wide ``echo`` flag.
         """
         rec = {
-            "seq": next(self._seq),
+            "seq": -1,  # placeholder; minted under the lock below
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "mono": round(time.monotonic(), 6),
             "event": str(event),
@@ -139,8 +139,13 @@ class EventJournal:
         for k, v in fields.items():
             if k not in rec:
                 rec[k] = v
-        line = json.dumps(rec, sort_keys=False, default=str)
         with self._lock:
+            # seq is minted inside the critical section so the numbering
+            # matches ring/file order: advancing the counter outside the
+            # lock lets two emitters append in the opposite order of their
+            # seq values (and readers treat seq as the total order)
+            rec["seq"] = next(self._seq)
+            line = json.dumps(rec, sort_keys=False, default=str)
             self._ring.append(rec)
             if self._fh is not None:
                 self._maybe_rotate(len(line) + 1)

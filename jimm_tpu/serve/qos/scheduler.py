@@ -62,6 +62,16 @@ class TokenBucket:
                               self.tokens + (now - self.t_last) * self.rate)
         self.t_last = now
 
+    def peek(self, now: float) -> float:
+        """Current token count WITHOUT mutating the bucket. Metrics
+        scrapes and snapshots run on HTTP/exporter threads concurrently
+        with the loop's :meth:`try_take`; a read-side ``_refill`` there
+        races the loop's read-modify-write and can resurrect spent
+        tokens. Observers compute the refilled value, never store it."""
+        if now <= self.t_last:
+            return self.tokens
+        return min(self.burst, self.tokens + (now - self.t_last) * self.rate)
+
     def try_take(self, now: float) -> float:
         """Take one token. Returns 0.0 on success, else the seconds until
         a token will exist (the Retry-After hint)."""
@@ -137,8 +147,7 @@ class QosScheduler:
         yield self.registry.default.name, self._default_state
 
     def _peek_tokens(self, state: _TenantState) -> float:
-        state.bucket._refill(self.clock())
-        return state.bucket.tokens
+        return state.bucket.peek(self.clock())
 
     # -- submit-side ------------------------------------------------------
 
@@ -227,9 +236,8 @@ class QosScheduler:
                    "throttled": _count(f"tenant_{key}_throttled_total"),
                    "shed": _count(f"tenant_{key}_shed_total")}
             if state.bucket is not None:
-                state.bucket._refill(now)
                 row["rate"] = state.spec.rate
-                row["tokens"] = round(state.bucket.tokens, 3)
+                row["tokens"] = round(state.bucket.peek(now), 3)
             if state.spec.max_queued is not None:
                 row["max_queued"] = state.spec.max_queued
             tenants[name] = row

@@ -478,11 +478,48 @@ class ServingServer:
             raise RequestError("revive needs 'replica': <int index>")
         engine = self._engine_for(payload.get("model"))
         try:
-            stats = engine.revive(index)
+            # Replica bookkeeping (pool/restarts/dead/incident_cid) is
+            # loop-confined state: the watchdog mutates it from loop
+            # coroutines, so the admin path must not mutate it from this
+            # HTTP handler thread. Hop onto the loop and wait. An
+            # unstarted server has no loop yet — spin a disposable one so
+            # the mutation still happens on a loop thread and the
+            # confinement invariant holds unconditionally.
+            loop = self._loop
+            if loop is None:
+                stats = self._revive_on_disposable_loop(engine, index)
+            else:
+                future = asyncio.run_coroutine_threadsafe(
+                    self._revive_on_loop(engine, index), loop)
+                stats = future.result(timeout=30.0)
         except ValueError as e:
             raise RequestError(str(e)) from None
         return {"revived": index, "replica_stats": stats,
                 "dead_replicas": engine.dead_replicas()}
+
+    async def _revive_on_loop(self, engine: InferenceEngine,
+                              index: int) -> dict:
+        return engine.revive(index)
+
+    def _revive_on_disposable_loop(self, engine: InferenceEngine,
+                                   index: int) -> dict:
+        """Revive on a short-lived loop thread when the server was never
+        started. There is no watchdog racing us here, but routing through a
+        loop anyway keeps replica state mutated from exactly one kind of
+        context, so the discipline is uniform rather than "safe by
+        accident"."""
+        loop = asyncio.new_event_loop()
+        thread = threading.Thread(target=loop.run_forever,
+                                  name="jimm-serve-loop", daemon=True)
+        thread.start()
+        try:
+            future = asyncio.run_coroutine_threadsafe(
+                self._revive_on_loop(engine, index), loop)
+            return future.result(timeout=30.0)
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+            thread.join(timeout=5.0)
+            loop.close()
 
     def metrics_text(self) -> str:
         """Unified Prometheus dump for ``/metrics``: this server's

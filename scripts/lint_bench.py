@@ -1,0 +1,83 @@
+"""Benchmark the linter's whole-program pass and gate its time budget.
+
+The ``--concurrency`` layer runs on every CI push and is meant for
+pre-commit hooks, so it has a hard wall-time budget: full-tree AST rules
+plus graph build plus race detection must finish in <= 10 s. This script
+measures the real phases in-process (no interpreter startup in the
+number), appends a record to ``MEASUREMENTS.jsonl``, and exits non-zero
+on a budget breach so CI catches a slow regression the same way it
+catches a wrong one.
+
+Usage::
+
+    python -m scripts.lint_bench            # measure + record + gate
+    python -m scripts.lint_bench --no-gate  # measure + record only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from scripts._measurements import MEASUREMENTS
+
+BUDGET_S = 10.0
+#: the CLI's default tree — what CI lints and pre-commit runs
+PATHS = ["jimm_tpu", "tests"]
+
+
+def measure() -> dict:
+    from jimm_tpu.lint import lint_paths
+    from jimm_tpu.lint.concurrency import run_concurrency_checks
+    from jimm_tpu.lint.core import collect_files
+    from jimm_tpu.lint.graph import ProjectGraph
+
+    t0 = time.perf_counter()
+    ast_findings = lint_paths(PATHS)
+    t_ast = time.perf_counter()
+    files = collect_files(PATHS)
+    graph = ProjectGraph.build(files)
+    t_graph = time.perf_counter()
+    conc_findings = run_concurrency_checks(files, graph=graph)
+    t_conc = time.perf_counter()
+    return {
+        "bench": "lint_full_tree",
+        "files": len(files),
+        "functions": len(graph.functions),
+        "ast_s": round(t_ast - t0, 3),
+        "graph_build_s": round(t_graph - t_ast, 3),
+        "concurrency_s": round(t_conc - t_graph, 3),
+        "total_s": round(t_conc - t0, 3),
+        "budget_s": BUDGET_S,
+        "ast_findings": len(ast_findings),
+        "concurrency_findings": len(conc_findings),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--no-gate", action="store_true",
+                        help="record the measurement without failing on a "
+                             "budget breach")
+    args = parser.parse_args()
+
+    rec = measure()
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(MEASUREMENTS, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    print(f"lint full tree: {rec['files']} files, "
+          f"{rec['functions']} functions | "
+          f"ast {rec['ast_s']}s + graph {rec['graph_build_s']}s + "
+          f"concurrency {rec['concurrency_s']}s = {rec['total_s']}s "
+          f"(budget {BUDGET_S}s)")
+    if not args.no_gate and rec["total_s"] > BUDGET_S:
+        print(f"BUDGET BREACH: {rec['total_s']}s > {BUDGET_S}s",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

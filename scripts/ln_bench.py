@@ -46,7 +46,7 @@ def main():
     for name, f in (("xla", xla_ln),
                     ("fused", lambda x, s, b: layer_norm(x, s, b, eps))):
         # one compile per benchmarked variant, by design
-        g = jax.jit(jax.grad(  # jaxlint: disable=JL008
+        g = jax.jit(jax.grad(  # jaxlint: disable=JL008 one compile/variant
             lambda x, s, b: jnp.sum(f(x, s, b).astype(jnp.float32) ** 2),
             argnums=(0, 1, 2)))
         dt = timeit(g, x, s, b)

@@ -55,10 +55,9 @@ def bench_attention():
         return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
 
     impls = {
-        # probing this exact pinned config IS the experiment
-        # jaxlint: disable=JL009
+        # jaxlint: disable=JL009 probing this pinned config IS the experiment
         "flash(bq=128,bk=128)": loss_of(flash_attention, block_q=128,
-                                        block_k=128),  # jaxlint: disable=JL009
+                                        block_k=128),  # jaxlint: disable=JL009 pinned probe
         "flash(default blocks)": loss_of(flash_attention),
         "xla_dpa": loss_of(
             lambda q, k, v: jax.nn.dot_product_attention(q, k, v)),

@@ -13,7 +13,7 @@ y = layer_norm(x, g, b, block_rows=64)  # line 12: JL009
 
 
 # a justified pin survives: probing this exact config is the point
-z = layer_norm(x, g, b, block_rows=64)  # jaxlint: disable=JL009
+z = layer_norm(x, g, b, block_rows=64)  # jaxlint: disable=JL009 tuned offline
 
 
 BLOCK = 128

@@ -29,3 +29,14 @@ def static_branches_ok(x, mask=None):
     if x.ndim == 3:           # shape metadata is static: no JL002
         x = x.reshape(x.shape[0], -1)
     return x
+
+
+@jax.jit
+def static_alias_branches_ok(x):
+    dtype = x.dtype           # alias of static metadata stays static
+    n = len(x)
+    if dtype == "int8":       # no JL002: branch on dtype via alias
+        x = x.astype("int32")
+    if n > 3:                 # no JL002: branch on len via alias
+        x = x[:3]
+    return x

@@ -1,0 +1,8 @@
+"""Living fixtures for the whole-program concurrency rules (JL017–JL019).
+
+Each module seeds one bug family the graph-based detector must keep
+catching — plus a clean counterpart shaped the same way, so the guard-set
+and root inference are pinned from both directions. ``tests/
+test_lint_graph.py`` asserts exact findings per file; the directory is
+excluded from directory walks like the rest of ``lint_fixtures``.
+"""

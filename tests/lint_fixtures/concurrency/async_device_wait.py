@@ -1,0 +1,16 @@
+"""Interprocedural JL006 seed: the async def never mentions a device wait,
+but the sync helper it calls inline does — only the call graph sees it.
+Dispatching the same helper via run_in_executor is the sanctioned shape."""
+
+
+async def handle_bad(batch):
+    return _wait_for_device(batch)  # JL006: blocks the loop via helper
+
+
+async def handle_ok(batch, loop, pool):
+    return await loop.run_in_executor(pool, _wait_for_device, batch)
+
+
+def _wait_for_device(batch):
+    out = batch * 2
+    return out.block_until_ready()

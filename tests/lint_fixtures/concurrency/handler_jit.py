@@ -1,0 +1,21 @@
+"""Interprocedural JL008 seed: the jit construction hides in a helper two
+hops from the do_GET handler — per-file JL008 can't see the handler, the
+call graph can. The module-scope jit is the clean shape."""
+
+import jax
+
+_FORWARD = jax.jit(lambda x: x * 2)  # built once at import: clean
+
+
+class FixtureHandler:
+    def do_GET(self):
+        return self._respond()
+
+    def _respond(self):
+        return self._make_fn()
+
+    def _make_fn(self):
+        return jax.jit(lambda x: x + 1)  # JL008: fresh wrapper per request
+
+    def fast_path(self, x):
+        return _FORWARD(x)

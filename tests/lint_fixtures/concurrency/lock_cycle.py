@@ -1,0 +1,47 @@
+"""JL018 seed: two locks acquired in opposite orders across methods (the
+replan-vs-scheduler deadlock precursor shape) — plus a pair that always
+nests in one global order, which must stay clean."""
+
+import threading
+
+
+class DeadlockPair:
+    """`rebalance` takes _plan_lock then _stats_lock; `report` takes
+    _stats_lock then _plan_lock: a cycle — two threads entering from
+    opposite ends freeze forever. JL018."""
+
+    def __init__(self):
+        self._plan_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.plan = {}
+        self.stats = {}
+
+    def rebalance(self):
+        with self._plan_lock:
+            with self._stats_lock:
+                self.stats["rebalance"] = len(self.plan)
+
+    def report(self):
+        with self._stats_lock:
+            with self._plan_lock:
+                self.plan["reported"] = dict(self.stats)
+
+
+class OrderedPair:
+    """Same two locks, always plan -> stats: clean."""
+
+    def __init__(self):
+        self._plan_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.plan = {}
+        self.stats = {}
+
+    def rebalance(self):
+        with self._plan_lock:
+            with self._stats_lock:
+                self.stats["rebalance"] = len(self.plan)
+
+    def report(self):
+        with self._plan_lock:
+            with self._stats_lock:
+                self.stats["reported"] = len(self.plan)

@@ -1,0 +1,71 @@
+"""JL017 seed: one attribute written from two thread entry points with no
+consistent guard — and the two shapes that must stay clean (a fully locked
+twin, and a helper guarded only at its call sites)."""
+
+import threading
+
+
+class RacyCounter:
+    """`hits` is written by both worker threads with no lock: JL017."""
+
+    def __init__(self):
+        self.hits = 0
+        self._threads = []
+
+    def start(self):
+        self._threads = [
+            threading.Thread(target=self._drain_a, daemon=True),
+            threading.Thread(target=self._drain_b, daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _drain_a(self):
+        self.hits += 1  # root thread:_drain_a, unguarded
+
+    def _drain_b(self):
+        self.hits += 1  # root thread:_drain_b, unguarded
+
+
+class LockedCounter:
+    """Same shape, every write under one lock: clean."""
+
+    def __init__(self):
+        self.hits = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self._drain_a, daemon=True).start()
+        threading.Thread(target=self._drain_b, daemon=True).start()
+
+    def _drain_a(self):
+        with self._lock:
+            self.hits += 1
+
+    def _drain_b(self):
+        with self._lock:
+            self.hits += 1
+
+
+class CallerGuardedCounter:
+    """The write sits in a helper with no lexical lock, but every direct
+    caller holds the lock — entry-guard inference must keep this clean."""
+
+    def __init__(self):
+        self.hits = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self._loop_a, daemon=True).start()
+        threading.Thread(target=self._loop_b, daemon=True).start()
+
+    def _loop_a(self):
+        with self._lock:
+            self._bump()
+
+    def _loop_b(self):
+        with self._lock:
+            self._bump()
+
+    def _bump(self):
+        self.hits += 1  # guarded at every entry: no JL017

@@ -1,0 +1,12 @@
+"""Cross-file JL014 waiver base: the eviction policy lives HERE, in the
+base class — a per-file scan of the subclass can't see it."""
+
+
+class BoundedTable:
+    def __init__(self, cap: int = 64):
+        self._table: dict = {}
+        self._cap = cap
+
+    def _evict_if_full(self):
+        while len(self._table) > self._cap:
+            self._table.pop(next(iter(self._table)))

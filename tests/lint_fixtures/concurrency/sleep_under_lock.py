@@ -1,0 +1,43 @@
+"""JL019 seed: blocking calls while holding a threading lock — lexically,
+and through a helper whose every caller holds the lock. The clean twins
+block only after releasing."""
+
+import queue
+import threading
+import time
+
+
+class SleepyWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q: queue.Queue = queue.Queue()
+        self.last = None
+
+    def poll_bad(self):
+        with self._lock:
+            time.sleep(0.5)  # JL019: every contender stalls half a second
+            self.last = "polled"
+
+    def drain_bad(self):
+        with self._lock:
+            self.last = self._q.get()  # JL019: queue wait under the lock
+
+    def drain_via_helper(self):
+        with self._lock:
+            self._take_one()
+
+    def _take_one(self):
+        # no lexical lock here, but the only caller holds it: JL019 via
+        # entry-guard inference
+        item = self._q.get()
+        self.last = item
+
+    def poll_ok(self):
+        time.sleep(0.5)  # not holding anything: clean
+        with self._lock:
+            self.last = "polled"
+
+    def drain_ok(self):
+        item = self._q.get()  # wait first, then lock: clean
+        with self._lock:
+            self.last = item

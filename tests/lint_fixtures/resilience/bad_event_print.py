@@ -18,7 +18,7 @@ def announce_restart(info):
 
 def sanctioned_sink(info):
     # ok: justified console sink (a cross-process drill scrapes this line)
-    print("ready: " + json.dumps(info))  # jaxlint: disable=JL015
+    print("ready: " + json.dumps(info))  # jaxlint: disable=JL015 startup banner predates journal
 
 
 def journaled(journal, decision):

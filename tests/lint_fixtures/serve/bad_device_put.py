@@ -11,5 +11,5 @@ def forward_batch(padded, batch_sharding):
     b = jax.device_put(padded, sharding=batch_sharding)
     c = jax.device_put(padded, device=jax.devices()[0])
     # ok: a justified default placement
-    d = jax.device_put(padded)  # jaxlint: disable=JL010
+    d = jax.device_put(padded)  # jaxlint: disable=JL010 placement asserted by caller
     return x, y, a, b, c, d

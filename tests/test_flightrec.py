@@ -44,6 +44,32 @@ class TestJournal:
         assert [r["event"] for r in j.tail(10)] == [
             "preempt_detected", "grace_save_committed"]
 
+    def test_concurrent_emit_seq_matches_ring_order(self):
+        # regression (JL017): seq was minted outside the journal lock, so
+        # two threads could append to the ring in the opposite order of
+        # their seq values; readers treat seq as the total order
+        import threading
+
+        n_threads, per_thread = 8, 200
+        j = EventJournal(ring=n_threads * per_thread)
+        start = threading.Barrier(n_threads)
+
+        def hammer(tid):
+            start.wait()
+            for i in range(per_thread):
+                j.emit("hammer", tid=tid, i=i)
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tail = j.tail(n_threads * per_thread)
+        seqs = [r["seq"] for r in tail]
+        assert seqs == sorted(seqs), "ring order must equal seq order"
+        assert len(set(seqs)) == len(seqs) == n_threads * per_thread
+
     def test_correlation_ids_unique_and_ambient(self):
         assert new_correlation_id() != new_correlation_id()
         j = EventJournal()

@@ -425,6 +425,25 @@ class TestRuleFixtures:
         # same-line and next-line `# jaxlint: disable=` forms: no findings
         assert findings_for("clean.py") == []
 
+    def test_jl002_alias_of_static_metadata_not_tainted(self, tmp_path):
+        # regression: `dtype = x.dtype` then branching on `dtype` used to
+        # taint the alias and flag a perfectly static branch
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    dtype = x.dtype\n"
+            "    if dtype == 'int8':\n"
+            "        x = x + 1\n"
+            "    y = x * 2\n"
+            "    if y > 0:\n"          # line 8: genuinely traced branch
+            "        x = x - 1\n"
+            "    return x\n"
+        )
+        p = tmp_path / "alias.py"
+        p.write_text(src)
+        assert rules_and_lines(lint_file(p)) == {("JL002", 8)}
+
 
 class TestTreeInvariants:
     def test_canonical_axes_match_mesh_module(self):

@@ -142,6 +142,19 @@ class TestTokenBucket:
         bucket.try_take(1000.0)
         assert bucket.tokens == pytest.approx(2.0)
 
+    def test_peek_reports_without_mutating(self):
+        # regression (JL017): metrics-scrape readers used to call _refill,
+        # racing the admission path's read-modify-write of `tokens`
+        bucket = TokenBucket(rate=10.0, burst=5.0, now=0.0)
+        bucket.try_take(0.0)
+        before = (bucket.tokens, bucket.t_last)
+        assert bucket.peek(0.5) == pytest.approx(
+            min(5.0, before[0] + 0.5 * 10.0))
+        assert (bucket.tokens, bucket.t_last) == before
+        # a stale clock reading never rolls the bucket backwards either
+        assert bucket.peek(-1.0) == pytest.approx(before[0])
+        assert (bucket.tokens, bucket.t_last) == before
+
 
 class TestScheduler:
     def _scheduler(self, t0=0.0):
@@ -188,6 +201,20 @@ class TestScheduler:
         for i in range(100):
             assert sched.resolve(f"invented-{i}") is default
         assert len(sched._states) == before
+
+    def test_snapshot_and_gauges_leave_buckets_untouched(self):
+        # regression (JL017): snapshot/scrape are observers; only admit()
+        # may advance a bucket's (tokens, t_last) state
+        sched, clock = self._scheduler()
+        reg = _registry({"tenants": {"slow": {"rate": 2, "burst": 1}}})
+        sched = QosScheduler(reg, clock=lambda: clock["now"])
+        state = sched.resolve("slow")
+        sched.admit(state)
+        frozen = (state.bucket.tokens, state.bucket.t_last)
+        clock["now"] += 0.25
+        snap = sched.snapshot()
+        assert (state.bucket.tokens, state.bucket.t_last) == frozen
+        assert snap["tenants"]["slow"]["tokens"] == pytest.approx(0.5)
 
     def test_metrics_precreated_and_snapshot_shape(self):
         sched, _ = self._scheduler()

@@ -424,6 +424,32 @@ class TestHttpEndToEnd:
         assert server.metrics.count("batches_total") \
             < server.metrics.count("responses_total")
 
+    def test_revive_runs_on_the_engine_loop_thread(self, clip_server):
+        # regression (JL017): admin revive used to mutate replica
+        # bookkeeping (pool/restarts/dead/incident_cid) directly from the
+        # HTTP handler thread while the watchdog mutates it from loop
+        # coroutines; the server must hop onto the loop first
+        import threading
+
+        server, _, _ = clip_server
+        engine = server.engine
+        seen = {}
+
+        def recording_revive(index):
+            seen["thread"] = threading.current_thread().name
+            seen["index"] = index
+            return {"dead": False, "revived": 1}
+
+        orig = engine.revive
+        engine.revive = recording_revive
+        try:
+            out = server.revive({"replica": 0})
+        finally:
+            engine.revive = orig
+        assert seen == {"thread": "jimm-serve-loop", "index": 0}
+        assert out["revived"] == 0
+        assert out["replica_stats"]["dead"] is False
+
     def test_bad_requests_get_typed_errors(self, clip_server, client):
         with pytest.raises(ServeClientError) as ei:
             client.embed(np.zeros((8, 8, 3), np.float32))  # wrong shape
