@@ -2256,6 +2256,10 @@ def build_parser() -> argparse.ArgumentParser:
     from jimm_tpu.serve.qos.cli import add_qos_parser
     add_qos_parser(sub)
 
+    # jimm-tpu cascade {calibrate,ls} — cascade calibration tooling (no jax)
+    from jimm_tpu.serve.cascade.cli import add_cascade_parser
+    add_cascade_parser(sub)
+
     return p
 
 

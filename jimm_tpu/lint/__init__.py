@@ -1,7 +1,7 @@
 """``jimm_tpu.lint`` — TPU-correctness static analyzer.
 
-Layer 1 (always on) is pure-``ast`` rules JL001–JL016 over the source
-tree, plus the JL020 suppression-hygiene meta-rule. ``--concurrency``
+Layer 1 (always on) is pure-``ast`` rules JL001–JL016 and JL021 over
+the source tree, plus the JL020 suppression-hygiene meta-rule. ``--concurrency``
 builds a project-wide symbol table and call graph (``lint.graph``) and
 runs the lock-discipline race detector (JL017–JL019) and
 interprocedural escalations of JL006/JL008/JL013. ``--jaxpr`` is layer

@@ -102,6 +102,16 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   -> cast travel together; expression-derived dtypes
   (``x.astype(k.dtype)``) stay legal, and deliberate unscaled casts
   carry a ``# jaxlint: disable=JL016`` justification. Tests are exempt.
+- **JL021** numeric confidence-threshold literal in ``serve/cascade/``
+  code outside the calibration module — a threshold hardcoded into a
+  router or autoscaler (``threshold = 0.92``, ``confidence=0.9``,
+  ``conf >= 0.95``) silently overrides whatever was *fit* on a holdout
+  set for the contracted disagreement rate, and drifts the moment the
+  model, dtype twin, or traffic changes. Thresholds are data: fit them
+  with ``jimm-tpu cascade calibrate`` and load the content-addressed
+  artifact (``load_calibration``); only ``calibrate.py`` (where fitting
+  lives) and tests may spell threshold numbers. Deliberate literals
+  carry a ``# jaxlint: disable=JL021`` justification.
 """
 
 from __future__ import annotations
@@ -1272,6 +1282,107 @@ def check_bare_lowp_cast(tree: ast.AST, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL021 — hardcoded confidence-threshold literal in cascade routing code
+# ---------------------------------------------------------------------------
+
+#: name substrings that mark a binding/comparison as a confidence threshold
+_THRESHOLD_NAME_MARKS = ("threshold", "confidence")
+
+#: the one cascade module where threshold numbers legitimately live:
+#: the fitter/loader itself
+_CALIBRATION_BASENAMES = frozenset({"calibrate.py"})
+
+
+def _path_is_cascade(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "cascade" in parts[:-1] and "serve" in parts
+
+
+def _is_threshold_name(node: ast.AST) -> bool:
+    """True when ``node`` names something threshold-like (``threshold``,
+    ``self.confidence``, ``escalation_threshold`` ...)."""
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    else:
+        return False
+    name = name.lower()
+    return any(mark in name for mark in _THRESHOLD_NAME_MARKS)
+
+
+def _numeric_literal(node: ast.AST) -> bool:
+    """A bare int/float constant, possibly under a unary +/- (``0.92``,
+    ``-1.5``). Deliberately NOT any-literal-in-subtree: ``round(conf, 6)``
+    carries a 6 but decides nothing."""
+    if isinstance(node, ast.UnaryOp) \
+            and isinstance(node.op, (ast.UAdd, ast.USub)):
+        node = node.operand
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, (int, float))
+            and not isinstance(node.value, bool))
+
+
+def check_cascade_thresholds(tree: ast.AST, path: str) -> list[Finding]:
+    """JL021: in ``serve/cascade/`` (outside ``calibrate.py`` and tests),
+    no numeric literal may bind to or compare against a threshold-named
+    value — routers load calibration artifacts, they never ship
+    thresholds."""
+    if not _path_is_cascade(path) or _path_is_test(path):
+        return []
+    if path.replace("\\", "/").rsplit("/", 1)[-1] in _CALIBRATION_BASENAMES:
+        return []
+
+    def finding(node: ast.AST, how: str) -> Finding:
+        return Finding(
+            "JL021", ERROR, path, node.lineno,
+            f"hardcoded confidence-threshold literal ({how}) in cascade "
+            "routing code — thresholds are fit on a holdout set "
+            "(jimm-tpu cascade calibrate) and loaded from the "
+            "content-addressed store (load_calibration), never spelled "
+            "in code; justify deliberate literals with "
+            "# jaxlint: disable=JL021")
+
+    findings = []
+    for node in ast.walk(tree):
+        # threshold = 0.92 / self.confidence_floor: float = 0.9
+        if isinstance(node, ast.Assign) and _numeric_literal(node.value):
+            if any(_is_threshold_name(t) for t in node.targets):
+                findings.append(finding(node, "assignment"))
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and _numeric_literal(node.value) \
+                and _is_threshold_name(node.target):
+            findings.append(finding(node, "assignment"))
+        # fn(threshold=0.92)
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg is not None and any(
+                        mark in kw.arg.lower()
+                        for mark in _THRESHOLD_NAME_MARKS) \
+                        and _numeric_literal(kw.value):
+                    findings.append(finding(kw.value, f"{kw.arg}= keyword"))
+        # def route(..., threshold=0.92)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            all_args = args.posonlyargs + args.args + args.kwonlyargs
+            all_defaults = args.defaults + args.kw_defaults
+            for arg, default in zip(all_args[-len(all_defaults):]
+                                    if all_defaults else [], all_defaults):
+                if default is not None and _numeric_literal(default) \
+                        and any(mark in arg.arg.lower()
+                                for mark in _THRESHOLD_NAME_MARKS):
+                    findings.append(finding(default,
+                                            f"{arg.arg}= default"))
+        # conf >= 0.95  /  0.95 < confidence
+        elif isinstance(node, ast.Compare):
+            operands = [node.left] + list(node.comparators)
+            if any(_is_threshold_name(op) for op in operands) and any(
+                    _numeric_literal(op) for op in operands):
+                findings.append(finding(node, "comparison"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -1293,4 +1404,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_unbounded_tenant_table(tree, path)
     findings += check_journal_bypass(tree, path)
     findings += check_bare_lowp_cast(tree, path)
+    findings += check_cascade_thresholds(tree, path)
     return findings

@@ -133,6 +133,8 @@ class SloEngine:
         self._lock = threading.Lock()
         self._trackers = {name: _Tracker(self.slow_window_s)
                           for name in objectives}
+        self._listeners: list = []
+        self._burning: set[str] = set()
         if registry is None:
             registry = MetricRegistry("jimm_slo")
             publish(registry)
@@ -175,7 +177,37 @@ class SloEngine:
         with self._lock:
             self._trackers[name].observe(good, now)
         self._counters[name][0 if good else 1].inc()
+        if self._listeners:
+            self._notify_transitions()
         return good
+
+    # -- burn-rate consumers ------------------------------------------------
+
+    def add_listener(self, fn) -> None:
+        """Register a fast-burn *transition* consumer:
+        ``fn(tenant, entered, fast_rate, slow_rate)`` fires once when a
+        tenant enters fast burn (``entered=True``) and once when it exits
+        (``entered=False``). Transitions are evaluated on observations —
+        an idle tenant's exit is reported with its next request, which is
+        exactly when a consumer could act on it anyway. This is the hook
+        the cascade autoscaler hangs capacity decisions on."""
+        self._listeners.append(fn)
+
+    def _notify_transitions(self) -> None:
+        burning = set(self.fast_burning())
+        entered = burning - self._burning
+        exited = self._burning - burning
+        if not entered and not exited:
+            return
+        self._burning = burning
+        for name in sorted(entered | exited):
+            fast = self.burn_rate(name, self.fast_window_s)
+            slow = self.burn_rate(name, self.slow_window_s)
+            for fn in list(self._listeners):
+                try:
+                    fn(name, name in entered, fast, slow)
+                except Exception:  # noqa: BLE001 — a consumer bug must not fail request accounting; surfaced as a counted error
+                    self.registry.counter("listener_errors_total").inc()
 
     # -- read --------------------------------------------------------------
 

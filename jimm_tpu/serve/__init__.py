@@ -19,9 +19,16 @@ from jimm_tpu.serve.buckets import (DEFAULT_BATCH_BUCKETS, SERVE_DTYPES,
                                     default_buckets, pad_batch)
 from jimm_tpu.serve.cache import (EmbeddingCache, class_embedding_cache,
                                   prompt_set_key)
-from jimm_tpu.serve.client import (ServeClient, ServeClientError,
-                                   ShedClientError, ThrottledClientError,
-                                   encode_image_payload)
+from jimm_tpu.serve.cascade import (CascadeAutoscaler, CascadeCalibration,
+                                    CascadeResult, CascadeRouter,
+                                    CascadeStage, ScaleTarget,
+                                    fit_calibration, fit_from_logits,
+                                    load_calibration, save_calibration)
+from jimm_tpu.serve.client import (CascadeInfo, EmbedResult, ServeClient,
+                                   ServeClientError, ShedClientError,
+                                   ThrottledClientError,
+                                   encode_image_payload,
+                                   parse_cascade_headers)
 from jimm_tpu.serve.engine import InferenceEngine, counting_forward
 from jimm_tpu.serve.qos import (ModelPool, QosPolicyError, QosScheduler,
                                 TenantRegistry, TenantSpec,
@@ -33,16 +40,22 @@ from jimm_tpu.serve.topology import (ReplicaForward, TopologyPlan,
 
 __all__ = [
     "AdmissionController", "AdmissionPolicy", "BucketTable",
-    "DEFAULT_BATCH_BUCKETS", "DeadlineExceededError", "EmbeddingCache",
+    "CascadeAutoscaler", "CascadeCalibration", "CascadeInfo",
+    "CascadeResult", "CascadeRouter", "CascadeStage",
+    "DEFAULT_BATCH_BUCKETS", "DeadlineExceededError", "EmbedResult",
+    "EmbeddingCache",
     "EngineClosedError", "InferenceEngine", "ModelPool", "QosPolicyError",
     "QosScheduler", "QueueFullError", "ReplicaForward",
-    "RequestError", "ServeClient", "ServeClientError", "ServeError",
+    "RequestError", "ScaleTarget", "ServeClient", "ServeClientError",
+    "ServeError",
     "SERVE_DTYPES", "ServeMetrics", "ServingServer", "ShedClientError",
     "ShedError", "TPU_BATCH_BUCKETS", "TenantRegistry", "TenantSpec",
     "ThrottledClientError", "ThrottledError", "TopologyPlan",
     "WeightedFairQueue",
     "ZeroShotService", "build_replica_forwards", "class_embedding_cache",
     "counting_forward", "decode_image_payload", "default_buckets",
-    "encode_image_payload", "load_policy", "pad_batch", "plan_topology",
-    "prompt_set_key",
+    "encode_image_payload", "fit_calibration", "fit_from_logits",
+    "load_calibration", "load_policy", "pad_batch",
+    "parse_cascade_headers", "plan_topology",
+    "prompt_set_key", "save_calibration",
 ]

@@ -1,0 +1,45 @@
+"""Confidence-cascade serving: route cheap, escalate on doubt.
+
+Three pieces over the existing serving substrate:
+
+- :mod:`~jimm_tpu.serve.cascade.calibrate` — fit the confidence threshold
+  on a holdout set for a target top-1 disagreement rate and persist the
+  result as a content-addressed artifact on the AOT store (routers never
+  ship hardcoded thresholds; lint rule JL021 enforces it).
+- :mod:`~jimm_tpu.serve.cascade.router` — requests hit the cheapest
+  resident pool model first and escalate to wider dtypes when the
+  calibrated confidence signal (temperature-scaled logit margin,
+  optionally cross-checked by embedding-neighbor agreement) says the
+  cheap answer is not trustworthy.
+- :mod:`~jimm_tpu.serve.cascade.autoscale` — a bounded, hysteretic
+  control loop converting SLO burn rates and per-class queue depth into
+  residency actions: shift replicas between pool models via
+  ``engine.replan``, hot-swap dtypes via ``ModelPool.swap``.
+
+See docs/cascade.md for the calibration workflow and the measured
+disagreement/cost table.
+"""
+
+from jimm_tpu.serve.cascade.autoscale import CascadeAutoscaler, ScaleTarget
+from jimm_tpu.serve.cascade.calibrate import (CascadeCalibration,
+                                              fit_calibration,
+                                              fit_from_logits,
+                                              list_calibrations,
+                                              load_calibration,
+                                              save_calibration)
+from jimm_tpu.serve.cascade.router import (CascadeResult, CascadeRouter,
+                                           CascadeStage)
+
+__all__ = [
+    "CascadeAutoscaler",
+    "CascadeCalibration",
+    "CascadeResult",
+    "CascadeRouter",
+    "CascadeStage",
+    "ScaleTarget",
+    "fit_calibration",
+    "fit_from_logits",
+    "list_calibrations",
+    "load_calibration",
+    "save_calibration",
+]

@@ -10,12 +10,70 @@ wire as base64 raw float32 when the input quacks like a numpy array
 from __future__ import annotations
 
 import base64
+import dataclasses
 import http.client
 import json
 import threading
 import time
 
 from jimm_tpu.resilience.backoff import BackoffPolicy  # stdlib-only module
+
+#: cascade response headers (mirrors serve.cascade.router — spelled out
+#: here because this module must stay stdlib-only importable)
+CASCADE_HEADER_MODELS = "X-Jimm-Cascade-Models"
+CASCADE_HEADER_MODEL = "X-Jimm-Cascade-Model"
+CASCADE_HEADER_CONFIDENCE = "X-Jimm-Cascade-Confidence"
+
+
+@dataclasses.dataclass(frozen=True)
+class CascadeInfo:
+    """Escalation metadata a cascade-routed response carried: which models
+    the request tried (cheapest first), which one answered, and the
+    calibrated confidence the final decision rode on (None when the
+    terminal stage accepted by fiat). This is what serve_bench bills
+    cost/request from — no server log scraping."""
+
+    models_tried: tuple[str, ...]
+    model: str
+    confidence: float | None
+
+    @property
+    def escalations(self) -> int:
+        return len(self.models_tried) - 1
+
+
+def parse_cascade_headers(headers) -> CascadeInfo | None:
+    """Parse the ``X-Jimm-Cascade-*`` response headers (a mapping or a
+    ``(name, value)`` iterable, matched case-insensitively) into a
+    :class:`CascadeInfo`; None when the response was not cascade-routed."""
+    items = headers.items() if hasattr(headers, "items") else headers
+    lower = {str(k).lower(): v for k, v in items}
+    model = lower.get(CASCADE_HEADER_MODEL.lower())
+    if model is None:
+        return None
+    raw = lower.get(CASCADE_HEADER_MODELS.lower()) or ""
+    models = tuple(m for m in raw.split(",") if m) or (model,)
+    confidence = None
+    conf_raw = lower.get(CASCADE_HEADER_CONFIDENCE.lower())
+    if conf_raw is not None:
+        try:
+            confidence = float(conf_raw)
+        except ValueError:
+            confidence = None
+    return CascadeInfo(models_tried=models, model=str(model),
+                       confidence=confidence)
+
+
+class EmbedResult(list):
+    """``embed()``'s return value: still the plain features list every
+    existing caller indexes into, plus the response's routing metadata
+    (:attr:`cascade` is None on non-cascade servers) and trace id."""
+
+    def __init__(self, features, *, cascade: CascadeInfo | None = None,
+                 trace_id: str | None = None):
+        super().__init__(features)
+        self.cascade = cascade
+        self.trace_id = trace_id
 
 
 class ServeClientError(Exception):
@@ -131,7 +189,8 @@ class ServeClient:
         self._drop_connection()
 
     def _request(self, method: str, path: str, payload: dict | None = None,
-                 *, deadline_s: float | None = None):
+                 *, deadline_s: float | None = None,
+                 with_headers: bool = False):
         body = None if payload is None else json.dumps(payload).encode()
         headers = {"Content-Type": "application/json"} if body else {}
         if self.tenant is not None:
@@ -183,6 +242,8 @@ class ServeClient:
                 return raw.decode(errors="replace")
             obj = json.loads(raw)
             if resp.status < 400:
+                if with_headers:
+                    return obj, dict(resp.getheaders())
                 return obj
             try:
                 retry_after = float(resp.getheader("Retry-After"))
@@ -213,12 +274,19 @@ class ServeClient:
     def metrics_text(self) -> str:
         return self._request("GET", "/metrics")
 
-    def embed(self, image, timeout_s: float | None = None) -> list:
+    def embed(self, image, timeout_s: float | None = None) -> EmbedResult:
+        """One image in, its features out — as an :class:`EmbedResult`
+        (a plain list, plus ``.cascade`` escalation metadata when the
+        server routed through a confidence cascade)."""
         payload = encode_image_payload(image)
         if timeout_s is not None:
             payload["timeout_s"] = timeout_s
-        return self._request("POST", "/v1/embed", payload,
-                             deadline_s=timeout_s)["features"]
+        obj, headers = self._request("POST", "/v1/embed", payload,
+                                     deadline_s=timeout_s,
+                                     with_headers=True)
+        return EmbedResult(obj["features"],
+                           cascade=parse_cascade_headers(headers),
+                           trace_id=obj.get("trace_id"))
 
     def embed_many(self, images, timeout_s: float | None = None) -> list:
         """Bulk embed: one request, one ``features`` row per image. The

@@ -638,7 +638,8 @@ class InferenceEngine:
     async def submit(self, item: np.ndarray,
                      timeout_s: float | None = None,
                      trace_id: str | None = None,
-                     tenant: str | None = None) -> np.ndarray:
+                     tenant: str | None = None,
+                     escalated: bool = False) -> np.ndarray:
         """One request in, one output row out. Raises
         :class:`QueueFullError` (backpressure), :class:`RequestError`
         (shape mismatch), or :class:`DeadlineExceededError` (deadline hit
@@ -654,16 +655,24 @@ class InferenceEngine:
         (:class:`~jimm_tpu.serve.admission.ShedError`, 503) to admit a
         higher-class arrival. Without a scheduler ``tenant`` is ignored
         and this path is byte-identical to the original engine.
+
+        ``escalated=True`` marks a cascade re-submit: the client already
+        paid the request counter and the tenant's token bucket at the
+        cheap stage, so the escalation must not double-bill either — it
+        still honors the queue bound (capacity is physical) but skips the
+        rate-limit charge and counts under ``escalated_submits_total``.
         """
         if not self._accepting or self._queue is None:
             raise EngineClosedError("engine is not running; call start()")
         item = self._coerce(item)
-        self.metrics.inc("requests_total")
+        self.metrics.inc("escalated_submits_total" if escalated
+                         else "requests_total")
         tenant_state = klass = None
         if self.qos is not None:
             tenant_state = self.qos.resolve(tenant)
             klass = tenant_state.spec.klass
-            self.qos.admit(tenant_state)
+            if not escalated:
+                self.qos.admit(tenant_state)
             timeout_s = self.qos.timeout_for(tenant_state, timeout_s)
             if self._queue.qsize() >= self.admission.policy.max_queue:
                 self._shed_for(klass)

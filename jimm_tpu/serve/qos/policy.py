@@ -196,6 +196,99 @@ def _parse_slo(raw, tenants: dict[str, TenantSpec],
     return slo
 
 
+def _parse_cascade(raw, problems: list[str]) -> dict:
+    """Validate the optional ``cascade`` section: the stage ladder
+    (``order``, cheapest model first) and the store fingerprints of each
+    non-terminal stage's calibration — the policy file carries artifact
+    *references*, never threshold values (JL021)."""
+    if raw is None:
+        return {}
+    if not isinstance(raw, dict):
+        problems.append("'cascade' must be a mapping")
+        return {}
+    unknown = set(raw) - {"order", "calibrations", "agreement_floor"}
+    if unknown:
+        problems.append(f"cascade: unknown keys {sorted(unknown)}")
+        return {}
+    order = raw.get("order")
+    if (not isinstance(order, list) or len(order) < 2
+            or not all(isinstance(n, str) and n for n in order)
+            or len(set(order)) != len(order)):
+        problems.append("cascade: 'order' must list >= 2 distinct model "
+                        f"names cheapest-first, got {order!r}")
+        return {}
+    calibrations = raw.get("calibrations")
+    if calibrations is None or not isinstance(calibrations, dict) or not all(
+            isinstance(k, str) and isinstance(v, str) and v
+            for k, v in calibrations.items()):
+        problems.append("cascade: 'calibrations' must map stage name -> "
+                        "store fingerprint")
+        return {}
+    missing = [n for n in order[:-1] if n not in calibrations]
+    if missing:
+        problems.append(f"cascade: stages {missing} have no calibration "
+                        "fingerprint (only the terminal stage may accept "
+                        "unconditionally)")
+    stray = sorted(set(calibrations) - set(order[:-1]))
+    if stray:
+        problems.append(f"cascade: calibrations for {stray} name no "
+                        "non-terminal stage in 'order'")
+    out = {"order": [str(n) for n in order],
+           "calibrations": {str(k): str(v)
+                            for k, v in calibrations.items()}}
+    floor = raw.get("agreement_floor")
+    if floor is not None:
+        if not isinstance(floor, (int, float)) or not 0.0 < floor <= 1.0:
+            problems.append("cascade: agreement_floor must be in (0, 1], "
+                            f"got {floor!r}")
+        else:
+            out["agreement_floor"] = float(floor)
+    return out
+
+
+def _parse_autoscale(raw, classes: dict, problems: list[str]) -> dict:
+    """Validate the optional ``autoscale`` section (the
+    :class:`~jimm_tpu.serve.cascade.autoscale.CascadeAutoscaler` knobs:
+    trip points + hysteresis)."""
+    if raw is None:
+        return {}
+    if not isinstance(raw, dict):
+        problems.append("'autoscale' must be a mapping")
+        return {}
+    unknown = set(raw) - {"watch_class", "burn_high", "queue_high",
+                          "window", "cooldown"}
+    if unknown:
+        problems.append(f"autoscale: unknown keys {sorted(unknown)}")
+        return {}
+    out: dict = {}
+    watch = raw.get("watch_class")
+    if watch is not None:
+        if not isinstance(watch, str) or (classes and watch not in classes):
+            problems.append(f"autoscale: watch_class {watch!r} is not a "
+                            f"declared class ({sorted(classes)})")
+        else:
+            out["watch_class"] = watch
+    for key in ("burn_high", "queue_high"):
+        value = raw.get(key)
+        if value is None:
+            continue
+        if not isinstance(value, (int, float)) or value <= 0:
+            problems.append(f"autoscale: {key} must be > 0, got {value!r}")
+        else:
+            out[key] = float(value)
+    for key, floor in (("window", 1), ("cooldown", 0)):
+        value = raw.get(key)
+        if value is None:
+            continue
+        if not isinstance(value, int) or isinstance(value, bool) \
+                or value < floor:
+            problems.append(f"autoscale: {key} must be an int >= {floor}, "
+                            f"got {value!r}")
+        else:
+            out[key] = value
+    return out
+
+
 class TenantRegistry:
     """The parsed policy: priority classes, named tenants, and the shared
     default tenant that anonymous/unknown traffic maps to."""
@@ -204,13 +297,20 @@ class TenantRegistry:
 
     def __init__(self, classes: dict[str, ClassSpec],
                  tenants: dict[str, TenantSpec], default: TenantSpec,
-                 slo: dict[str, dict] | None = None):
+                 slo: dict[str, dict] | None = None,
+                 cascade: dict | None = None,
+                 autoscale: dict | None = None):
         self.classes = classes
         self.tenants = tenants
         self.default = default
         #: per-tenant SLO objective dicts from the policy's ``slo`` section
         #: (empty when the policy declares none)
         self.slo = dict(slo or {})
+        #: cascade stage ladder + calibration fingerprints (``cascade``
+        #: section; None when the policy declares none)
+        self.cascade = dict(cascade) if cascade else None
+        #: autoscaler trip points + hysteresis (``autoscale`` section)
+        self.autoscale = dict(autoscale) if autoscale else None
         #: class names in priority order (rank 0 first) — the weighted-fair
         #: queue's drain order and the INVERSE of the shed order
         self.class_order = tuple(sorted(classes, key=lambda n:
@@ -223,7 +323,8 @@ class TenantRegistry:
         if not isinstance(data, dict):
             raise QosPolicyError("policy must be a mapping")
         problems: list[str] = []
-        unknown = set(data) - {"classes", "tenants", "default", "slo"}
+        unknown = set(data) - {"classes", "tenants", "default", "slo",
+                               "cascade", "autoscale"}
         if unknown:
             problems.append(f"unknown top-level keys {sorted(unknown)}")
         classes = _parse_classes(data.get("classes"), problems)
@@ -239,9 +340,12 @@ class TenantRegistry:
         default = _parse_tenant(cls.DEFAULT_TENANT, data.get("default") or {},
                                 classes, problems)
         slo = _parse_slo(data.get("slo"), tenants, problems)
+        cascade = _parse_cascade(data.get("cascade"), problems)
+        autoscale = _parse_autoscale(data.get("autoscale"), classes,
+                                     problems)
         if problems:
             raise QosPolicyError("; ".join(problems))
-        return cls(classes, tenants, default, slo)
+        return cls(classes, tenants, default, slo, cascade, autoscale)
 
     @classmethod
     def load(cls, path: str) -> "TenantRegistry":
@@ -292,6 +396,10 @@ class TenantRegistry:
         if self.slo:
             out["slo"] = {name: dict(obj)
                           for name, obj in sorted(self.slo.items())}
+        if self.cascade:
+            out["cascade"] = dict(self.cascade)
+        if self.autoscale:
+            out["autoscale"] = dict(self.autoscale)
         return out
 
 

@@ -23,7 +23,29 @@ import threading
 
 from jimm_tpu.serve.admission import RequestError
 
-__all__ = ["ModelPool"]
+__all__ = ["ModelPool", "param_nbytes"]
+
+
+def param_nbytes(tree) -> int:
+    """Total parameter bytes of a (possibly nested) param container —
+    dict/list/tuple of arrays, an ``nnx.State`` (any ``.items()``
+    mapping, with ``VariableState.value`` leaves), or a flax module with
+    ``.params``. Duck-typed on ``size``/``dtype.itemsize`` so numpy and
+    jax arrays both count without this module importing jax."""
+    params = getattr(tree, "params", tree)
+    if isinstance(params, (list, tuple)):
+        return sum(param_nbytes(v) for v in params)
+    size = getattr(params, "size", None)
+    itemsize = getattr(getattr(params, "dtype", None), "itemsize", None)
+    if size is not None and itemsize is not None:
+        return int(size) * int(itemsize)
+    items = getattr(params, "items", None)  # dict / nnx.State / FrozenDict
+    if callable(items):
+        return sum(param_nbytes(v) for _, v in items())
+    value = getattr(params, "value", None)  # nnx VariableState leaf
+    if value is not None:
+        return param_nbytes(value)
+    return 0
 
 
 class ModelPool:
@@ -44,11 +66,33 @@ class ModelPool:
         self._lock = threading.Lock()
         self._engines = dict(engines)
         self.default_name = default
+        self._resident_bytes: dict[str, int] = {}
         metrics = engines[default].metrics
-        for name in engines:
+        for name, engine in engines.items():
             metrics.inc(f"model_{name}_requests_total", 0)
+            self._track_bytes(name, engine)
+        metrics.bind_gauge(
+            "pool_resident_bytes",
+            lambda: float(sum(self._resident_bytes.values())))
+
+    def _track_bytes(self, name: str, engine) -> None:
+        """Record a model's resident parameter bytes (from the engine's
+        ``resident_param_bytes`` attribute, stamped at build time or via
+        :meth:`set_resident_bytes`) and expose the
+        ``pool_resident_bytes_{model}`` gauge. The gauge closure reads the
+        dict, so swap/remove update the scrape without rebinding."""
+        self._resident_bytes[name] = int(
+            getattr(engine, "resident_param_bytes", 0) or 0)
+        self.metrics.bind_gauge(
+            f"pool_resident_bytes_{name}",
+            lambda n=name: float(self._resident_bytes.get(n, 0)))
 
     # -- routing ----------------------------------------------------------
+
+    @property
+    def metrics(self):
+        """The pool's shared metrics surface (the default engine's)."""
+        return self._engines[self.default_name].metrics
 
     @property
     def default(self):
@@ -89,6 +133,7 @@ class ModelPool:
                                  "use swap()")
             self._engines[name] = engine
         engine.metrics.inc(f"model_{name}_requests_total", 0)
+        self._track_bytes(name, engine)
 
     def swap(self, name: str, engine):
         """Weight hot-swap: atomically route ``name`` to ``engine`` and
@@ -99,6 +144,7 @@ class ModelPool:
                 raise ValueError(f"model {name!r} not resident; use add()")
             old = self._engines[name]
             self._engines[name] = engine
+        self._track_bytes(name, engine)
         return old
 
     def remove(self, name: str):
@@ -109,7 +155,22 @@ class ModelPool:
                 raise ValueError("cannot remove the default model")
             if name not in self._engines:
                 raise ValueError(f"model {name!r} not resident")
+            self._resident_bytes.pop(name, None)
             return self._engines.pop(name)
+
+    def set_resident_bytes(self, name: str, nbytes: int) -> None:
+        """Operator override for a model's resident parameter bytes (for
+        engines built before byte stamping, or quantized twins whose
+        packed layout the builder can't see)."""
+        with self._lock:
+            if name not in self._engines:
+                raise ValueError(f"model {name!r} not resident")
+            self._resident_bytes[name] = int(nbytes)
+
+    def resident_bytes(self) -> dict[str, int]:
+        """Per-model resident parameter bytes (autoscaler residency input)."""
+        with self._lock:
+            return dict(self._resident_bytes)
 
     # -- surfaces ---------------------------------------------------------
 
@@ -125,6 +186,7 @@ class ModelPool:
                    # serving precision rides the bucket table, not the
                    # engine (whose dtype is batch assembly, always f32)
                    "dtype": engine.buckets.dtype,
+                   "resident_param_bytes": self._resident_bytes.get(name, 0),
                    "requests": engine.metrics.count(
                        f"model_{name}_requests_total")}
             report = getattr(engine, "warmup_report", None)

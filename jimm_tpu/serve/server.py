@@ -197,7 +197,11 @@ class _Handler(BaseHTTPRequestHandler):
             if model is not None:
                 payload.setdefault("model", model)
             if self.path == "/v1/embed":
-                self._send_json(200, app.embed(payload))
+                out = app.embed(payload)
+                # cascade routing metadata travels as response headers so
+                # clients bill cost/request without a changed body shape
+                cascade_headers = out.pop("_cascade", None)
+                self._send_json(200, out, extra_headers=cascade_headers)
             elif self.path == "/v1/classify":
                 self._send_json(200, app.classify(payload))
             elif self.path == "/v1/search":
@@ -226,7 +230,7 @@ class ServingServer:
 
     def __init__(self, engine: InferenceEngine, *,
                  zero_shot: ZeroShotService | None = None,
-                 retrieval=None, pool=None,
+                 retrieval=None, pool=None, cascade=None, autoscaler=None,
                  host: str = "127.0.0.1", port: int = 0,
                  request_timeout_s: float = 30.0, warmup: bool = True,
                  metrics_logger=None, metrics_log_every_s: float = 10.0):
@@ -237,6 +241,17 @@ class ServingServer:
         self.pool = pool
         if pool is not None and engine is not pool.default:
             raise ValueError("engine must be the pool's default entry")
+        #: optional jimm_tpu.serve.cascade.CascadeRouter: single-image
+        #: embeds that name no explicit model route through it (cheapest
+        #: stage first, calibrated escalation) and carry the routing
+        #: metadata back as X-Jimm-Cascade-* response headers
+        self.cascade = cascade
+        if cascade is not None and pool is None:
+            raise ValueError("cascade routing requires a model pool")
+        #: optional jimm_tpu.serve.cascade.CascadeAutoscaler, surfaced in
+        #: healthz (the control loop itself is driven by the operator
+        #: harness, not the HTTP server)
+        self.autoscaler = autoscaler
         self.engine = engine
         self.zero_shot = zero_shot
         #: optional jimm_tpu.retrieval.RetrievalService backing /v1/search
@@ -378,6 +393,18 @@ class ServingServer:
             for i, image in enumerate(images)]
         return [f.result(timeout=self.request_timeout_s) for f in futures]
 
+    def _submit_cascade(self, image: np.ndarray, timeout_s: float | None,
+                        trace_id: str, tenant: str | None):
+        """Route one request through the cascade router on the serving
+        loop; returns the full :class:`CascadeResult` (output + routing
+        metadata for the response headers)."""
+        assert self._loop is not None and self.cascade is not None
+        future = asyncio.run_coroutine_threadsafe(
+            self.cascade.submit(image, timeout_s=timeout_s,
+                                trace_id=trace_id, tenant=tenant),
+            self._loop)
+        return future.result(timeout=self.request_timeout_s)
+
     def embed(self, payload: dict) -> dict:
         rid = new_trace_id()
         engine = self._engine_for(payload.get("model"))
@@ -397,6 +424,15 @@ class ServingServer:
                                  for f in features],
                     "count": len(features), "trace_id": rid}
         image = decode_image_payload(payload, dtype=engine.dtype)
+        if self.cascade is not None and payload.get("model") is None:
+            result = self._submit_cascade(image, payload.get("timeout_s"),
+                                          rid, tenant)
+            return {"features": np.asarray(result.output,
+                                           np.float32).tolist(),
+                    "trace_id": rid,
+                    # popped into response headers by the handler, never
+                    # serialized into the JSON body
+                    "_cascade": result.headers()}
         features = self._submit(image, payload.get("timeout_s"), rid,
                                 engine=engine, tenant=tenant)
         return {"features": np.asarray(features, np.float32).tolist(),
@@ -582,6 +618,12 @@ class ServingServer:
             out["qos"] = self.engine.qos.snapshot()
         if self.pool is not None:
             out["models"] = self.pool.describe()
+        # cascade/autoscale blocks follow the same conditional contract:
+        # absent unless the server was started with a router / control loop
+        if self.cascade is not None:
+            out["cascade"] = self.cascade.describe()
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.describe()
         # SLO block only when an SloEngine is attached (same conditional
         # contract as qos/models: the bare server's shape is unchanged).
         # Fast-burning tenants downgrade the probe like a fenced replica:

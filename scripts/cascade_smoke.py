@@ -1,0 +1,359 @@
+"""CI tier-1 smoke for confidence-cascade serving + SLO-driven autoscaling.
+
+End to end on 8 virtual CPU devices, one process, five properties:
+
+1. **Calibrated routing**: an f32 model (2 replicas x model-parallel 2)
+   and its int8 twin share one :class:`ModelPool`; a
+   :class:`CascadeCalibration` is *fit* on a holdout of the two models'
+   actual score rows, persisted content-addressed on the AOT store, and
+   loaded back by fingerprint — the router never sees a literal threshold
+   (lint JL021, the runtime side).
+2. **Cascade semantics**: routed traffic lands on the int8 stage unless
+   the calibrated margin says escalate; every request's whole path is
+   journaled on one correlation id (``cascade_request`` →
+   ``cascade_routed``), and escalations ride ``escalated=True`` so
+   admission never double-bills.
+3. **Traffic-mix flip → autoscale**: when bulk traffic flips onto the
+   expensive stage and saturates its queue, the
+   :class:`CascadeAutoscaler` (watching the batch class) shifts a
+   replica from the cheap target to the expensive one via
+   ``engine.replan`` — bounded, after a full window, journaled
+   (``autoscale_decision`` → ``autoscale_applied``) on the autoscaler's
+   root cid — and **interactive p99 through the flip stays <= 2x the
+   unloaded p99** (weighted-fair isolation + the shifted capacity).
+4. **Zero post-warmup compiles**, including through the replica shift:
+   the shifted replica sets come off the same warm AOT store.
+5. **Residency accounting**: the pool reports per-model resident
+   parameter bytes (the cascade's cost proxy) and the int8 twin is
+   strictly cheaper than f32.
+6. **Timeline visibility**: the whole drill's journal — routing,
+   escalations, the autoscale chain — exports to a structurally valid
+   Chrome trace (``jimm-tpu obs timeline``'s exporter).
+
+Prints one JSON result line; exits non-zero on any failed property.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+MODEL_PARALLEL = 2
+F32_REPLICAS = 1          # autoscaler shifts this to 2 under pressure
+Q8_REPLICAS = 2
+HOLDOUT = 96
+CLASSES = 16              # score-row width the calibration thresholds
+ROUTED = 64               # cascade requests driven before the flip
+FLIP_BURST = 48           # concurrent bulk f32 submits forming backlog
+QUEUE_HIGH = 4.0
+PROBES = 40               # interactive latency samples per phase
+PROBE_GAP_S = 0.002
+MAX_P99_RATIO = 2.0       # loaded interactive p99 vs unloaded
+
+POLICY = {
+    "tenants": {
+        "vip": {"class": "interactive"},
+        "bulk": {"class": "batch"},
+    },
+}
+
+
+def p99(samples: list[float]) -> float:
+    ordered = sorted(samples)
+    return ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+
+
+def fail(msg: str) -> int:
+    print(json.dumps({"metric": "cascade_smoke", "value": 0.0,
+                      "error": msg}), flush=True)
+    return 1
+
+
+def main() -> int:
+    # must land before any jax import anywhere in the process
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import asyncio
+
+    import jax
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import CLIP, preset
+    from jimm_tpu.aot import ArtifactStore
+    from jimm_tpu.cli import _tiny_override
+    from jimm_tpu.obs.journal import get_journal
+    from jimm_tpu.quant import quantize_model
+    from jimm_tpu.serve import (AdmissionPolicy, BucketTable,
+                                CascadeAutoscaler, CascadeRouter,
+                                InferenceEngine, ScaleTarget,
+                                build_replica_forwards, fit_from_logits,
+                                load_calibration, plan_topology,
+                                save_calibration)
+    from jimm_tpu.serve.qos import ModelPool, QosScheduler, load_policy
+    from jimm_tpu.serve.qos.pool import param_nbytes
+
+    need = max(Q8_REPLICAS, 2 * MODEL_PARALLEL)
+    if jax.device_count() < need:
+        return fail(f"need {need} devices, have {jax.device_count()} — was "
+                    f"XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+                    f"set before another jax import?")
+
+    cfg = _tiny_override(preset("clip-vit-base-patch16"))
+    size = cfg.vision.image_size
+    policy = AdmissionPolicy(max_queue=256, default_timeout_s=60.0)
+
+    with tempfile.TemporaryDirectory(prefix="jimm-cascade-smoke-") as root:
+        policy_path = os.path.join(root, "qos.json")
+        with open(policy_path, "w", encoding="utf-8") as fh:
+            json.dump(POLICY, fh)
+        registry = load_policy(policy_path)
+        sched = QosScheduler(registry)
+        store = ArtifactStore(os.path.join(root, "aot"))
+
+        # --- two resident twins over one warm store -----------------------
+        f32_model = CLIP(cfg, rngs=nnx.Rngs(0))
+        q8_model = CLIP(cfg, rngs=nnx.Rngs(0))
+        quantize_model(q8_model)
+
+        def f32_built(n):
+            return build_replica_forwards(
+                f32_model, plan_topology(n, MODEL_PARALLEL),
+                method="encode_image", item_shape=(size, size, 3),
+                store=store, label="cascade_smoke:f32")
+
+        def q8_built(n):
+            return build_replica_forwards(
+                q8_model, plan_topology(n, 1), method="encode_image",
+                item_shape=(size, size, 3), store=store,
+                label="cascade_smoke:q8")
+
+        f32_fwd, f32_traces = f32_built(F32_REPLICAS)
+        q8_fwd, q8_traces = q8_built(Q8_REPLICAS)
+        f32_eng = InferenceEngine(f32_fwd, item_shape=(size, size, 3),
+                                  buckets=BucketTable((1, 2, 4)),
+                                  max_delay_ms=5.0, policy=policy,
+                                  qos=sched, trace_count=f32_traces)
+        q8_eng = InferenceEngine(q8_fwd, item_shape=(size, size, 3),
+                                 buckets=BucketTable((1, 2, 4), dtype="int8"),
+                                 max_delay_ms=5.0, policy=policy,
+                                 metrics=f32_eng.metrics, qos=sched,
+                                 trace_count=q8_traces)
+        for eng in (f32_eng, q8_eng):
+            eng.warmup_blocking()
+
+        # --- property 5: resident-byte accounting (cascade cost proxy) ----
+        f32_eng.resident_param_bytes = param_nbytes(
+            nnx.state(f32_model, nnx.Param))
+        q8_eng.resident_param_bytes = param_nbytes(
+            nnx.state(q8_model, nnx.Param))
+        pool = ModelPool({"f32": f32_eng, "q8": q8_eng}, default="f32")
+        resident = pool.resident_bytes()
+        if not 0 < resident["q8"] < resident["f32"]:
+            return fail(f"resident bytes not int8 < f32: {resident}")
+        snap = pool.metrics.snapshot()
+        if snap.get("pool_resident_bytes") != float(sum(resident.values())):
+            return fail(f"pool_resident_bytes gauge disagrees with "
+                        f"accounting: {snap.get('pool_resident_bytes')} "
+                        f"vs {resident}")
+
+        # --- property 1: fit on holdout, persist, load by fingerprint -----
+        # score rows are a fixed random projection of each model's actual
+        # embeddings — the zero-shot-logit stand-in both the fit and the
+        # router's score_fn share
+        rng = np.random.RandomState(0)
+        holdout = rng.rand(HOLDOUT, size, size, 3).astype(np.float32)
+        probe = np.asarray(f32_fwd[0](holdout[:1]))
+        proj = rng.standard_normal(
+            (CLASSES, probe.shape[-1])).astype(np.float32)
+
+        def scores_of(fwd, batch):
+            return np.asarray(fwd(batch), np.float64) @ proj.T
+
+        cheap_logits = scores_of(q8_fwd[0], holdout)
+        ref_logits = scores_of(f32_fwd[0], holdout)
+        calib = fit_from_logits(cheap_logits, ref_logits, cheap_model="q8",
+                                reference_model="f32",
+                                target_disagreement=0.01)
+        fingerprint = save_calibration(store, calib)
+        calib = load_calibration(store, fingerprint)  # routers load, not fit
+        if calib.fingerprint != fingerprint:
+            return fail("calibration fingerprint did not round-trip")
+
+        router = CascadeRouter.from_pool(
+            pool, ["q8", "f32"], {"q8": calib},
+            score_fn=lambda out: np.asarray(out, np.float64) @ proj.T)
+
+        # --- property 3 wiring: autoscaler over the two targets -----------
+        auto = CascadeAutoscaler(
+            cheap=ScaleTarget(name="q8", engine=q8_eng,
+                              build_forwards=q8_built,
+                              replicas=Q8_REPLICAS),
+            expensive=ScaleTarget(name="f32", engine=f32_eng,
+                                  build_forwards=f32_built,
+                                  replicas=F32_REPLICAS, max_replicas=2),
+            scheduler=sched, pool=pool, watch_class="batch",
+            queue_high=QUEUE_HIGH, window=2, cooldown=0,
+            metrics=pool.metrics)
+
+        compiles_before = f32_traces() + q8_traces()
+        journal = get_journal()
+
+        async def drive():
+            for eng in pool.engines():
+                await eng.start()
+            try:
+                # prime each engine's live dispatch path: the first couple
+                # of executions of an AOT-warmed executable still pay
+                # one-time host-side finalization (no fresh traces — the
+                # compile tripwire below stays 0), and the rare escalation
+                # must not be the request that eats it
+                for name in pool.names():
+                    for _ in range(3):
+                        await pool.get(name).submit(holdout[0],
+                                                    tenant="vip")
+
+                # --- property 2: calibrated cascade traffic ---------------
+                results = []
+                for i in range(ROUTED):
+                    item = holdout[i % HOLDOUT]
+                    results.append(await router.submit(item, tenant="vip"))
+
+                # steady state: calm must not flap capacity (f32 is at
+                # min_replicas — the bounded no-op)
+                for _ in range(4):
+                    if auto.tick() is not None:
+                        raise RuntimeError("autoscaler acted while calm")
+
+                async def probe_round():
+                    lats = []
+                    for p in range(PROBES):
+                        t0 = time.perf_counter()
+                        await router.submit(holdout[p % HOLDOUT],
+                                            tenant="vip")
+                        lats.append(time.perf_counter() - t0)
+                        await asyncio.sleep(PROBE_GAP_S)
+                    return lats
+
+                unloaded = await probe_round()
+
+                # --- traffic-mix flip: bulk load lands on f32 -------------
+                burst = [asyncio.create_task(
+                    f32_eng.submit(holdout[i % HOLDOUT], tenant="bulk"))
+                    for i in range(FLIP_BURST)]
+                await asyncio.sleep(0)  # admissions run; batch queue fills
+                decision = None
+                for _ in range(4):
+                    decision = auto.tick()
+                    if decision is not None:
+                        break
+                if decision is not None:
+                    await auto.apply(decision)
+                # interactive latency through the flip: probes share the
+                # process with the draining bulk backlog on the shifted
+                # topology — weighted-fair isolation + the extra f32
+                # replica are what keep the bound
+                loaded = await probe_round()
+                await asyncio.gather(*burst)
+                return results, decision, unloaded, loaded
+            finally:
+                for eng in pool.engines():
+                    await eng.stop()
+
+        results, decision, unloaded, loaded = asyncio.run(drive())
+
+        # property 2 checks: routing + single-cid journal chains
+        served_by = {"q8": 0, "f32": 0}
+        for res in results:
+            served_by[res.model] += 1
+            if res.models_tried[0] != "q8":
+                return fail(f"request entered at {res.models_tried[0]}, "
+                            "not the cheapest stage")
+        chain = journal.chain(results[0].cid)
+        events = [e["event"] for e in chain]
+        if events[0] != "cascade_request" or events[-1] != "cascade_routed":
+            return fail(f"cascade journal chain malformed: {events}")
+        if served_by["q8"] == 0:
+            return fail("calibrated cascade escalated every request — "
+                        f"threshold {calib.threshold:.4f} rejects twin "
+                        "outputs it was fit on")
+        esc_rate = router.escalation_rate
+        if not 0.0 <= esc_rate <= calib.escalation_fraction + 0.25:
+            return fail(f"live escalation rate {esc_rate:.3f} far off the "
+                        f"holdout's {calib.escalation_fraction:.3f}")
+
+        # property 3 checks: the flip produced one audited replica shift
+        if decision is None:
+            return fail("interactive backlog never tripped the autoscaler "
+                        f"(queue_high={QUEUE_HIGH})")
+        if decision["action"] != "shift_replica" or \
+                decision["replicas"].get("f32") != 2:
+            return fail(f"expected q8->f32 replica shift, got {decision}")
+        if auto.expensive.replicas != 2 or auto.cheap.replicas != 1:
+            return fail(f"replica counts not updated: "
+                        f"{auto.describe()['replicas']}")
+        # one audited chain: decision -> both engines' replans -> applied,
+        # all on the autoscaler's root correlation id
+        auto_events = [e["event"] for e in journal.chain(auto.cid)]
+        if (auto_events[0] != "autoscale_decision"
+                or auto_events[-1] != "autoscale_applied"
+                or auto_events.count("replan_done") != 2):
+            return fail(f"autoscale journal chain on {auto.cid}: "
+                        f"{auto_events}")
+
+        # the acceptance bound: the autoscaler held interactive latency
+        # through the traffic-mix flip
+        p99_unloaded, p99_loaded = p99(unloaded), p99(loaded)
+        if p99_loaded > MAX_P99_RATIO * p99_unloaded:
+            return fail(f"interactive p99 through the flip "
+                        f"{p99_loaded * 1e3:.1f} ms > {MAX_P99_RATIO}x "
+                        f"unloaded {p99_unloaded * 1e3:.1f} ms")
+
+        # property 6: the drill's journal exports to a valid Chrome trace
+        from jimm_tpu.obs.timeline import (export_timeline,
+                                           validate_chrome_trace)
+        trace = export_timeline(journal.events())
+        problems = validate_chrome_trace(trace)
+        if problems:
+            return fail(f"timeline export invalid: {problems[:3]}")
+        names = {e.get("name") for e in trace["traceEvents"]}
+        for wanted in ("cascade_request", "cascade_routed",
+                       "autoscale_decision", "autoscale_applied"):
+            if wanted not in names:
+                return fail(f"{wanted} missing from the exported timeline")
+
+        # property 4: the whole run — routing, escalations, the replica
+        # shift's replans — compiled nothing after warmup
+        compile_delta = (f32_traces() + q8_traces()) - compiles_before
+        if compile_delta:
+            return fail(f"{compile_delta} fresh compile(s) after warmup "
+                        "(replica shift did not come off the warm store)")
+
+        print(json.dumps({
+            "metric": "cascade_smoke", "value": 1.0,
+            "models": pool.names(),
+            "resident_bytes": resident,
+            "calibration": {"fingerprint": fingerprint[:12],
+                            "escalation_fraction": calib.escalation_fraction,
+                            "measured_disagreement":
+                                calib.measured_disagreement},
+            "routed": len(results),
+            "served_by": served_by,
+            "live_escalation_rate": round(esc_rate, 4),
+            "unloaded_p99_ms": round(p99_unloaded * 1e3, 3),
+            "flip_p99_ms": round(p99_loaded * 1e3, 3),
+            "autoscale_decision": decision["action"],
+            "replicas_after": auto.describe()["replicas"],
+            "compile_count_delta": compile_delta,
+            "store_entries": len(store.entries()),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -270,6 +270,177 @@ def bench_tenants(args) -> tuple[dict, str | None]:
     return rec, error
 
 
+def bench_cascade(args) -> tuple[dict, str | None]:
+    """Confidence-cascade cost bench (docs/cascade.md): the closed loop
+    drives a calibrated int8->f32 cascade router and bills each request by
+    the resident parameter bytes of every model it touched (escalations
+    pay both stages). The headline value is mean cost/request vs the
+    f32-only baseline (x cheaper), stamped together with the live top-1
+    disagreement against the f32 oracle — the cost win only counts at
+    the contracted quality (<= the calibration's target)."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import preset
+    from jimm_tpu.cli import _family, _model_cls, _tiny_override
+    from jimm_tpu.obs import Histogram
+    from jimm_tpu.quant import quantize_model
+    from jimm_tpu.serve import (AdmissionPolicy, BucketTable,
+                                CascadeRouter, InferenceEngine, ModelPool,
+                                counting_forward, fit_from_logits)
+    from jimm_tpu.serve.qos.pool import param_nbytes
+
+    on_tpu = jax.default_backend() == "tpu"
+    name = args.preset or ("clip-vit-base-patch32" if on_tpu
+                           else "clip-vit-base-patch16")
+    fam = _family(name)
+    cfg = preset(name)
+    if args.tiny or not on_tpu:
+        cfg = _tiny_override(cfg)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    method = "encode_image" if fam in ("clip", "siglip") else "__call__"
+    size = cfg.vision.image_size
+    model_cls = _model_cls(fam)
+    f32_model = model_cls(cfg, rngs=nnx.Rngs(0), dtype=dtype,
+                          param_dtype=dtype)
+    q8_model = model_cls(cfg, rngs=nnx.Rngs(0), dtype=dtype,
+                         param_dtype=dtype)
+    quantize_model(q8_model)
+
+    buckets = tuple(int(s) for s in args.buckets.split(",")) \
+        if args.buckets else (1, 2, 4, 8)
+    policy = AdmissionPolicy(max_queue=max(4 * args.clients, 64),
+                             default_timeout_s=120.0)
+    f32_fwd, f32_traces = counting_forward(f32_model, method)
+    q8_fwd, q8_traces = counting_forward(q8_model, method)
+    f32_eng = InferenceEngine(f32_fwd, item_shape=(size, size, 3),
+                              buckets=BucketTable(buckets),
+                              max_delay_ms=args.max_delay_ms, policy=policy,
+                              trace_count=f32_traces)
+    q8_eng = InferenceEngine(q8_fwd, item_shape=(size, size, 3),
+                             buckets=BucketTable(buckets, dtype="int8"),
+                             max_delay_ms=args.max_delay_ms, policy=policy,
+                             metrics=f32_eng.metrics, trace_count=q8_traces)
+    f32_eng.resident_param_bytes = param_nbytes(
+        nnx.state(f32_model, nnx.Param))
+    q8_eng.resident_param_bytes = param_nbytes(
+        nnx.state(q8_model, nnx.Param))
+    pool = ModelPool({"f32": f32_eng, "q8": q8_eng}, default="f32")
+    cost = pool.resident_bytes()  # the per-stage cost model, in bytes
+
+    # calibrate on a holdout of both models' actual score rows (a fixed
+    # random projection of the embeddings stands in for zero-shot logits)
+    rng = np.random.RandomState(0)
+    n_holdout = 96
+    holdout = rng.rand(n_holdout, size, size, 3).astype(np.float32)
+    probe = np.asarray(f32_fwd(holdout[:1]))
+    proj = rng.standard_normal((16, probe.shape[-1])).astype(np.float32)
+
+    def score_fn(out):
+        return np.asarray(out, np.float64) @ proj.T
+
+    ref_logits = score_fn(f32_fwd(holdout))
+    cheap_logits = score_fn(q8_fwd(holdout))
+    calib = fit_from_logits(cheap_logits, ref_logits, cheap_model="q8",
+                            reference_model="f32",
+                            target_disagreement=args.target_disagreement)
+    router = CascadeRouter.from_pool(pool, ["q8", "f32"], {"q8": calib},
+                                     score_fn=score_fn)
+    ref_top1 = ref_logits.argmax(axis=1)
+
+    for eng in pool.engines():
+        eng.warmup_blocking()
+    compiles_before = f32_traces() + q8_traces()
+
+    per_client = max(1, (args.requests or 16 * args.clients) // args.clients)
+    total = per_client * args.clients
+    latency = Histogram("client_latency_seconds", window=max(total, 1))
+    depth_counts: dict[int, int] = {}
+    disagreements = 0
+    cost_sum = 0
+
+    async def one_client(ci):
+        nonlocal disagreements, cost_sum
+        for r in range(per_client):
+            idx = (ci * per_client + r) % n_holdout
+            t0 = time.perf_counter()
+            res = await router.submit(holdout[idx])
+            latency.observe(time.perf_counter() - t0)
+            depth_counts[res.escalations] = \
+                depth_counts.get(res.escalations, 0) + 1
+            cost_sum += sum(cost[m] for m in res.models_tried)
+            # quality audit: an answer accepted on the cheap stage must
+            # agree with the f32 oracle's top-1 for this item
+            if res.model == "q8" and \
+                    int(score_fn(res.output).argmax()) != int(ref_top1[idx]):
+                disagreements += 1
+
+    async def go():
+        for eng in pool.engines():
+            await eng.start()
+        try:
+            await asyncio.gather(*[one_client(ci)
+                                   for ci in range(args.clients)])
+        finally:
+            for eng in pool.engines():
+                await eng.stop()
+
+    t0 = time.monotonic()
+    asyncio.run(go())
+    dt = time.monotonic() - t0
+
+    compile_delta = (f32_traces() + q8_traces()) - compiles_before
+    mean_cost = cost_sum / total
+    ratio = cost["f32"] / mean_cost if mean_cost else 0.0
+    disagreement = disagreements / total
+    rec = {
+        "metric": ("serve_cascade_cost" if on_tpu
+                   else "serve_cascade_cost (cpu smoke)"),
+        "value": round(ratio, 3),
+        "unit": "x cost/request vs f32-only (resident param bytes)",
+        "workload": "cascade",
+        "model": name + (":tiny" if (args.tiny or not on_tpu) else ""),
+        "clients": args.clients,
+        "requests": total,
+        "rps": round(total / dt, 2),
+        "p50_ms": round(latency.percentile(50) * 1e3, 3),
+        "p99_ms": round(latency.percentile(99) * 1e3, 3),
+        "stage_cost_bytes": cost,
+        "mean_cost_bytes": round(mean_cost, 1),
+        "cost_per_depth": {str(d): cost["q8"] + d * cost["f32"]
+                           for d in sorted(depth_counts)},
+        "requests_per_depth": {str(d): n
+                               for d, n in sorted(depth_counts.items())},
+        "escalation_rate": round(router.escalation_rate, 4),
+        "disagreement": round(disagreement, 4),
+        "target_disagreement": args.target_disagreement,
+        "calibration": {"fingerprint": calib.fingerprint[:12],
+                        "temperature": round(calib.temperature, 4),
+                        "holdout": calib.holdout,
+                        "holdout_escalation": calib.escalation_fraction},
+        "buckets": list(buckets),
+        "compile_count_delta": compile_delta,
+        "n_devices": jax.device_count(),
+        "replicas": 1,
+        "model_parallel": 1,
+    }
+    error = None
+    if compile_delta:
+        error = f"{compile_delta} recompile(s) after warmup"
+    elif disagreement > args.target_disagreement:
+        error = (f"live top-1 disagreement {disagreement:.4f} over the "
+                 f"{args.target_disagreement} target — calibration does "
+                 "not transfer from its holdout")
+    elif ratio < 2.0:
+        error = (f"cascade cost win {ratio:.2f}x < 2x — escalation rate "
+                 f"{router.escalation_rate:.3f} erases the int8 saving")
+    return rec, error
+
+
 def bench_cold_start(args) -> dict:
     """Time-to-first-response of a fresh engine, without vs. with a
     populated AOT store. Each life uses a brand-new forward wrapper (what
@@ -523,6 +694,16 @@ def main() -> int:
     p.add_argument("--http", action="store_true",
                    help="measure through the full HTTP stack instead of "
                         "the in-process engine")
+    p.add_argument("--cascade", action="store_true",
+                   help="benchmark confidence-cascade serving: a calibrated "
+                        "int8->f32 router vs the f32-only baseline, billed "
+                        "in resident parameter bytes per request "
+                        "(docs/cascade.md); fails if the cost win is < 2x "
+                        "or live disagreement exceeds the target")
+    p.add_argument("--target-disagreement", type=float, default=0.01,
+                   help="--cascade: top-1 disagreement budget the "
+                        "calibration is fit to (and the live run is "
+                        "audited against)")
     p.add_argument("--record", action="store_true",
                    help="append the result line to MEASUREMENTS.jsonl")
     p.add_argument("--aot", default=None, metavar="STORE_DIR",
@@ -555,6 +736,20 @@ def main() -> int:
 
     if args.tenants:
         rec, error = bench_tenants(args)
+        print(json.dumps(rec), flush=True)
+        if args.record:
+            from scripts._measurements import MEASUREMENTS
+            full = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "phase": "serve_bench", **rec}
+            with open(MEASUREMENTS, "a") as f:
+                f.write(json.dumps(full) + "\n")
+        if error:
+            print(json.dumps({"error": error}), flush=True)
+            return 1
+        return 0
+
+    if args.cascade:
+        rec, error = bench_cascade(args)
         print(json.dumps(rec), flush=True)
         if args.record:
             from scripts._measurements import MEASUREMENTS

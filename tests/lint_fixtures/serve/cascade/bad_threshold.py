@@ -1,0 +1,32 @@
+"""Fixture: hardcoded confidence thresholds in cascade routing code (JL021)."""
+
+
+def route(scores, escalation_threshold=0.95):          # JL021 line 4: default
+    confidence = scores.max()
+    if confidence >= 0.92:                             # JL021 line 6: comparison
+        return "accept"
+    return "escalate"
+
+
+class BadRouter:
+    def __init__(self, stages):
+        self.stages = stages
+        self.confidence_floor = 0.9                    # JL021 line 14: assignment
+        self.margin_threshold: float = -0.05           # JL021 line 15: assignment
+
+    def build(self):
+        return make_router(self.stages, threshold=0.88)  # JL021 line 18: keyword
+
+
+def make_router(stages, **kw):
+    return kw
+
+
+def fine(calibration, confidence):
+    # Loading from a fitted artifact and formatting are fine: no literal
+    # ever binds to or gates on a threshold-named value here.
+    threshold = calibration.threshold
+    shown = round(confidence, 6)
+    if confidence >= threshold:
+        return shown
+    return None

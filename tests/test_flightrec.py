@@ -349,6 +349,94 @@ class TestSlo:
         assert snap["tenants"]["t"]["objective"] == {"availability": 0.999}
 
 
+class TestSloTransitions:
+    """Fast-burn *transition* events (add_listener) under bursty traffic.
+
+    The listener contract is edge-triggered: one call on entering fast
+    burn, one on exiting, nothing while the state holds — this is what
+    the cascade autoscaler hangs capacity decisions on.
+    """
+
+    @staticmethod
+    def make_listening_engine(**kw):
+        kw.setdefault("fast_window_s", 60)
+        kw.setdefault("slow_window_s", 600)
+        kw.setdefault("fast_burn_threshold", 1.5)
+        eng, clock = make_engine({"t": SloObjective(0.5)}, **kw)
+        events = []
+        eng.add_listener(
+            lambda tenant, entered, fast, slow:
+            events.append((tenant, entered, fast, slow)))
+        return eng, clock, events
+
+    def test_enter_fires_once_not_per_observation(self):
+        eng, clock, events = self.make_listening_engine()
+        # all-bad traffic: budget 0.5 -> burn 2.0 in both windows, over
+        # the 1.5 fast threshold and the 1.0 slow guard immediately
+        eng.observe("t", False)
+        assert events == [("t", True, pytest.approx(2.0),
+                           pytest.approx(2.0))]
+        # staying in fast burn is not a transition
+        for _ in range(5):
+            eng.observe("t", False)
+        assert len(events) == 1
+
+    def test_exit_fires_when_windows_drain(self):
+        eng, clock, events = self.make_listening_engine()
+        eng.observe("t", False)
+        assert [e[1] for e in events] == [True]
+        # idle past both windows: the exit is reported with the next
+        # request (transitions are evaluated on observations)
+        clock["t"] += 700.0
+        assert len(events) == 1
+        eng.observe("t", True)
+        assert [e[1] for e in events] == [True, False]
+        tenant, entered, fast, slow = events[-1]
+        assert fast < 1.5 and slow < 1.0
+
+    def test_burst_diluted_by_slow_window_never_fires(self):
+        # a fresh error burst after a long clean stretch: fast window
+        # burns but the 600s window is diluted -> multi-window guard
+        # holds and no transition is emitted
+        eng, clock, events = self.make_listening_engine()
+        for _ in range(400):
+            eng.observe("t", True)
+        clock["t"] += 300.0
+        eng.observe("t", False)
+        assert eng.burn_rate("t", 60.0) >= 1.5
+        assert eng.burn_rate("t", 600.0) < 1.0
+        assert events == []
+        # sustained errors eventually tip the slow window too -> enter
+        for _ in range(500):
+            eng.observe("t", False)
+        assert [e[1] for e in events] == [True]
+        assert events[0][3] >= 1.0
+
+    def test_flap_across_windows_yields_paired_transitions(self):
+        # bursty traffic that alternates bad bursts and quiet recovery:
+        # each burn episode yields exactly one enter/exit pair
+        eng, clock, events = self.make_listening_engine()
+        for _ in range(3):
+            eng.observe("t", False)          # enter
+            clock["t"] += 700.0              # drain 60s and 600s windows
+            eng.observe("t", True)           # exit reported here
+            clock["t"] += 700.0              # drain the recovery probe too
+        assert [e[1] for e in events] == [True, False] * 3
+
+    def test_listener_errors_counted_not_raised(self):
+        eng, clock, events = self.make_listening_engine()
+
+        def broken(tenant, entered, fast, slow):
+            raise RuntimeError("consumer bug")
+
+        eng._listeners.insert(0, broken)
+        # the broken consumer neither fails accounting nor starves the
+        # healthy one
+        assert eng.observe("t", False) is False
+        assert [e[1] for e in events] == [True]
+        assert eng.registry.counter("listener_errors_total").value == 1
+
+
 # ---------------------------------------------------------------------------
 # baseline store / regression gate
 # ---------------------------------------------------------------------------

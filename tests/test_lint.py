@@ -420,6 +420,41 @@ class TestRuleFixtures:
         assert check_bare_lowp_cast(
             tree_ok, "jimm_tpu/ops/int8_matmul.py") == []
 
+    def test_jl021_cascade_threshold_literals(self):
+        findings = findings_for("serve/cascade/bad_threshold.py")
+        assert rules_and_lines(findings) == {
+            ("JL021", 4),   # def route(..., escalation_threshold=0.95)
+            ("JL021", 6),   # confidence >= 0.92
+            ("JL021", 14),  # self.confidence_floor = 0.9
+            ("JL021", 15),  # self.margin_threshold: float = -0.05
+            ("JL021", 18),  # make_router(..., threshold=0.88)
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert any("cascade calibrate" in f.message for f in findings)
+        # loading calibration.threshold, round(confidence, 6), and the
+        # variable-vs-variable comparison (lines 24-31) stay clean
+
+    def test_jl021_scoped_to_cascade_outside_calibrate(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_cascade_thresholds
+        src = "threshold = 0.92\n"
+        tree = ast.parse(src)
+        assert check_cascade_thresholds(
+            tree, "jimm_tpu/serve/cascade/router.py") != []
+        assert check_cascade_thresholds(
+            tree, "jimm_tpu/serve/cascade/autoscale.py") != []
+        # the fitter is the one place thresholds legitimately live
+        assert check_cascade_thresholds(
+            tree, "jimm_tpu/serve/cascade/calibrate.py") == []
+        # outside the cascade package the marks mean nothing
+        assert check_cascade_thresholds(
+            tree, "jimm_tpu/serve/engine.py") == []
+        assert check_cascade_thresholds(
+            tree, "jimm_tpu/retrieval/cascade.py") == []
+        assert check_cascade_thresholds(
+            tree, "tests/test_cascade.py") == []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings
