@@ -674,6 +674,15 @@ def cmd_train(args: argparse.Namespace) -> int:
     # compile/data_wait/step/checkpoint/host_sync/other
     acct = obs.GoodputAccounter()
     profiler_ctx = None
+    # continuous profiling ring: a bounded on-disk rotation of short
+    # step-window captures, plus anomaly-triggered deep captures (installed
+    # process-globally so resilience paths can maybe_trigger() into it)
+    prof_ring = None
+    if args.prof_ring:
+        from jimm_tpu.obs.prof.capture import configure_capture
+        prof_ring = configure_capture(
+            args.prof_ring, max_ring_bytes=args.prof_ring_bytes,
+            every_steps=args.prof_every, window_steps=args.prof_window)
 
     # preemption guard: SIGTERM sets a flag the loop polls; the handler
     # turns it into a grace-window async save + resumable PreemptedError
@@ -708,7 +717,13 @@ def cmd_train(args: argparse.Namespace) -> int:
     try:
         with use_sharding(mesh, rules):
             for step in range(start_step, args.steps):
+                if prof_ring is not None:
+                    prof_ring.on_step(step)
                 if args.profile_dir and step == profile_start:
+                    if prof_ring is not None:
+                        # one profiler session at a time: a live ring window
+                        # would deadlock the blocking one-shot trace below
+                        prof_ring.flush()
                     from jimm_tpu.train.profile import trace
                     profiler_ctx = trace(args.profile_dir)
                     profiler_ctx.__enter__()
@@ -762,6 +777,10 @@ def cmd_train(args: argparse.Namespace) -> int:
             # crash mid-profile: still flush what was captured
             profiler_ctx.__exit__(None, None, None)
             print(f"profile trace written to {args.profile_dir}")
+        if prof_ring is not None:
+            # commit a half-open window so the newest capture survives a
+            # crash — the whole point of a flight-recorder ring
+            prof_ring.close()
         # a mid-run crash must not strand buffered TensorBoard events (the
         # EventFileWriter queue flushes on close, not per event)
         logger.close()
@@ -1671,6 +1690,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
             lambda: _build_forward(model, method, size, model_key))
     pool = None
     pool_traces = []
+    pool_models = [model]
     if args.pool_model:
         # multi-model residency: each extra model gets its own warm engine
         # (own buckets + own AOT fingerprint via its model_key, so the
@@ -1714,6 +1734,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
             # the default model's, bound above via trace_count=)
             engine.metrics.bind_gauge(f"model_{pname}_compile_count", ptrace)
             pool_traces.append(ptrace)
+            pool_models.append(pmodel)
             engines[pname] = pengine
         pool = ModelPool(engines, default="default")
         # every extra engine's __init__ re-bound queue_depth_now to its own
@@ -1744,6 +1765,33 @@ def cmd_serve(args: argparse.Namespace) -> int:
         from jimm_tpu.train.metrics import MetricsLogger
         logger = MetricsLogger(path=args.metrics_file,
                                print_every=10 ** 9)  # JSONL only, no console
+    monitor = None
+    if args.prof_dir:
+        # continuous profiling + HBM watchdog: the capture manager is
+        # process-global so heal/replan/SLO-burn paths (and POST
+        # /admin/prof/trigger) deep-capture onto their incident cids
+        from jimm_tpu.obs.prof.capture import configure_capture
+        from jimm_tpu.obs.prof.memory import MemoryMonitor
+        configure_capture(args.prof_dir)
+        monitor = MemoryMonitor()
+
+        def _model_pool_bytes() -> float:
+            import jax
+            total = 0.0
+            for m in pool_models:
+                for leaf in jax.tree_util.tree_leaves(nnx.state(m)):
+                    total += float(getattr(leaf, "nbytes", 0) or 0)
+            return total
+
+        monitor.register_subsystem("model_pool", _model_pool_bytes)
+        monitor.register_subsystem(
+            "serve_buffers", lambda: float(engine._traces_bytes))
+        if retrieval is not None:
+            info = retrieval.describe()
+            monitor.register_subsystem(
+                "retrieval_index",
+                lambda r=info["rows"], d=info["dim"]: float(r * d * 4))
+        monitor.start()
     server = ServingServer(engine, zero_shot=zero_shot,
                            retrieval=retrieval, host=args.host,
                            port=args.port, metrics_logger=logger,
@@ -1785,11 +1833,20 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 str(b): s for b, s in sorted(
                     retrieval.searcher.warmup_report.items())}
     print(json.dumps(ready), flush=True)
-    if args.max_seconds:
-        time.sleep(args.max_seconds)
-        server.stop()
-    else:
-        server.serve_forever()
+    try:
+        if args.max_seconds:
+            time.sleep(args.max_seconds)
+            server.stop()
+        else:
+            server.serve_forever()
+    finally:
+        if monitor is not None:
+            monitor.stop()
+        if args.prof_dir:
+            from jimm_tpu.obs.prof.capture import get_capture_manager
+            mgr = get_capture_manager()
+            if mgr is not None:
+                mgr.flush()
     return 0
 
 
@@ -1940,6 +1997,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write TensorBoard scalar events here")
     sp.add_argument("--profile-dir", default=None,
                     help="capture a jax.profiler trace of steps 2-4 here")
+    sp.add_argument("--prof-ring", default=None, metavar="DIR",
+                    help="continuous profiling: keep a bounded on-disk "
+                         "ring of short step-window captures here, and "
+                         "accept anomaly-triggered deep captures "
+                         "(jimm-tpu obs prof ls/show/diff)")
+    sp.add_argument("--prof-every", type=int, default=200,
+                    help="capture a ring window every N steps")
+    sp.add_argument("--prof-window", type=int, default=2,
+                    help="steps per ring window capture")
+    sp.add_argument("--prof-ring-bytes", type=int, default=64 << 20,
+                    help="ring byte budget; oldest captures evicted")
     sp.add_argument("--journal", default=None, metavar="FILE",
                     help="persist flight-recorder events (preemption, "
                          "checkpoint, reshard) to this rotating JSONL "
@@ -2168,6 +2236,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="append metric snapshots as JSONL "
                          "(train/metrics.py format)")
     sp.add_argument("--metrics-every-s", type=float, default=10.0)
+    sp.add_argument("--prof-dir", default=None, metavar="DIR",
+                    help="continuous profiling + HBM watchdog: keep the "
+                         "anomaly-triggered capture ring here (heal/replan/"
+                         "SLO-burn incidents and POST /admin/prof/trigger "
+                         "deep-capture onto their cids) and sample "
+                         "jimm_hbm_* device-memory gauges")
     sp.add_argument("--bf16", action="store_true",
                     help="legacy spelling of --dtype bf16")
     sp.add_argument("--dtype", choices=["f32", "bf16", "int8"], default=None,

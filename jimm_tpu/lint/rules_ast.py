@@ -112,6 +112,17 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   artifact (``load_calibration``); only ``calibrate.py`` (where fitting
   lives) and tests may spell threshold numbers. Deliberate literals
   carry a ``# jaxlint: disable=JL021`` justification.
+- **JL022** direct ``jax.profiler.start_trace`` / ``stop_trace`` call
+  outside ``jimm_tpu/obs/prof/`` — the runtime supports ONE active
+  profiler session per process, and the continuous capture ring
+  (``--prof-ring`` / ``--prof-dir``) may be holding it at any moment: a
+  second ``start_trace`` raises mid-incident, exactly when the capture
+  mattered. All session control lives behind the ring's session lock —
+  one-shot traces go through
+  ``jimm_tpu.obs.prof.capture.profiler_session`` (or
+  ``train.profile.trace``), anomaly captures through
+  ``CaptureManager.trigger``. Tests are exempt; deliberate direct calls
+  carry a ``# jaxlint: disable=JL022`` justification.
 """
 
 from __future__ import annotations
@@ -1383,6 +1394,59 @@ def check_cascade_thresholds(tree: ast.AST, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL022 — direct profiler session control outside obs/prof/
+# ---------------------------------------------------------------------------
+
+#: the two jax.profiler calls that claim/release THE process profiler
+#: session (TraceAnnotation etc. are session-agnostic and stay legal)
+_PROFILER_SESSION_FNS = frozenset({"start_trace", "stop_trace"})
+
+
+def _path_is_prof_home(path: str) -> bool:
+    """Inside ``jimm_tpu/obs/prof/`` — the sanctioned session owner."""
+    parts = path.replace("\\", "/").split("/")
+    return "prof" in parts[:-1] and "obs" in parts
+
+
+def check_profiler_bypass(tree: ast.AST, path: str) -> list[Finding]:
+    """JL022: ``jax.profiler.start_trace``/``stop_trace`` called outside
+    ``obs/prof/`` — the process has ONE profiler session and the capture
+    ring may be holding it; direct session control races the ring instead
+    of serializing on its lock. Catches both the attribute spelling
+    (``jax.profiler.start_trace(...)``) and names imported from
+    ``jax.profiler`` directly."""
+    if _path_is_prof_home(path) or _path_is_test(path):
+        return []
+    imported: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == "jax.profiler":
+            for alias in node.names:
+                if alias.name in _PROFILER_SESSION_FNS:
+                    imported.add(alias.asname or alias.name)
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        if fname is None:
+            continue
+        leaf = fname.rsplit(".", 1)[-1]
+        if fname in imported or (leaf in _PROFILER_SESSION_FNS
+                                 and fname.endswith(f"profiler.{leaf}")):
+            findings.append(Finding(
+                "JL022", ERROR, path, node.lineno,
+                f"direct jax.profiler.{leaf} outside obs/prof — the "
+                "process has ONE profiler session and the continuous "
+                "capture ring may be holding it (a second start_trace "
+                "raises mid-incident, exactly when the capture mattered). "
+                "Use jimm_tpu.obs.prof.capture.profiler_session (or "
+                "train.profile.trace) so sessions serialize on the ring's "
+                "lock, or justify with # jaxlint: disable=JL022"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -1405,4 +1469,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_journal_bypass(tree, path)
     findings += check_bare_lowp_cast(tree, path)
     findings += check_cascade_thresholds(tree, path)
+    findings += check_profiler_bypass(tree, path)
     return findings

@@ -27,6 +27,9 @@ from jimm_tpu.obs.journal import (EventJournal, chain, configure_journal,
                                   correlate, current_cid, get_journal,
                                   new_correlation_id, read_events,
                                   reset_journal)
+from jimm_tpu.obs.prof import (CaptureManager, MemoryMonitor,
+                               configure_capture, get_capture_manager,
+                               maybe_trigger, reset_capture)
 from jimm_tpu.obs.registry import (Counter, DuplicateMetricError, Gauge,
                                    Histogram, MetricRegistry, enabled,
                                    get_registry, percentile, publish,
@@ -38,15 +41,16 @@ from jimm_tpu.obs.timeline import (export_timeline, validate_chrome_trace,
                                    write_timeline)
 
 __all__ = [
-    "BUCKETS", "BaselineStore", "Counter", "DuplicateMetricError",
-    "EventJournal", "Gauge", "GoodputAccounter", "Histogram",
-    "JsonlExporter", "MetricRegistry", "SloEngine", "SloObjective", "chain",
-    "check_rows", "configure_journal", "console_table", "correlate",
-    "current_cid", "diff_snapshots", "enabled", "export_timeline",
-    "get_journal", "get_registry", "is_fallback", "new_correlation_id",
-    "new_trace_id", "parse_prometheus_text", "percentile", "publish",
-    "read_events", "registries", "render_prometheus",
-    "render_prometheus_text", "reset_journal", "row_key", "set_enabled",
-    "snapshot", "span", "unpublish", "validate_chrome_trace",
-    "write_timeline",
+    "BUCKETS", "BaselineStore", "CaptureManager", "Counter",
+    "DuplicateMetricError", "EventJournal", "Gauge", "GoodputAccounter",
+    "Histogram", "JsonlExporter", "MemoryMonitor", "MetricRegistry",
+    "SloEngine", "SloObjective", "chain", "check_rows", "configure_capture",
+    "configure_journal", "console_table", "correlate", "current_cid",
+    "diff_snapshots", "enabled", "export_timeline", "get_capture_manager",
+    "get_journal", "get_registry", "is_fallback", "maybe_trigger",
+    "new_correlation_id", "new_trace_id", "parse_prometheus_text",
+    "percentile", "publish", "read_events", "registries",
+    "render_prometheus", "render_prometheus_text", "reset_capture",
+    "reset_journal", "row_key", "set_enabled", "snapshot", "span",
+    "unpublish", "validate_chrome_trace", "write_timeline",
 ]

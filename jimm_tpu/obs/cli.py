@@ -1,6 +1,6 @@
-"""``jimm-tpu obs`` — tail, snapshot, diff, timeline, and regress.
+"""``jimm-tpu obs`` — tail, snapshot, diff, timeline, regress, and prof.
 
-Five verbs over the exporter formats (stdlib only, no jax import):
+Six verbs over the exporter formats (stdlib only, no jax import):
 
 - ``snapshot`` — fetch a ``/metrics`` endpoint (or read a saved dump) and
   print it as a console table, JSON, or raw Prometheus text; ``-o`` saves
@@ -17,6 +17,11 @@ Five verbs over the exporter formats (stdlib only, no jax import):
 - ``regress``  — gate fresh MEASUREMENTS.jsonl rows against adopted
   per-(workload,backend,preset) baselines; fallback rows are excluded
   from comparison and ``--adopt`` records new baselines.
+- ``prof``     — the continuous-profiling ring: ``ls`` committed captures,
+  ``show`` a per-op table, ``diff`` two captures direction-aware (exit 1
+  on regression), and ``trigger`` a deep capture on a running server.
+  ``ls``/``show``/``diff`` stay jax-free so they run on a dev box against
+  artifacts rsynced off the TPU host.
 
 Wired as a subparser under the main ``jimm-tpu`` CLI (see jimm_tpu/cli.py).
 """
@@ -25,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import urllib.request
@@ -65,26 +71,61 @@ def _cmd_snapshot(args) -> int:
     return 0
 
 
-def _tail_jsonl(path: str, follow: bool) -> int:
-    with open(path) as f:
+def _follow_lines(path: str, *, follow: bool, poll_s: float = 0.5,
+                  sleep=time.sleep, should_stop=None):
+    """Yield lines from ``path``, surviving journal-style rotation.
+
+    The flight-recorder journal rotates by renaming the live file aside
+    and recreating the path; a follower holding the old descriptor then
+    reads EOF forever. So at EOF we re-stat the *path*: a changed inode
+    (or a file shorter than our read position — truncate-in-place
+    rotation) means a new file is live, and we reopen from its top.
+    ``sleep``/``should_stop`` are injectable so the rotation regression
+    test can drive the loop without wall-clock waits."""
+    f = open(path)
+    try:
+        ino = os.fstat(f.fileno()).st_ino
         while True:
             line = f.readline()
             if line:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                ts = rec.pop("ts", "")
-                phase = rec.pop("phase", "")
-                keys = ", ".join(f"{k}={v}" for k, v in sorted(rec.items()))
-                print(f"{ts} [{phase}] {keys}", flush=True)
-            elif follow:
-                time.sleep(0.5)
-            else:
-                return 0
+                yield line
+                continue
+            if not follow:
+                return
+            try:
+                st = os.stat(path)
+            except OSError:
+                st = None  # mid-rotation window; poll again
+            if st is not None and (st.st_ino != ino
+                                   or st.st_size < f.tell()):
+                f.close()
+                f = open(path)
+                ino = os.fstat(f.fileno()).st_ino
+                continue
+            if should_stop is not None and should_stop():
+                return
+            sleep(poll_s)
+    finally:
+        f.close()
+
+
+def _tail_jsonl(path: str, follow: bool, *, sleep=time.sleep,
+                should_stop=None, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    for line in _follow_lines(path, follow=follow, sleep=sleep,
+                              should_stop=should_stop):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        ts = rec.pop("ts", "")
+        phase = rec.pop("phase", "")
+        keys = ", ".join(f"{k}={v}" for k, v in sorted(rec.items()))
+        print(f"{ts} [{phase}] {keys}", file=out, flush=True)
+    return 0
 
 
 def _tail_url(url: str, interval_s: float) -> int:
@@ -199,6 +240,10 @@ def _cmd_timeline(args) -> int:
 
     events = read_events(args.journal)
     traces = _load_trace_rows(args.traces) if args.traces else []
+    captures = []
+    if args.prof:
+        from jimm_tpu.obs.prof.capture import list_captures
+        captures = list_captures(args.prof)
     goodput = None
     if args.goodput:
         with open(args.goodput) as f:
@@ -209,7 +254,8 @@ def _cmd_timeline(args) -> int:
                    if k.endswith("_s") and isinstance(v, (int, float))} \
             or {k: v for k, v in report.items()
                 if isinstance(v, (int, float))}
-    trace = export_timeline(events, traces=traces, goodput=goodput,
+    trace = export_timeline(events, traces=traces, captures=captures,
+                            goodput=goodput,
                             meta={"journal": str(args.journal)})
     problems = validate_chrome_trace(trace)
     if problems:
@@ -220,9 +266,70 @@ def _cmd_timeline(args) -> int:
     write_timeline(out, trace)
     n = sum(1 for e in trace["traceEvents"] if e.get("ph") != "M")
     print(f"wrote {out}: {n} events from {len(events)} journal records"
-          f" + {len(traces)} serve traces"
+          f" + {len(traces)} serve traces + {len(captures)} captures"
           f" (open in Perfetto or chrome://tracing)")
     return 0
+
+
+def _cmd_prof_ls(args) -> int:
+    from jimm_tpu.obs.prof.capture import list_captures
+    metas = list_captures(args.dir)
+    if args.json:
+        print(json.dumps([{k: v for k, v in m.items() if k != "path"}
+                          for m in metas], indent=2))
+        return 0
+    if not metas:
+        print(f"(no committed captures under {args.dir})")
+        return 0
+    print(f"{'capture':<24} {'kind':<7} {'dur':>8} {'bytes':>10} "
+          f"{'step':>7}  cid / reason")
+    for m in metas:
+        dur = m.get("dur_s")
+        dur_txt = f"{dur:.3f}s" if isinstance(dur, (int, float)) else "?"
+        step = m.get("step")
+        tail = " ".join(str(x) for x in (m.get("cid"), m.get("reason"))
+                        if x is not None)
+        print(f"{m.get('name', '?'):<24} {m.get('kind', '?'):<7} "
+              f"{dur_txt:>8} {m.get('bytes', 0):>10} "
+              f"{step if step is not None else '-':>7}  {tail}")
+    return 0
+
+
+def _cmd_prof_show(args) -> int:
+    from jimm_tpu.obs.prof.opstats import op_table, render_table
+    rows = op_table(args.capture, device=args.device)
+    print(render_table(rows, top=args.top))
+    return 0
+
+
+def _cmd_prof_diff(args) -> int:
+    from jimm_tpu.obs.prof.opstats import diff_ops, op_table, render_diff
+    before = op_table(args.before, device=args.device)
+    after = op_table(args.after, device=args.device)
+    d = diff_ops(before, after, threshold=args.threshold, top=args.top)
+    if args.json:
+        print(json.dumps(d, indent=2))
+    else:
+        print(render_diff(d))
+    return 1 if d["verdict"] == "regression" else 0
+
+
+def _cmd_prof_trigger(args) -> int:
+    url = args.url.rstrip("/") + "/admin/prof/trigger"
+    payload: dict = {"reason": args.reason}
+    if args.cid:
+        payload["cid"] = args.cid
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=15.0) as resp:
+            body = json.loads(resp.read().decode("utf-8"))
+    except OSError as e:
+        print(f"trigger failed: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(body, indent=2))
+    return 0 if body.get("triggered") else 1
 
 
 def _cmd_regress(args) -> int:
@@ -330,7 +437,52 @@ def add_obs_parser(subparsers) -> None:
                     help="serve traces: /debug/traces URL or saved JSON")
     px.add_argument("--goodput", default=None,
                     help="goodput report JSON to render as a bucket lane")
+    px.add_argument("--prof", default=None,
+                    help="capture ring dir: render committed profiler "
+                         "captures as spans on a 'prof' lane")
     px.set_defaults(obs_func=_cmd_timeline)
+
+    pp = sub.add_parser(
+        "prof", help="list, analyze, and trigger profiler captures")
+    psub = pp.add_subparsers(dest="prof_cmd", required=True)
+
+    pls = psub.add_parser("ls", help="list committed captures in a ring dir")
+    pls.add_argument("dir", nargs="?", default=".",
+                     help="capture ring directory (default .)")
+    pls.add_argument("--json", action="store_true")
+    pls.set_defaults(obs_func=_cmd_prof_ls)
+
+    psh = psub.add_parser(
+        "show", help="per-op time/bytes table for one capture (jax-free)")
+    psh.add_argument("capture",
+                     help="capture dir (or any dir/file holding a "
+                          "*.trace.json.gz)")
+    psh.add_argument("--top", type=int, default=20)
+    psh.add_argument("--device", type=int, default=0,
+                     help="device pid to aggregate (default first)")
+    psh.set_defaults(obs_func=_cmd_prof_show)
+
+    pdf = psub.add_parser(
+        "diff", help="direction-aware per-op diff of two captures; "
+                     "exit 1 on regression")
+    pdf.add_argument("before")
+    pdf.add_argument("after")
+    pdf.add_argument("--top", type=int, default=20)
+    pdf.add_argument("--threshold", type=float, default=0.10,
+                     help="per-op fractional slowdown that counts as a "
+                          "regression (0.10 = 10%%)")
+    pdf.add_argument("--device", type=int, default=0)
+    pdf.add_argument("--json", action="store_true")
+    pdf.set_defaults(obs_func=_cmd_prof_diff)
+
+    ptr = psub.add_parser(
+        "trigger", help="ask a serving server for a deep capture "
+                        "(POST /admin/prof/trigger)")
+    ptr.add_argument("url", help="server base URL, e.g. http://host:8000")
+    ptr.add_argument("--cid", default=None,
+                     help="incident correlation id to tag the capture with")
+    ptr.add_argument("--reason", default="manual")
+    ptr.set_defaults(obs_func=_cmd_prof_trigger)
 
     pr = sub.add_parser(
         "regress",

@@ -1,0 +1,32 @@
+"""jimm_tpu.obs.prof — continuous profiling + HBM observability.
+
+Three pieces (see docs/observability.md "Profiling & memory"):
+
+- :mod:`~jimm_tpu.obs.prof.capture` — the windowed ``jax.profiler``
+  capture manager: an always-on bounded-overhead ring of recent
+  step-window captures plus ``trigger(cid)`` deep captures correlated on
+  flight-recorder cids (``prof_capture_started/committed`` journal
+  events). The ONLY sanctioned home of ``start_trace``/``stop_trace``
+  (lint JL022).
+- :mod:`~jimm_tpu.obs.prof.memory` — per-device HBM gauges
+  (``jimm_hbm_*``), per-subsystem byte attribution, and the
+  ``hbm_leak_suspected`` monotonic-growth watchdog.
+- :mod:`~jimm_tpu.obs.prof.opstats` — jax-free parsing of committed
+  captures into top-k per-op tables and a direction-aware diff (the
+  ``obs prof ls/show/diff`` CLI).
+"""
+
+from jimm_tpu.obs.prof.capture import (CaptureManager, configure_capture,
+                                       get_capture_manager, list_captures,
+                                       maybe_trigger, profiler_session,
+                                       reset_capture)
+from jimm_tpu.obs.prof.memory import MemoryMonitor, device_memory_rows
+from jimm_tpu.obs.prof.opstats import (aggregate_ops, diff_ops, op_table,
+                                       render_diff, render_table, top_ops)
+
+__all__ = [
+    "CaptureManager", "MemoryMonitor", "aggregate_ops", "configure_capture",
+    "device_memory_rows", "diff_ops", "get_capture_manager",
+    "list_captures", "maybe_trigger", "op_table", "profiler_session",
+    "render_diff", "render_table", "reset_capture", "top_ops",
+]

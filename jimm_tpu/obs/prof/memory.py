@@ -1,0 +1,213 @@
+"""HBM observability: per-device memory gauges + a monotonic-leak watchdog.
+
+``MemoryMonitor.sample()`` reads each device's allocator stats
+(``device.memory_stats()`` — TPU/GPU backends) and publishes ``jimm_hbm_*``
+gauges: bytes in use, peak, limit, and a fragmentation estimate. Backends
+without allocator stats (CPU in CI) fall back to summing live jax arrays
+per device, so the series exist — and the leak watchdog works — on every
+platform the tests run on.
+
+**Per-subsystem attribution**: ``register_subsystem(name, fn)`` binds a
+byte-counting callable (model pool residency, retrieval index bytes, serve
+trace-ring bytes...) into ``jimm_hbm_subsystem_{name}_bytes`` so "where
+did HBM go" decomposes the same way goodput decomposes wall time.
+
+**Leak watchdog**: when total in-use bytes grow monotonically across
+``leak_window`` consecutive samples by at least ``leak_min_growth_frac``
+(and ``leak_min_growth_bytes``), it journals ``hbm_leak_suspected`` with a
+fresh correlation id and the subsystem snapshot — once per episode; any
+decrease closes the episode. The cid threads into a deep capture the same
+way serve incidents do.
+
+jax is imported lazily inside :meth:`sample` so importing the module (and
+the jax-free ``obs prof`` CLI verbs) never drags in the runtime.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from jimm_tpu.obs.journal import get_journal, new_correlation_id
+from jimm_tpu.obs.registry import get_registry
+
+__all__ = ["MemoryMonitor", "device_memory_rows"]
+
+
+def device_memory_rows() -> list[dict]:
+    """One row per jax device: allocator stats when the backend exposes
+    them, live-array accounting otherwise. Each row carries ``source``
+    ("allocator" | "live_arrays") so consumers know the fidelity."""
+    import jax
+
+    live_by_device: dict = {}
+    rows = []
+    devices = jax.devices()
+    need_live = any(_stats_of(d) is None for d in devices)
+    if need_live:
+        for arr in jax.live_arrays():
+            for shard in getattr(arr, "addressable_shards", []):
+                nbytes = getattr(shard.data, "nbytes", 0)
+                live_by_device[shard.device] = \
+                    live_by_device.get(shard.device, 0) + int(nbytes)
+    for i, dev in enumerate(devices):
+        stats = _stats_of(dev)
+        if stats is not None:
+            in_use = int(stats.get("bytes_in_use", 0))
+            limit = int(stats.get("bytes_limit", 0) or
+                        stats.get("bytes_reservable_limit", 0))
+            peak = int(stats.get("peak_bytes_in_use", in_use))
+            free = max(0, limit - in_use) if limit else 0
+            largest = int(stats.get("largest_free_block_bytes", 0))
+            # classic allocator fragmentation estimate: the share of free
+            # memory NOT in the largest free block — 0 when contiguous
+            frag = (1.0 - largest / free) if (free and largest) else 0.0
+            rows.append({"device": i, "platform": dev.platform,
+                         "source": "allocator", "bytes_in_use": in_use,
+                         "peak_bytes_in_use": peak, "bytes_limit": limit,
+                         "fragmentation": round(max(0.0, frag), 4)})
+        else:
+            rows.append({"device": i, "platform": dev.platform,
+                         "source": "live_arrays",
+                         "bytes_in_use": live_by_device.get(dev, 0),
+                         "peak_bytes_in_use": 0, "bytes_limit": 0,
+                         "fragmentation": 0.0})
+    return rows
+
+
+def _stats_of(dev) -> dict | None:
+    try:
+        stats = dev.memory_stats()
+    except Exception:  # noqa: BLE001 — a backend without allocator stats raises or returns None; both mean "fall back"
+        return None
+    return stats if isinstance(stats, dict) and stats else None
+
+
+class MemoryMonitor:
+    """Periodic HBM sampler + leak watchdog publishing ``jimm_hbm_*``.
+
+    ``sample()`` is callable directly (train loop, tests); ``start()``
+    spawns a daemon polling thread for serving processes."""
+
+    def __init__(self, *, period_s: float = 10.0, leak_window: int = 5,
+                 leak_min_growth_frac: float = 0.05,
+                 leak_min_growth_bytes: int = 1 << 20,
+                 journal=None, sampler: Callable[[], list[dict]]
+                 | None = None):
+        self.period_s = float(period_s)
+        self.leak_window = max(2, int(leak_window))
+        self.leak_min_growth_frac = float(leak_min_growth_frac)
+        self.leak_min_growth_bytes = int(leak_min_growth_bytes)
+        self._journal = journal
+        self._sampler = sampler or device_memory_rows
+        self._subsystems: dict[str, Callable[[], float]] = {}
+        self._lock = threading.Lock()
+        self._last: dict[str, float] = {}
+        self._bound: set[str] = set()
+        self._totals: deque[float] = deque(maxlen=self.leak_window + 1)
+        self._leak_open = False
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._reg = get_registry("jimm_hbm")
+        self._samples_total = self._reg.counter("samples_total")
+        self._leaks_total = self._reg.counter("leak_suspected_total")
+        self.last_leak_cid: str | None = None
+
+    def register_subsystem(self, name: str,
+                           fn: Callable[[], float]) -> None:
+        """Attribute bytes to a named subsystem (model pool, retrieval
+        index, serve buffers). ``fn`` returns current bytes; it is called
+        at sample time and a raising fn reports 0 (attribution must never
+        break sampling)."""
+        self._subsystems[name] = fn
+
+    def _gauge(self, key: str, value: float) -> None:
+        self._last[key] = float(value)
+        if key not in self._bound:
+            self._bound.add(key)
+            self._reg.gauge(key, lambda k=key: self._last.get(k, 0.0))
+
+    def sample(self) -> dict:
+        """One sampling pass: refresh every gauge, run the leak check.
+        Returns ``{"devices": rows, "total_bytes_in_use": n,
+        "subsystems": {...}, "leak_suspected": bool}``."""
+        rows = self._sampler()
+        with self._lock:
+            total = 0
+            for row in rows:
+                i = row["device"]
+                total += row["bytes_in_use"]
+                self._gauge(f"device{i}_bytes_in_use",
+                            row["bytes_in_use"])
+                self._gauge(f"device{i}_peak_bytes_in_use",
+                            row["peak_bytes_in_use"])
+                self._gauge(f"device{i}_bytes_limit", row["bytes_limit"])
+                self._gauge(f"device{i}_fragmentation",
+                            row["fragmentation"])
+            self._gauge("total_bytes_in_use", total)
+            subsystems = {}
+            for name, fn in self._subsystems.items():
+                try:
+                    subsystems[name] = float(fn())
+                except Exception:  # noqa: BLE001 — attribution is best-effort; a broken counter must not kill the sampler
+                    subsystems[name] = 0.0
+                self._gauge(f"subsystem_{name}_bytes", subsystems[name])
+            self._samples_total.inc()
+            leak = self._check_leak(total, subsystems)
+        return {"devices": rows, "total_bytes_in_use": total,
+                "subsystems": subsystems, "leak_suspected": leak}
+
+    def _check_leak(self, total: float, subsystems: dict) -> bool:
+        self._totals.append(total)
+        if len(self._totals) < self._totals.maxlen:
+            return self._leak_open
+        deltas = [b - a for a, b in zip(self._totals,
+                                        list(self._totals)[1:])]
+        if any(d <= 0 for d in deltas):
+            self._leak_open = False  # any decrease closes the episode
+            return False
+        growth = self._totals[-1] - self._totals[0]
+        base = self._totals[0] or 1.0
+        if growth < self.leak_min_growth_bytes \
+                or growth / base < self.leak_min_growth_frac:
+            return self._leak_open
+        if self._leak_open:
+            return True  # one journal record per episode
+        self._leak_open = True
+        self._leaks_total.inc()
+        cid = new_correlation_id()
+        self.last_leak_cid = cid
+        journal = self._journal if self._journal is not None \
+            else get_journal()
+        journal.emit("hbm_leak_suspected", cid=cid,
+                     growth_bytes=int(growth),
+                     window=self.leak_window,
+                     total_bytes_in_use=int(total),
+                     subsystems={k: int(v) for k, v in subsystems.items()})
+        return True
+
+    # -- background polling -----------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="jimm-hbm-monitor",
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.sample()
+            except Exception:  # noqa: BLE001 — a transient backend error must not end monitoring; the next tick retries
+                time.sleep(0.0)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,6 +1,6 @@
 """Perfetto/Chrome-trace timeline export: journal + serve traces + goodput.
 
-Merges three sources into one ``chrome://tracing`` / Perfetto-loadable
+Merges four sources into one ``chrome://tracing`` / Perfetto-loadable
 JSON object (the `Trace Event Format`_):
 
 - **journal events** — instant ("i") markers on per-subsystem lanes, or
@@ -14,6 +14,10 @@ JSON object (the `Trace Event Format`_):
   per-bucket spans on a synthetic ``goodput`` lane (relative placement:
   buckets are cumulative ledgers, not intervals, so the lane shows
   proportions, anchored at the trace origin).
+- **profiler captures** — committed capture metas from the continuous
+  profiling ring (``obs prof``): each capture window becomes an "X" span
+  on the ``prof`` lane, carrying its incident cid, so a deep capture sits
+  visually under the heal/replan/SLO event that triggered it.
 
 All timestamps share the ``time.monotonic()`` clock the journal and the
 serve dispatcher stamp, shifted so the earliest event sits at t=0 (Chrome
@@ -29,8 +33,9 @@ import json
 from pathlib import Path
 
 __all__ = [
-    "export_timeline", "goodput_to_trace_events", "journal_to_trace_events",
-    "traces_to_trace_events", "validate_chrome_trace", "write_timeline",
+    "captures_to_trace_events", "export_timeline", "goodput_to_trace_events",
+    "journal_to_trace_events", "traces_to_trace_events",
+    "validate_chrome_trace", "write_timeline",
 ]
 
 _PID = 1
@@ -42,6 +47,7 @@ _LANES = (
       "mesh", "restore"), "train"),
     (("replica", "heal", "replan", "probe", "revive", "slo"), "serve"),
     (("advisor",), "advisor"),
+    (("prof", "hbm"), "prof"),
 )
 
 
@@ -128,6 +134,37 @@ def traces_to_trace_events(rows: list[dict], *,
     return out
 
 
+def captures_to_trace_events(metas: list[dict], *,
+                             t0: float | None = None) -> list[dict]:
+    """Committed capture metas (``list_captures``) -> spans on the ``prof``
+    lane. Metas stamp ``start_mono``/``end_mono`` on the same monotonic
+    clock the journal uses, so a deep capture lines up under the heal or
+    replan that triggered it; ``args.cid`` makes the incident searchable
+    from the capture span too."""
+    usable = [m for m in metas
+              if isinstance(m.get("start_mono"), (int, float))
+              and isinstance(m.get("end_mono"), (int, float))]
+    if not usable:
+        return []
+    if t0 is None:
+        t0 = min(m["start_mono"] for m in usable)
+    out = []
+    for m in usable:
+        out.append({
+            "name": f"capture:{m.get('kind', 'window')}",
+            "ph": "X",
+            "pid": _PID,
+            "tid": "prof",
+            "cat": "prof",
+            "ts": max(0.0, m["start_mono"] - t0) * _US,
+            "dur": max(0.0, m["end_mono"] - m["start_mono"]) * _US,
+            "args": {"cid": m.get("cid"), "capture": m.get("name"),
+                     "kind": m.get("kind"), "reason": m.get("reason"),
+                     "bytes": m.get("bytes"), "step": m.get("step")},
+        })
+    return out
+
+
 def goodput_to_trace_events(buckets: dict[str, float], *,
                             t0_us: float = 0.0) -> list[dict]:
     """A ``{bucket: seconds}`` ledger -> consecutive spans on one lane."""
@@ -147,6 +184,7 @@ def goodput_to_trace_events(buckets: dict[str, float], *,
 
 def export_timeline(journal_events: list[dict], *,
                     traces: list[dict] = (),
+                    captures: list[dict] = (),
                     goodput: dict[str, float] | None = None,
                     meta: dict | None = None) -> dict:
     """Merge all sources into one Chrome trace object.
@@ -157,9 +195,12 @@ def export_timeline(journal_events: list[dict], *,
              if isinstance(e.get("mono"), (int, float))]
     monos += [r["done_mono"] - r.get("total_s", 0.0) for r in traces
               if isinstance(r.get("done_mono"), (int, float))]
+    monos += [m["start_mono"] for m in captures
+              if isinstance(m.get("start_mono"), (int, float))]
     t0 = min(monos) if monos else 0.0
     events = journal_to_trace_events(journal_events, t0=t0)
     events += traces_to_trace_events(list(traces), t0=t0)
+    events += captures_to_trace_events(list(captures), t0=t0)
     if goodput:
         events += goodput_to_trace_events(goodput)
     tids = sorted({e["tid"] for e in events})

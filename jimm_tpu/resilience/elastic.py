@@ -200,7 +200,12 @@ class GoodputAdvisor:
         # the audit trail: journaled (joining the active incident's chain
         # when one is ambient), echoed as the legacy parseable line only
         # for injected sinks (tests, supervise transcripts)
-        get_journal().emit("advisor_decision", **decision)
+        rec = get_journal().emit("advisor_decision", **decision)
+        # an advisor notch means goodput is measurably degrading — worth a
+        # deep profiler capture on the same incident chain (no-op unless a
+        # capture ring is configured)
+        from jimm_tpu.obs.prof.capture import maybe_trigger
+        maybe_trigger(rec.get("cid"), "advisor_" + str(decision["knob"]))
         if self._emit is not None:
             self._emit("goodput_advisor_decision: " + json.dumps(decision))
 

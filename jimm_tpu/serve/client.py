@@ -12,11 +12,26 @@ from __future__ import annotations
 import base64
 import dataclasses
 import http.client
+import itertools
 import json
+import os
 import threading
 import time
 
 from jimm_tpu.resilience.backoff import BackoffPolicy  # stdlib-only module
+
+_trace_counter = itertools.count(1)
+_trace_lock = threading.Lock()
+
+
+def client_trace_id() -> str:
+    """Client-minted end-to-end trace id, sent as ``X-Jimm-Trace-Id``. The
+    server inherits it into its journal records and trace ring, so one id
+    threads client retry → admission → replica dispatch → capture. Prefixed
+    with the client pid so ids from a client herd never collide."""
+    with _trace_lock:
+        n = next(_trace_counter)
+    return f"tc{os.getpid():x}-{n:06x}"
 
 #: cascade response headers (mirrors serve.cascade.router — spelled out
 #: here because this module must stay stdlib-only importable)
@@ -197,6 +212,11 @@ class ServeClient:
             headers["X-Jimm-Tenant"] = self.tenant
         if self.model is not None:
             headers["X-Jimm-Model"] = self.model
+        if body:
+            # one id for the whole logical request, retries included — the
+            # server inherits it (see server.request_trace_id) so every
+            # attempt journals under the same identity
+            headers["X-Jimm-Trace-Id"] = client_trace_id()
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
         fresh_failures = 0

@@ -31,6 +31,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import functools
+import json
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -46,6 +47,15 @@ from jimm_tpu.serve.admission import (AdmissionController, AdmissionPolicy,
 from jimm_tpu.serve.buckets import BucketTable, default_buckets, pad_batch
 
 _STOP = object()
+
+
+def _prof_trigger(cid: str | None, reason: str) -> None:
+    """Deep profiler capture on an incident cid — a no-op unless a global
+    capture manager is configured (``--prof-dir`` / ``JIMM_PROF_DIR``),
+    and deduped per cid inside the manager so heal + replan + SLO burn on
+    one incident yield one capture."""
+    from jimm_tpu.obs.prof.capture import maybe_trigger
+    maybe_trigger(cid, reason)
 
 
 def counting_forward(model, method: str = "encode_image"
@@ -155,7 +165,8 @@ class InferenceEngine:
                  policy: AdmissionPolicy | None = None,
                  metrics: ServeMetrics | None = None,
                  trace_count: Callable[[], int] | None = None,
-                 qos=None):
+                 qos=None, recent_traces_entries: int = 64,
+                 recent_traces_max_bytes: int = 64 << 10):
         # A list of forwards means explicit replicas (topology-planned
         # serving); a bare callable is the classic single-replica engine.
         # The per-replica jimm_serve_replica_* series exist only in the
@@ -221,8 +232,19 @@ class InferenceEngine:
         self.slo = None
         self._slo_burning: set = set()
         # Per-request phase decomposition (trace id -> phase seconds),
-        # newest last; read by /healthz debugging and tests.
-        self.recent_traces: deque[dict] = deque(maxlen=64)
+        # newest last; read by /healthz debugging and tests. Bounded by
+        # entries AND bytes: a long incident producing fat rows (big
+        # tenant ids, cascade metadata) must not grow host memory — the
+        # byte cap evicts oldest and counts each drop.
+        self.recent_traces: deque[dict] = deque()
+        self._trace_sizes: deque[int] = deque()
+        self._traces_bytes = 0
+        self.recent_traces_entries = int(recent_traces_entries)
+        self.recent_traces_max_bytes = int(recent_traces_max_bytes)
+        # pre-created at zero so "never dropped" is visible in scrapes
+        self.metrics.inc("traces_dropped_total", 0)
+        self.metrics.bind_gauge("recent_traces_bytes",
+                                lambda: float(self._traces_bytes))
         # bucket -> {"seconds", "source"} filled by warmup_blocking;
         # source is "compile" (plain forward) or the AOT outcome
         # ("aot"/"miss"/"fallback") when the forward is store-backed.
@@ -247,6 +269,25 @@ class InferenceEngine:
                                 lambda r=replica: float(r.inflight))
         self.metrics.bind_gauge(f"replica_{i}_device_seconds",
                                 lambda r=replica: round(r.device_s, 6))
+
+    def _record_trace(self, row: dict) -> None:
+        """Append to the debug trace ring under both bounds (entry count
+        and serialized bytes), counting evictions in
+        ``jimm_serve_traces_dropped_total``. Loop-confined (called from
+        dispatch coroutines only), so the bookkeeping needs no lock."""
+        try:
+            size = len(json.dumps(row, default=str))
+        except (TypeError, ValueError):
+            size = 256  # unserializable row: charge a nominal size
+        self.recent_traces.append(row)
+        self._trace_sizes.append(size)
+        self._traces_bytes += size
+        while len(self.recent_traces) > 1 and (
+                len(self.recent_traces) > self.recent_traces_entries
+                or self._traces_bytes > self.recent_traces_max_bytes):
+            self.recent_traces.popleft()
+            self._traces_bytes -= self._trace_sizes.popleft()
+            self.metrics.inc("traces_dropped_total")
 
     def replica_stats(self) -> list[dict]:
         """Per-replica load snapshot (healthz payload and the sharded serve
@@ -331,8 +372,20 @@ class InferenceEngine:
         request (success, forward error, deadline timeout) becomes one
         per-tenant availability/latency observation, and a tenant entering
         fast burn escalates into the self-heal path (see
-        :meth:`_slo_check_escalate`)."""
+        :meth:`_slo_check_escalate`) and triggers a deep profiler capture
+        on the incident's correlation id (via the burn-transition listener
+        hook) — the capture of *why the burn happened* starts while the
+        anomaly is still live, not after a human reads the page."""
         self.slo = slo
+        slo.add_listener(self._on_burn_transition_capture)
+
+    def _on_burn_transition_capture(self, tenant, entered: bool,
+                                    fast: float, slow: float) -> None:
+        if not entered:
+            return
+        dead = [r for r in self._replicas if r.dead]
+        _prof_trigger(dead[0].incident_cid if dead else None,
+                      "slo_fast_burn")
 
     def _observe_slo(self, req, ok: bool, latency_s: float | None) -> None:
         if self.slo is None:
@@ -391,6 +444,9 @@ class InferenceEngine:
         ok = await loop.run_in_executor(None, self._probe_blocking, replica)
         get_journal().emit("heal_probe", cid=cid, replica=replica.index,
                            ok=ok)
+        # deep profiler capture on the incident cid: the heal window is
+        # exactly when the degraded topology's behavior is capturable
+        _prof_trigger(cid, "heal")
         if ok:
             # the fault was transient (wedged thread, recovered device):
             # the lane still computes, so un-fence it in place
@@ -465,6 +521,7 @@ class InferenceEngine:
         get_journal().emit("replan_started", cid=cid,
                            replicas_to=len(forwards),
                            replicas_from=len(self._replicas))
+        _prof_trigger(cid, "replan")
         async with self._replan_lock:
             loop = asyncio.get_running_loop()
             if warm:
@@ -852,7 +909,7 @@ class InferenceEngine:
                 self.metrics.inc("responses_total")
                 self.metrics.observe_latency(done - req.t0)
                 self._observe_slo(req, True, done - req.t0)
-                self.recent_traces.append({
+                self._record_trace({
                     "trace_id": req.rid,
                     "replica": replica.index,
                     "bucket": bucket,

@@ -44,6 +44,17 @@ from jimm_tpu.serve.cache import (EmbeddingCache, class_embedding_cache,
 from jimm_tpu.serve.engine import InferenceEngine
 
 
+def request_trace_id(payload: dict) -> str:
+    """The request's trace id: inherit the client's ``X-Jimm-Trace-Id``
+    (folded into the payload by the handler) when it looks sane, else mint
+    one. Wire-supplied ids are untrusted text — bound the length so a
+    hostile header can't bloat journal records or the trace ring."""
+    tid = payload.get("trace_id")
+    if isinstance(tid, str) and 0 < len(tid) <= 64:
+        return tid
+    return new_trace_id()
+
+
 def decode_image_payload(payload: dict, *, dtype=np.float32) -> np.ndarray:
     """Pull the image array out of a request body (list or b64 form)."""
     if "image" in payload:
@@ -196,6 +207,13 @@ class _Handler(BaseHTTPRequestHandler):
             model = self.headers.get("X-Jimm-Model")
             if model is not None:
                 payload.setdefault("model", model)
+            # client-minted trace identity: one id threads client retry ->
+            # admission -> replica dispatch -> journal/capture, so a slow
+            # request is profilable end to end (FastUSP-style multi-level
+            # correlation)
+            trace_id = self.headers.get("X-Jimm-Trace-Id")
+            if trace_id is not None:
+                payload.setdefault("trace_id", trace_id)
             if self.path == "/v1/embed":
                 out = app.embed(payload)
                 # cascade routing metadata travels as response headers so
@@ -208,6 +226,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, app.search(payload))
             elif self.path == "/admin/revive":
                 self._send_json(200, app.revive(payload))
+            elif self.path == "/admin/prof/trigger":
+                self._send_json(200, app.prof_trigger(payload))
             else:
                 self._send_json(404, {"error": "not_found",
                                       "message": self.path})
@@ -406,7 +426,7 @@ class ServingServer:
         return future.result(timeout=self.request_timeout_s)
 
     def embed(self, payload: dict) -> dict:
-        rid = new_trace_id()
+        rid = request_trace_id(payload)
         engine = self._engine_for(payload.get("model"))
         tenant = payload.get("tenant")
         if "images" in payload:
@@ -445,7 +465,7 @@ class ServingServer:
         if self.retrieval is None:
             raise RequestError("this server has no retrieval index "
                                "(start with serve --index)")
-        rid = new_trace_id()
+        rid = request_trace_id(payload)
         if "vector" in payload:
             try:
                 query = np.asarray(payload["vector"], np.float32)
@@ -486,7 +506,7 @@ class ServingServer:
         if self.zero_shot is None:
             raise RequestError("this server has no zero-shot service "
                                "(started without a text tower)")
-        rid = new_trace_id()
+        rid = request_trace_id(payload)
         tokens = payload.get("tokens")
         if not isinstance(tokens, dict) or not tokens:
             raise RequestError("classify needs 'tokens': {label: [ids]}")
@@ -556,6 +576,33 @@ class ServingServer:
             loop.call_soon_threadsafe(loop.stop)
             thread.join(timeout=5.0)
             loop.close()
+
+    def prof_trigger(self, payload: dict) -> dict:
+        """``POST /admin/prof/trigger`` — kick a deep profiler capture on a
+        caller-supplied incident cid (``jimm-tpu obs prof trigger``). The
+        capture manager is process-global (``serve --prof-dir`` or
+        ``JIMM_PROF_DIR``); a server without one is a 400, not a silent
+        no-op, so drills notice a misconfigured box."""
+        from jimm_tpu.obs.prof.capture import get_capture_manager
+        mgr = get_capture_manager()
+        if mgr is None:
+            raise RequestError("this server has no capture manager "
+                               "(start with serve --prof-dir, or set "
+                               "JIMM_PROF_DIR)")
+        cid = payload.get("cid")
+        if cid is not None and not isinstance(cid, str):
+            raise RequestError("'cid' must be a string")
+        reason = payload.get("reason", "admin")
+        if not isinstance(reason, str):
+            raise RequestError("'reason' must be a string")
+        window_s = payload.get("window_s")
+        if window_s is not None and not isinstance(window_s, (int, float)):
+            raise RequestError("'window_s' must be a number")
+        meta = mgr.trigger(cid, reason,
+                           window_s=float(window_s) if window_s else None)
+        if meta is None:
+            return {"triggered": False, "suppressed": True}
+        return {"triggered": True, "capture": meta}
 
     def metrics_text(self) -> str:
         """Unified Prometheus dump for ``/metrics``: this server's

@@ -1,20 +1,24 @@
 """Profiling hooks (SURVEY §5 tracing row): `jax.profiler` trace capture
 around training steps, viewable in TensorBoard / Perfetto — plus an
 offline per-op analyzer so a capture can be read without TensorBoard (the
-workflow behind docs/performance.md; `python -m jimm_tpu profile-analyze`)."""
+workflow behind docs/performance.md; `python -m jimm_tpu profile-analyze`).
+
+Since the continuous profiler landed, :func:`trace` delegates to
+:func:`jimm_tpu.obs.prof.capture.profiler_session` — the process-wide
+sanctioned ``start_trace``/``stop_trace`` home (lint JL022) — so a
+one-shot ``--profile-dir`` capture and the ``--prof-ring`` continuous ring
+can never double-start the profiler. The parsing core lives jax-free in
+:mod:`jimm_tpu.obs.prof.opstats`; this module keeps the :class:`OpStat`
+shape the CLI and tests consume."""
 
 from __future__ import annotations
 
 import collections
-import glob
-import gzip
-import json
-import re
 from contextlib import contextmanager
 from dataclasses import dataclass
 from pathlib import Path
 
-import jax
+from jimm_tpu.obs.prof.opstats import op_table
 
 
 @contextmanager
@@ -25,26 +29,20 @@ def trace(log_dir: str | Path, *, host_tracer_level: int = 2):
             for _ in range(5):
                 train_step(...)
     """
-    Path(log_dir).mkdir(parents=True, exist_ok=True)
-    jax.profiler.start_trace(str(log_dir))
-    try:
+    from jimm_tpu.obs.prof.capture import profiler_session
+    with profiler_session(log_dir):
         yield
-    finally:
-        jax.profiler.stop_trace()
 
 
 def annotate(name: str):
     """Named region that shows up in the trace timeline."""
+    import jax
     return jax.profiler.TraceAnnotation(name)
 
 
 # ---------------------------------------------------------------------------
 # Offline trace analysis
 # ---------------------------------------------------------------------------
-
-#: container/framework events that would double-count their children
-_NON_OP = re.compile(r"^(while\.|jit_|\d+$|SyncOnDone|.*Module)")
-
 
 @dataclass
 class OpStat:
@@ -75,58 +73,7 @@ def op_stats(log_dir: str | Path, *, device: int | None = 0) -> list[OpStat]:
     ``device`` picks ONE device pid (default: the first) — under SPMD every
     core runs the same program, and summing across cores would report
     n_devices times the per-step time. ``None`` aggregates all devices."""
-    paths = sorted(glob.glob(str(Path(log_dir) / "**" / "*.trace.json.gz"),
-                             recursive=True))
-    if not paths:
-        raise FileNotFoundError(f"no *.trace.json.gz under {log_dir}")
-    with gzip.open(paths[-1], "rt") as f:
-        events = json.load(f)["traceEvents"]
-
-    pids = {e["pid"]: e["args"].get("name", "")
-            for e in events if e.get("ph") == "M"
-            and e.get("name") == "process_name"}
-    tnames = {(e["pid"], e["tid"]): e["args"].get("name", "")
-              for e in events if e.get("ph") == "M"
-              and e.get("name") == "thread_name"}
-    device_pids = {p for p, n in pids.items() if n.startswith("/device:")}
-    if device_pids and device is not None:
-        device_pids = {sorted(device_pids)[device]}
-    if not device_pids:  # CPU-only capture: ops run inside the host process
-        device_pids = set(pids)
-
-    def is_op_lane(lane: str) -> bool:
-        # TPU: per-core "XLA Ops" lanes; CPU: tf_XLAEigen/... executor
-        # threads. Everything else (python host frames, "Steps", module
-        # lanes) would double-count or pollute the aggregation.
-        return "XLA Ops" in lane or lane.startswith("tf_XLA")
-
-    have_op_lanes = any(is_op_lane(n) for n in tnames.values())
-
-    agg: dict[str, list] = {}
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in device_pids:
-            continue
-        lane = tnames.get((e["pid"], e["tid"]), "")
-        if have_op_lanes:
-            if not is_op_lane(lane):
-                continue
-        elif lane == "python":
-            continue
-        if _NON_OP.match(e["name"]):
-            continue
-        a = e.get("args", {})
-        r = agg.setdefault(e["name"], [0.0, 0, 0, "", a.get("hlo_category",
-                                                            "?")])
-        r[0] += e.get("dur", 0)
-        r[1] += 1
-        r[2] += int(a.get("bytes_accessed", 0) or 0)
-        r[3] = r[3] or a.get("long_name", "")
-
-    stats = [OpStat(name=k, category=v[4], total_us=v[0], count=v[1],
-                    bytes_accessed=v[2], long_name=v[3])
-             for k, v in agg.items()]
-    stats.sort(key=lambda s: -s.total_us)
-    return stats
+    return [OpStat(**row) for row in op_table(log_dir, device=device)]
 
 
 def summarize(stats: list[OpStat], top: int = 25, steps: int = 1) -> str:

@@ -15,6 +15,10 @@ watchdog mints the incident cid and the smoke asserts ``replica_fault →
 replica_fenced → heal_probe → heal_rebuilt → replan_started →
 replan_done`` all carry it, with ``dur_s`` on the heal/replan spans and
 wall time booked into the ``goodput_heal`` / ``goodput_replan`` buckets.
+With a capture ring configured, the heal path also auto-triggers a deep
+profiler capture on the SAME incident cid — the smoke asserts
+``prof_capture_started``/``prof_capture_committed`` join the chain and
+the committed artifact's ``meta.json`` carries the cid.
 
 **Timeline leg.** ``export_timeline`` over the full journal plus the
 engine's ``recent_traces`` must validate with zero problems and cover both
@@ -96,8 +100,10 @@ def train_leg(tmp: Path, journal: Path) -> tuple[str | None, dict]:
     return None, {"cid": cid, "chain_len": len(incident)}
 
 
-def serve_leg(journal: Path) -> tuple[str | None, dict, list[dict]]:
+def serve_leg(journal: Path,
+              prof_dir: Path) -> tuple[str | None, dict, list[dict]]:
     import asyncio
+    import time
 
     import numpy as np
     from flax import nnx
@@ -106,8 +112,14 @@ def serve_leg(journal: Path) -> tuple[str | None, dict, list[dict]]:
     from jimm_tpu.aot import ArtifactStore
     from jimm_tpu.cli import _tiny_override
     from jimm_tpu.obs.journal import chain, read_events
+    from jimm_tpu.obs.prof.capture import configure_capture, reset_capture
     from jimm_tpu.serve import (BucketTable, InferenceEngine,
                                 build_replica_forwards, plan_topology)
+
+    # deep captures on incidents: the heal path maybe_trigger()s into this
+    # ring, tagging the capture with the incident cid
+    prof_mgr = configure_capture(prof_dir, deep_window_s=0.3,
+                                 min_trigger_interval_s=0.0)
 
     cfg = _tiny_override(preset("clip-vit-base-patch16"))
     model = CLIP(cfg, rngs=nnx.Rngs(0))
@@ -167,7 +179,17 @@ def serve_leg(journal: Path) -> tuple[str | None, dict, list[dict]]:
         err = asyncio.run(drive())
         rows = list(engine.recent_traces)
         if err:
+            reset_capture()
             return f"serve leg: {err}", {}, rows
+
+        # the deep capture commits on its window timer; wait it out, then
+        # drop the global manager so later legs see a clean slate
+        deadline = time.monotonic() + 10.0
+        while not prof_mgr.ls() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        prof_mgr.flush()
+        captures = prof_mgr.ls()
+        reset_capture()
 
         events = read_events(journal)
         faults = [e for e in events if e["event"] == "replica_fault"
@@ -192,9 +214,22 @@ def serve_leg(journal: Path) -> tuple[str | None, dict, list[dict]]:
                     f"(heal={heal_s}, replan={replan_s})"), {}, rows
         if not rows or not any("done_mono" in r for r in rows):
             return "recent_traces rows carry no done_mono anchor", {}, rows
+        # the incident's deep capture: journaled on the SAME root cid,
+        # and the committed artifact's meta agrees
+        chain_events = [e["event"] for e in incident]
+        for ev in ("prof_capture_started", "prof_capture_committed"):
+            if ev not in chain_events:
+                return (f"{ev} missing from incident chain {cid}: "
+                        f"{chain_events}"), {}, rows
+        tagged = [c for c in captures if c.get("cid") == cid]
+        if not tagged:
+            return (f"no committed capture carries the incident cid {cid}: "
+                    f"{[c.get('cid') for c in captures]}"), {}, rows
         return None, {"cid": cid, "chain_len": len(incident),
                       "goodput_heal_s": round(heal_s, 4),
-                      "goodput_replan_s": round(replan_s, 4)}, rows
+                      "goodput_replan_s": round(replan_s, 4),
+                      "deep_capture": tagged[0]["name"],
+                      "capture_bytes": tagged[0]["bytes"]}, rows
 
 
 def timeline_leg(tmp: Path, journal: Path, rows: list[dict],
@@ -266,7 +301,7 @@ def main() -> int:
     err, train_summary = train_leg(tmp, journal)
     if err:
         return fail(f"train leg: {err}")
-    err, serve_summary, rows = serve_leg(journal)
+    err, serve_summary, rows = serve_leg(journal, tmp / "prof")
     if err:
         return fail(f"serve leg: {err}")
     err, timeline_summary = timeline_leg(

@@ -1,0 +1,35 @@
+"""JL022 fixture: direct jax.profiler session control outside obs/prof —
+racing the continuous capture ring for the process's ONE profiler session."""
+
+import jax
+from jax.profiler import start_trace, stop_trace
+
+
+def profile_a_few_steps(step_fn, log_dir):
+    jax.profiler.start_trace(log_dir)       # JL022: attribute spelling
+    for _ in range(3):
+        step_fn()
+    jax.profiler.stop_trace()               # JL022: same hole on the way out
+
+
+def profile_imported(step_fn, log_dir):
+    start_trace(log_dir)                    # JL022: from-import spelling
+    step_fn()
+    stop_trace()                            # JL022
+
+
+def sanctioned_direct(log_dir):
+    # ok: justified direct session (a standalone harness with no ring)
+    jax.profiler.start_trace(log_dir)  # jaxlint: disable=JL022 ringless one-off harness
+
+
+def sanctioned_session(step_fn, log_dir):
+    # ok: the ring's session lock serializes this against window captures
+    from jimm_tpu.obs.prof.capture import profiler_session
+    with profiler_session(log_dir):
+        step_fn()
+
+
+def annotations_stay_legal(name):
+    # ok: TraceAnnotation is session-agnostic — no session claimed
+    return jax.profiler.TraceAnnotation(name)

@@ -455,6 +455,35 @@ class TestRuleFixtures:
         assert check_cascade_thresholds(
             tree, "tests/test_cascade.py") == []
 
+    def test_jl022_profiler_bypass(self):
+        findings = findings_for("train/bad_profiler.py")
+        assert rules_and_lines(findings) == {
+            ("JL022", 9),   # jax.profiler.start_trace(log_dir)
+            ("JL022", 12),  # jax.profiler.stop_trace()
+            ("JL022", 16),  # start_trace(log_dir) — from-import spelling
+            ("JL022", 18),  # stop_trace()
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert any("profiler_session" in f.message for f in findings)
+        # the disabled direct call, the profiler_session route, and the
+        # session-agnostic TraceAnnotation all stay clean
+
+    def test_jl022_scoped_to_outside_obs_prof(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_profiler_bypass
+        src = "import jax\njax.profiler.start_trace('/tmp/x')\n"
+        tree = ast.parse(src)
+        assert check_profiler_bypass(
+            tree, "jimm_tpu/train/profile.py") != []
+        assert check_profiler_bypass(
+            tree, "jimm_tpu/serve/engine.py") != []
+        # the sanctioned session owner and tests are exempt
+        assert check_profiler_bypass(
+            tree, "jimm_tpu/obs/prof/capture.py") == []
+        assert check_profiler_bypass(
+            tree, "tests/test_profile.py") == []
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings
