@@ -1746,6 +1746,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     zero_shot = (ZeroShotService(model, model_key=model_key)
                  if fam in ("clip", "siglip") else None)
     retrieval = None
+    index_daemon = None
     if args.index:
         if not args.index_store:
             raise SystemExit("--index needs --index-store (the vector "
@@ -1757,7 +1758,21 @@ def cmd_serve(args: argparse.Namespace) -> int:
         retrieval = RetrievalService.from_store(
             vstore, args.index, k=args.search_k, plan=plan,
             aot_store=store, mode=args.index_mode, nprobe=args.nprobe,
-            nprobe_max=args.nprobe_max)
+            nprobe_max=args.nprobe_max,
+            device_budget_bytes=(args.tier_device_budget_mb << 20
+                                 if args.tier_device_budget_mb is not None
+                                 else None),
+            host_budget_bytes=(args.tier_host_budget_mb << 20
+                               if args.tier_host_budget_mb is not None
+                               else None))
+        if args.tier_daemon_interval is not None:
+            if args.index_mode != "tiered":
+                raise SystemExit("--tier-daemon-interval needs "
+                                 "--index-mode tiered")
+            from jimm_tpu.retrieval.tier import IndexDaemon
+            index_daemon = IndexDaemon(vstore, args.index,
+                                       retrieval.searcher)
+            index_daemon.start(args.tier_daemon_interval)
     elif args.index_store:
         raise SystemExit("--index-store needs --index (the index name)")
     logger = None
@@ -1788,9 +1803,16 @@ def cmd_serve(args: argparse.Namespace) -> int:
             "serve_buffers", lambda: float(engine._traces_bytes))
         if retrieval is not None:
             info = retrieval.describe()
-            monitor.register_subsystem(
-                "retrieval_index",
-                lambda r=info["rows"], d=info["dim"]: float(r * d * 4))
+            if info["mode"] == "tiered":
+                # tiered residency: report the (flat) hot-arena bytes,
+                # not the corpus size the budget exists to decouple from
+                monitor.register_subsystem(
+                    "retrieval_index",
+                    lambda s=retrieval.searcher: float(s.resident_bytes()))
+            else:
+                monitor.register_subsystem(
+                    "retrieval_index",
+                    lambda r=info["rows"], d=info["dim"]: float(r * d * 4))
         monitor.start()
     server = ServingServer(engine, zero_shot=zero_shot,
                            retrieval=retrieval, host=args.host,
@@ -1824,10 +1846,15 @@ def cmd_serve(args: argparse.Namespace) -> int:
                               "block_n": info["block_n"],
                               "partitions": info["partitions"],
                               "mode": info["mode"]}
-        if info["mode"] == "ivf":
+        if info["mode"] in ("ivf", "tiered"):
             ready["retrieval"]["nprobe"] = info["nprobe"]
             ready["retrieval"]["nprobe_max"] = info["nprobe_max"]
             ready["retrieval"]["clusters"] = info["clusters"]
+        if info["mode"] == "tiered":
+            ready["retrieval"]["resident_bytes"] = info["resident_bytes"]
+            ready["retrieval"]["tiers"] = info["tiers"]
+            if index_daemon is not None:
+                ready["retrieval"]["daemon"] = index_daemon.describe()
         if args.aot_store:
             ready["retrieval"]["aot"] = {
                 str(b): s for b, s in sorted(
@@ -1840,6 +1867,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         else:
             server.serve_forever()
     finally:
+        if index_daemon is not None:
+            index_daemon.stop()
         if monitor is not None:
             monitor.stop()
         if args.prof_dir:
@@ -2268,18 +2297,34 @@ def build_parser() -> argparse.ArgumentParser:
                     help="compiled top-k carry width; /v1/search requests "
                          "may ask for any k up to this")
     sp.add_argument("--index-mode", default="exact",
-                    choices=["exact", "ivf"],
-                    help="retrieval mode: exact streaming top-k, or "
+                    choices=["exact", "ivf", "tiered"],
+                    help="retrieval mode: exact streaming top-k, "
                          "two-stage IVF over the index's trained codebook "
-                         "(train with `jimm-tpu index train-centroids`)")
+                         "(train with `jimm-tpu index train-centroids`), "
+                         "or tiered — IVF under an explicit device byte "
+                         "budget with warm/cold spill to host RAM and the "
+                         "store's artifact dir (docs/retrieval.md)")
     sp.add_argument("--nprobe", type=int, default=None,
-                    help="ivf mode: default clusters probed per query "
-                         "(requests may override up to --nprobe-max; "
+                    help="ivf/tiered mode: default clusters probed per "
+                         "query (requests may override up to --nprobe-max; "
                          "default: min(8, --nprobe-max))")
     sp.add_argument("--nprobe-max", type=int, default=32,
-                    help="ivf mode: compiled probe-width ceiling — any "
-                         "nprobe up to this reuses one program (a runtime "
-                         "scalar, never a recompile)")
+                    help="ivf/tiered mode: compiled probe-width ceiling — "
+                         "any nprobe up to this reuses one program (a "
+                         "runtime scalar, never a recompile)")
+    sp.add_argument("--tier-device-budget-mb", type=int, default=None,
+                    help="tiered mode: hot-arena HBM budget in MiB "
+                         "(default 64); device-resident bytes stay flat "
+                         "at this cap however large the corpus grows")
+    sp.add_argument("--tier-host-budget-mb", type=int, default=None,
+                    help="tiered mode: host-RAM budget for warm "
+                         "full-precision rows; clusters past it spill to "
+                         "disk segments (default: unbounded host)")
+    sp.add_argument("--tier-daemon-interval", type=float, default=None,
+                    metavar="SECONDS",
+                    help="tiered mode: start the autonomous IndexDaemon "
+                         "(retrain/build-ivf/compact/re-tier on staleness "
+                         "and access drift) at this tick interval")
     sp.add_argument("--qos-policy", default=None, metavar="FILE",
                     help="tenant QoS policy (JSON/TOML): priority classes, "
                          "per-tenant token-bucket rate limits, and queue "

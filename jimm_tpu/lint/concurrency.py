@@ -20,6 +20,13 @@ than per-file patterns:
   ``.block_until_ready()``, HTTP/subprocess) while holding a threading
   lock: every other thread touching that lock stalls for the full wait.
   ``Condition.wait`` on the *held* lock is exempt (it releases it).
+- **JL023** — a synchronous disk/artifact-store transfer
+  (``ArtifactStore.get/put``, ``TierIoEngine.spill``, ``np.load``,
+  ``Path.read_bytes``, ``open().read``) in tiered-retrieval code
+  reachable from an HTTP request handler. The tier design's contract is
+  that request threads only *name* clusters (``prefetch``) and *wait on
+  the worker's* completed fetch (``collect``) — inline IO rides disk
+  latency straight into serve p99 and bypasses the fetch journal.
 
 The same graph also upgrades four Layer-1 rules from path-name heuristics
 to interprocedural facts: JL006 (device sync reachable from an async def
@@ -169,6 +176,36 @@ def _jl019(graph: ProjectGraph) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL023 — inline tier IO on a serve request thread
+# ---------------------------------------------------------------------------
+
+def _is_tier_path(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return "retrieval/tier" in norm
+
+
+def _jl023(graph: ProjectGraph) -> list[Finding]:
+    findings = []
+    for fn in graph.functions.values():
+        if _path_is_test(fn.path) or not fn.tier_io:
+            continue
+        if not _is_tier_path(fn.path):
+            continue
+        if "http-handler" not in fn.roots:
+            continue
+        for site in fn.tier_io:
+            findings.append(Finding(
+                "JL023", ERROR, fn.path, site.lineno,
+                f"tier IO call {site.what} in `{fn.qual}`, which is "
+                f"reachable from an HTTP request handler — an inline "
+                f"disk/artifact-store transfer on the serve request path "
+                f"rides the full IO latency into p99 and bypasses the "
+                f"fetch journal; enqueue it on the TierIoEngine worker "
+                f"(prefetch the cluster, then collect the staged rows)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # interprocedural escalations of Layer-1 rules
 # ---------------------------------------------------------------------------
 
@@ -286,14 +323,14 @@ def apply_jl014_waivers(findings: list[Finding],
 def run_concurrency_checks(paths: list[str],
                            graph: ProjectGraph | None = None
                            ) -> list[Finding]:
-    """Build the project graph over ``paths`` and run JL017–JL019 plus the
-    interprocedural JL006/JL008/JL013 escalations. Suppression comments
-    apply exactly as for per-file rules."""
+    """Build the project graph over ``paths`` and run JL017–JL019 and
+    JL023 plus the interprocedural JL006/JL008/JL013 escalations.
+    Suppression comments apply exactly as for per-file rules."""
     if graph is None:
         graph = ProjectGraph.build(paths)
     findings = (_jl017(graph) + _jl018(graph) + _jl019(graph)
-                + _jl006_interproc(graph) + _jl008_interproc(graph)
-                + _jl013_interproc(graph))
+                + _jl023(graph) + _jl006_interproc(graph)
+                + _jl008_interproc(graph) + _jl013_interproc(graph))
     by_path: dict[str, list[Finding]] = {}
     for f in findings:
         by_path.setdefault(f.path, []).append(f)

@@ -91,6 +91,20 @@ _BLOCKING_ATTRS = frozenset({"block_until_ready"})
 #: a device wait never is)
 _DEVICE_SYNC_DOTTED = frozenset({"jax.device_get", "device_get"})
 
+#: tier-IO vocabulary (JL023): calls that move cluster payloads to or from
+#: disk *synchronously*. Enqueue-style calls (``TierIoEngine.prefetch``)
+#: and waiting on a worker-completed fetch (``.collect``) are deliberately
+#: absent — that worker-thread split is the sanctioned request-path shape;
+#: what the rule hunts is the direct read/write that skips the worker.
+_TIER_IO_DOTTED = frozenset({"np.load", "numpy.load", "np.fromfile",
+                             "numpy.fromfile", "np.save", "numpy.save"})
+_TIER_IO_ATTRS = frozenset({"read_bytes", "write_bytes"})
+#: receiver class name -> methods that hit the artifact store / disk
+_TIER_IO_CLASSES = {
+    "ArtifactStore": frozenset({"get", "put"}),
+    "TierIoEngine": frozenset({"spill"}),
+}
+
 _EVICTION_METHODS = frozenset({"pop", "popitem", "popleft", "clear"})
 
 
@@ -152,6 +166,7 @@ class FunctionInfo:
         self.calls: list[CallSite] = []
         self.acquires: list[AcquireSite] = []
         self.blocking: list[BlockSite] = []
+        self.tier_io: list[BlockSite] = []
         self.device_syncs: list[tuple[str, int]] = []
         self.jit_sites: list[int] = []
         self.swallow_lines: list[int] = []
@@ -883,6 +898,27 @@ class ProjectGraph:
                         blocked = None
         if blocked is not None:
             fn.blocking.append(BlockSite(blocked, lineno, held_thread))
+
+        # tier IO (interprocedural JL023) -----------------------------------
+        tio = None
+        if name in _TIER_IO_DOTTED:
+            tio = name
+        elif attr in _TIER_IO_ATTRS:
+            tio = f".{attr}()"
+        elif attr and isinstance(node.func, ast.Attribute):
+            recv = self._expr_type(node.func.value, fn)
+            if recv is not None:
+                meths = _TIER_IO_CLASSES.get(recv.rsplit(".", 1)[-1])
+                if meths is not None and attr in meths:
+                    tio = f"{recv}.{attr}()"
+            elif attr in ("read", "write") and \
+                    isinstance(node.func.value, ast.Call) and \
+                    isinstance(node.func.value.func, ast.Name) and \
+                    node.func.value.func.id == "open":
+                # open(...).read(): an unbuffered inline file transfer
+                tio = f"open().{attr}()"
+        if tio is not None:
+            fn.tier_io.append(BlockSite(tio, lineno, held_thread))
 
         # device syncs + jit construction (interprocedural JL006/JL008) ----
         if name in _DEVICE_SYNC_DOTTED or attr in _BLOCKING_ATTRS:

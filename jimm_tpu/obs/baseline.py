@@ -43,6 +43,10 @@ METRIC_DIRECTIONS = {
     # drop gates exactly like a ≥20% throughput drop
     "recall_at_10": +1,
     "value": +1,
+    # tiered retrieval: device-resident footprint of the serving index —
+    # a growth past the budget (arena leak, plan regression) gates like
+    # a latency regression
+    "resident_bytes": -1,
     "step_time_ms": -1,
     "latency_ms": -1,
     "latency_p50_ms": -1,

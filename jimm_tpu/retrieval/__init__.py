@@ -9,6 +9,9 @@
 - :mod:`~jimm_tpu.retrieval.ann` — IVF two-stage approximate search
   (k-means coarse quantizer + runtime-``nprobe`` cluster probe + exact
   rescore of candidate spans), same AOT/tune/sharding contracts.
+- :mod:`~jimm_tpu.retrieval.tier` — tiered residency over the same
+  cluster-major layout: budgeted hot arena on device, warm host RAM, cold
+  disk segments, PQ residual codec, and the autonomous ``IndexDaemon``.
 - :mod:`~jimm_tpu.retrieval.api` — the service facade ``serve --index``
   and ``/v1/search`` ride, plus the ``jimm_retrieval`` metric namespace.
 - :mod:`~jimm_tpu.retrieval.cli` — ``jimm-tpu index build|add|ls|verify``
@@ -25,13 +28,17 @@ from jimm_tpu.retrieval.api import RetrievalService, retrieval_metrics
 from jimm_tpu.retrieval.store import (LoadedIndex, PersistentEmbeddingCache,
                                       RetrievalStoreError, VectorStore,
                                       normalize_rows)
+from jimm_tpu.retrieval.tier import (IndexDaemon, PqCodec, TieredSearcher,
+                                     TierPlan, plan_tiers, train_pq)
 from jimm_tpu.retrieval.topk import (DEFAULT_BLOCK_N, IndexSearcher,
                                      Searcher, merge_partials,
                                      streaming_topk)
 
-__all__ = ["DEFAULT_BLOCK_N", "DEFAULT_NPROBE", "IndexSearcher",
-           "IvfIndexSearcher", "IvfSearcher", "LoadedIndex",
-           "PersistentEmbeddingCache", "RetrievalService",
-           "RetrievalStoreError", "Searcher", "VectorStore",
-           "assign_clusters", "merge_partials", "normalize_rows",
-           "retrieval_metrics", "streaming_topk", "train_centroids"]
+__all__ = ["DEFAULT_BLOCK_N", "DEFAULT_NPROBE", "IndexDaemon",
+           "IndexSearcher", "IvfIndexSearcher", "IvfSearcher",
+           "LoadedIndex", "PersistentEmbeddingCache", "PqCodec",
+           "RetrievalService", "RetrievalStoreError", "Searcher",
+           "TierPlan", "TieredSearcher", "VectorStore", "assign_clusters",
+           "merge_partials", "normalize_rows", "plan_tiers",
+           "retrieval_metrics", "streaming_topk", "train_centroids",
+           "train_pq"]

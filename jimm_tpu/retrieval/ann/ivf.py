@@ -304,6 +304,14 @@ class IvfSearcher:
     def trace_count(self) -> int:
         return self._traces["count"]
 
+    def resident_bytes(self) -> int:
+        """Device-resident bytes: cluster-major blocks, row ids, padded
+        codebook, and span tables — comparable with the exact scan's and
+        the tiered searcher's accounting."""
+        return sum(int(a.nbytes) for a in
+                   (self._blocks, self._row_ids, self._centroids,
+                    self._cl_start, self._cl_count))
+
     # -- AOT keys ---------------------------------------------------------
 
     def key_for(self, bucket: int):
@@ -556,6 +564,9 @@ class IvfIndexSearcher:
 
     def trace_count(self) -> int:
         return sum(s.trace_count() for s in self.searchers)
+
+    def resident_bytes(self) -> int:
+        return sum(s.resident_bytes() for s in self.searchers)
 
     def prepare(self, bucket: int) -> str:
         sources = {s.prepare(bucket) for s in self.searchers}

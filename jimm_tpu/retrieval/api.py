@@ -4,9 +4,11 @@ serving stack, plus the ``jimm_retrieval`` observability namespace.
 :class:`RetrievalService` is what ``serve --index`` constructs and
 :class:`~jimm_tpu.serve.server.ServingServer` consults for ``/v1/search``:
 it owns the loaded index, the warm searcher — exact
-:class:`~jimm_tpu.retrieval.topk.IndexSearcher` or approximate
-:class:`~jimm_tpu.retrieval.ann.ivf.IvfIndexSearcher`, per ``serve
---index-mode`` — and the metric series the obs docs list:
+:class:`~jimm_tpu.retrieval.topk.IndexSearcher`, approximate
+:class:`~jimm_tpu.retrieval.ann.ivf.IvfIndexSearcher`, or budgeted
+:class:`~jimm_tpu.retrieval.tier.TieredSearcher` (which adds the
+``jimm_tier_*`` residency gauges), per ``serve --index-mode`` — and the
+metric series the obs docs list:
 
 - ``jimm_retrieval_search_total`` / ``jimm_retrieval_embed_total``
   counters (embed counts rows, not requests: a bulk ``/v1/embed`` of 16
@@ -63,8 +65,9 @@ class RetrievalService:
                  store: VectorStore | None = None, mode: str = "exact",
                  nprobe: int | None = None):
         from jimm_tpu import obs
-        if mode not in ("exact", "ivf"):
-            raise ValueError(f"mode must be 'exact' or 'ivf'; got {mode!r}")
+        if mode not in ("exact", "ivf", "tiered"):
+            raise ValueError(f"mode must be 'exact', 'ivf', or 'tiered'; "
+                             f"got {mode!r}")
         self.index = index
         self.searcher = searcher
         self.store = store
@@ -74,7 +77,7 @@ class RetrievalService:
         reg.gauge("index_size", lambda: float(len(self.index)))
         reg.gauge("index_segments", fn=self._segments_now)
         reg.gauge("index_staleness_seconds", fn=self._staleness_now)
-        if mode == "ivf":
+        if mode in ("ivf", "tiered"):
             from jimm_tpu.retrieval.ann.ivf import DEFAULT_NPROBE
             cap = searcher.nprobe_max
             self.default_nprobe = min(
@@ -97,22 +100,34 @@ class RetrievalService:
                    buckets=(1,), block_n: int | None = None,
                    plan: Any = None, aot_store: Any = None,
                    mode: str = "exact", nprobe: int | None = None,
-                   nprobe_max: int = 32) -> "RetrievalService":
+                   nprobe_max: int = 32,
+                   device_budget_bytes: int | None = None,
+                   host_budget_bytes: int | None = None
+                   ) -> "RetrievalService":
         index = store.load(name)
-        if mode == "ivf":
-            from jimm_tpu.retrieval.ann.ivf import IvfIndexSearcher
+        if mode in ("ivf", "tiered"):
             loaded = store.codebook(name)
             if loaded is None:
                 raise RetrievalStoreError(
                     f"index {name!r} has no trained codebook — run "
                     f"`jimm-tpu index train-centroids` (and `build-ivf`) "
-                    f"before serving with --index-mode ivf")
+                    f"before serving with --index-mode {mode}")
             centroids, _meta = loaded
             assign = store.load_assignments(name)
-            searcher: Any = IvfIndexSearcher(
-                index, centroids, assign, k=k, nprobe_max=nprobe_max,
-                buckets=buckets, block_n=block_n, plan=plan,
-                aot_store=aot_store)
+            if mode == "tiered":
+                from jimm_tpu.retrieval.tier import TieredSearcher
+                searcher: Any = TieredSearcher(
+                    index, centroids, assign, k=k, nprobe_max=nprobe_max,
+                    buckets=buckets, block_n=block_n,
+                    device_budget_bytes=device_budget_bytes,
+                    host_budget_bytes=host_budget_bytes,
+                    aot_store=aot_store, artifacts=store.artifacts)
+            else:
+                from jimm_tpu.retrieval.ann.ivf import IvfIndexSearcher
+                searcher = IvfIndexSearcher(
+                    index, centroids, assign, k=k, nprobe_max=nprobe_max,
+                    buckets=buckets, block_n=block_n, plan=plan,
+                    aot_store=aot_store)
         else:
             searcher = IndexSearcher(index, k=k, buckets=buckets,
                                      block_n=block_n, plan=plan,
@@ -158,13 +173,16 @@ class RetrievalService:
                "metric": self.index.metric, "k": self.searcher.k,
                "block_n": self.searcher.block_n,
                "buckets": list(self.searcher.buckets),
-               "partitions": len(self.searcher.searchers),
+               "partitions": len(getattr(self.searcher, "searchers", [0])),
                "mode": self.mode,
                "staleness_s": self._staleness_now()}
-        if self.mode == "ivf":
+        if self.mode in ("ivf", "tiered"):
             out["nprobe"] = self.default_nprobe
             out["nprobe_max"] = self.searcher.nprobe_max
             out["clusters"] = self.searcher.n_clusters
+        if self.mode == "tiered":
+            out["resident_bytes"] = self.searcher.resident_bytes()
+            out["tiers"] = self.searcher.tier_plan().describe()
         return out
 
     # -- queries ----------------------------------------------------------
@@ -194,13 +212,15 @@ class RetrievalService:
             raise RequestError(
                 f"k must be in [1, {self.searcher.k}] (the searcher's "
                 f"compiled carry width); got {k_eff}")
-        if self.mode == "ivf":
+        if self.mode in ("ivf", "tiered"):
             np_eff = self.default_nprobe if nprobe is None else int(nprobe)
             if np_eff < 1 or np_eff > self.searcher.nprobe_max:
                 raise RequestError(
                     f"nprobe must be in [1, {self.searcher.nprobe_max}] "
                     f"(the searcher's compiled probe width); got {np_eff}")
-            with obs.span("retrieval_ivf"):
+            span_name = ("retrieval_tier" if self.mode == "tiered"
+                         else "retrieval_ivf")
+            with obs.span(span_name):
                 values, _indices, ids = self.searcher.search(
                     queries, nprobe=np_eff)
         else:

@@ -172,7 +172,14 @@ def _cmd_stats(args: argparse.Namespace) -> int:
     store = VectorStore(args.store)
     names = [args.name] if args.name else store.names()
     out = [store.stats(n) for n in names]
-    print(json.dumps(out if args.name is None else out[0], indent=1))
+    doc = out if args.name is None else out[0]
+    if args.json:
+        # machine-readable contract: one compact line, stable under
+        # pretty-print drift — what tier_smoke and the IndexDaemon's
+        # operators parse
+        print(json.dumps(doc, separators=(",", ":"), sort_keys=True))
+    else:
+        print(json.dumps(doc, indent=1))
     return 0
 
 
@@ -270,6 +277,9 @@ def add_index_parser(subparsers) -> None:
     _store_flag(sp)
     sp.add_argument("name", nargs="?", default=None,
                     help="one index (default: all)")
+    sp.add_argument("--json", action="store_true",
+                    help="one compact sorted-key JSON line (machine-"
+                         "readable; default output is pretty-printed)")
     sp.set_defaults(index_func=_cmd_stats)
 
 

@@ -1,0 +1,51 @@
+"""Tiered residency for billion-row retrieval: HBM / host RAM / disk.
+
+The subsystem that makes corpus size independent of device memory. Hot
+clusters stay device-resident in a fixed budgeted arena, warm clusters
+in host RAM, cold clusters on the aot artifact store; the device-side
+coarse probe names which clusters a query touches and only those stream
+up the hierarchy, double-buffered behind the PQ asymmetric-distance
+pass (:mod:`~jimm_tpu.retrieval.tier.engine`). The
+:class:`~jimm_tpu.retrieval.tier.daemon.IndexDaemon` keeps the whole
+arrangement healthy autonomously — retrain, rebuild, compact, re-tier —
+journaled on one correlation id per cycle.
+
+Importing this package never imports jax (the CLI and the daemon's
+store-only mode stay accelerator-free); the device programs materialize
+lazily inside :class:`TieredSearcher`.
+"""
+
+from jimm_tpu.retrieval.tier.daemon import IndexDaemon
+from jimm_tpu.retrieval.tier.engine import (DEFAULT_DEVICE_BUDGET_MB,
+                                            TieredSearcher,
+                                            make_rescore_fn, make_tier_fn)
+from jimm_tpu.retrieval.tier.io import (TIER_FORMAT_VERSION, TierIoEngine,
+                                        decode_cluster, encode_cluster)
+from jimm_tpu.retrieval.tier.pq import (PQ_FORMAT_VERSION, PqCodec,
+                                        adc_scores, decode_pq, encode_pq,
+                                        encode_rows, query_luts, train_pq)
+from jimm_tpu.retrieval.tier.residency import (AccessStats, TierPlan,
+                                               plan_tiers)
+
+__all__ = [
+    "AccessStats",
+    "DEFAULT_DEVICE_BUDGET_MB",
+    "IndexDaemon",
+    "PQ_FORMAT_VERSION",
+    "PqCodec",
+    "TIER_FORMAT_VERSION",
+    "TierIoEngine",
+    "TierPlan",
+    "TieredSearcher",
+    "adc_scores",
+    "decode_cluster",
+    "decode_pq",
+    "encode_cluster",
+    "encode_pq",
+    "encode_rows",
+    "make_rescore_fn",
+    "make_tier_fn",
+    "plan_tiers",
+    "query_luts",
+    "train_pq",
+]

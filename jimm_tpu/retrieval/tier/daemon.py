@@ -1,0 +1,282 @@
+"""Autonomous index maintenance: the tier twin of the CascadeAutoscaler.
+
+An :class:`IndexDaemon` closes the loop the CLI's manual ``index
+retrain`` / ``build-ivf`` / ``compact`` workflow leaves open: it samples
+the store's ``ann_status`` staleness advice plus the live searcher's
+access statistics, keeps a sliding window of samples, and — after a
+cooldown, past explicit trip points — makes exactly ONE decision per
+tick:
+
+- ``retrain``  — staleness past the store's retrain threshold: train a
+  fresh codebook (same cluster count, so the compiled programs never
+  retrace), persist it, rebuild cluster runs, refresh the searcher;
+- ``build_ivf`` — segments merely lack cluster runs: re-cluster them
+  against the current codebook;
+- ``compact``  — the tombstone share crossed ``compact_high``: fold live
+  rows and refresh so reclaimed rows leave every tier;
+- ``retier``   — the access-EMA-optimal hot set drifted from the
+  installed one by more than ``retier_high``: rebuild residency so the
+  working set is the device-resident set.
+
+Bounded (one decision per tick, cooldown between decisions), hysteretic
+(each trip point is well above the post-action value of its own signal,
+so an action cannot immediately re-trip itself), and audited: every
+decision and application is journaled (``tier_daemon_decision`` /
+``tier_daemon_applied``) on the daemon's root correlation id — the same
+cid the refresh's ``tier_plan`` event and any resulting ``tier_spill``
+transfers carry, so ``jimm-tpu journal correlate`` shows one whole
+retrain/re-tier cycle as one chain. ``jimm_tier_daemon_decisions_total``
+is pre-created at 0 so "the loop ran and did nothing" is visible,
+distinct from "the loop never ran".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from jimm_tpu.obs import get_journal, get_registry, new_correlation_id
+from jimm_tpu.retrieval.store import ANN_STALENESS_RETRAIN
+
+__all__ = ["IndexDaemon"]
+
+
+class IndexDaemon:
+    """Background maintenance for one named index.
+
+    Args:
+        store: the :class:`~jimm_tpu.retrieval.store.VectorStore`.
+        name: the index to maintain.
+        searcher: optionally a live
+            :class:`~jimm_tpu.retrieval.tier.engine.TieredSearcher` —
+            refreshed after every action so serving follows the store;
+            without one the daemon still maintains the store itself.
+        retrain_high: staleness trip point (default: the store's own
+            retrain threshold).
+        compact_high: tombstone-share trip point.
+        retier_high: hot-set drift trip point (symmetric-difference
+            fraction of the installed hot set).
+        window / cooldown: hysteresis, measured in ticks.
+    """
+
+    def __init__(self, store, name: str, searcher=None, *,
+                 retrain_high: float = ANN_STALENESS_RETRAIN,
+                 compact_high: float = 0.25, retier_high: float = 0.25,
+                 window: int = 3, cooldown: int = 2,
+                 cid: str | None = None, seed: int = 0,
+                 clock=time.monotonic):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if min(retrain_high, compact_high, retier_high) <= 0:
+            raise ValueError("trip points must be positive")
+        self.store = store
+        self.name = str(name)
+        self.searcher = searcher
+        self.retrain_high = float(retrain_high)
+        self.compact_high = float(compact_high)
+        self.retier_high = float(retier_high)
+        self.window = int(window)
+        self.cooldown = max(0, int(cooldown))
+        self.cid = cid or new_correlation_id()
+        self.seed = int(seed)
+        self.clock = clock
+        self.decisions: list[dict] = []
+        self._samples: deque[dict] = deque(maxlen=self.window)
+        self._cooldown_lock = threading.Lock()
+        self._since_decision = self.cooldown  # first full window may act
+        self._tick = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._m_decisions = get_registry("jimm_tier").counter(
+            "jimm_tier_daemon_decisions_total")
+        self._m_decisions.inc(0)
+
+    # -- sensing -----------------------------------------------------------
+
+    def sample(self) -> dict:
+        """One sensor reading: store staleness/advice + tombstone share
+        + (with a live searcher) hot-set drift vs the access EMA."""
+        status = self.store.ann_status(self.name) or {}
+        man = self.store.manifest(self.name)
+        dead = len(man.get("tombstones", []))
+        live = int(status.get("live_rows", 0))
+        out = {"staleness": float(status.get("staleness", 0.0)),
+               "advice": status.get("advice", "ok"),
+               "tombstone_frac": dead / max(live + dead, 1),
+               "live": live}
+        if self.searcher is not None:
+            installed = set(self.searcher.tier_plan().hot)
+            proposed = set(self.searcher.propose_plan().hot)
+            out["hot_drift"] = (len(installed ^ proposed)
+                               / max(len(installed), 1))
+        else:
+            out["hot_drift"] = 0.0
+        return out
+
+    # -- deciding ----------------------------------------------------------
+
+    def tick(self) -> dict | None:
+        """Sample, window, and decide. Returns the decision (not yet
+        applied — run it through :meth:`apply`) or None."""
+        self._tick += 1
+        self._samples.append(self.sample())
+        if len(self._samples) < self.window:
+            return None
+        with self._cooldown_lock:
+            if self._since_decision < self.cooldown:
+                self._since_decision += 1
+                return None
+        decision = self._decide()
+        if decision is None:
+            with self._cooldown_lock:
+                self._since_decision += 1
+            return None
+        self._record(decision)
+        return decision
+
+    def _mean(self, name: str) -> float:
+        return sum(s[name] for s in self._samples) / len(self._samples)
+
+    def _decide(self) -> dict | None:
+        staleness = self._mean("staleness")
+        tombs = self._mean("tombstone_frac")
+        drift = self._mean("hot_drift")
+        advice = self._samples[-1]["advice"]
+        window = {"staleness": round(staleness, 4),
+                  "tombstone_frac": round(tombs, 4),
+                  "hot_drift": round(drift, 4), "advice": advice,
+                  "ticks": self._tick}
+        # priority order: correctness-of-routing first (a stale codebook
+        # degrades recall everywhere), storage health second, placement
+        # last — and exactly one action per tick
+        if staleness >= self.retrain_high:
+            return {"action": "retrain", "window": window,
+                    "reason": f"staleness {staleness:.3f} >= "
+                              f"{self.retrain_high} across the window: "
+                              "retrain codebook + rebuild runs"}
+        if advice == "build-ivf":
+            return {"action": "build_ivf", "window": window,
+                    "reason": "segments lack cluster runs: re-cluster "
+                              "against the current codebook"}
+        if tombs >= self.compact_high:
+            return {"action": "compact", "window": window,
+                    "reason": f"tombstone share {tombs:.3f} >= "
+                              f"{self.compact_high}: fold live rows"}
+        if self.searcher is not None and drift >= self.retier_high:
+            return {"action": "retier", "window": window,
+                    "reason": f"hot-set drift {drift:.3f} >= "
+                              f"{self.retier_high}: re-tier to the "
+                              "access working set"}
+        return None
+
+    def _record(self, decision: dict) -> None:
+        self.decisions.append(decision)
+        with self._cooldown_lock:
+            self._since_decision = 0
+        self._m_decisions.inc()
+        get_journal().emit("tier_daemon_decision", cid=self.cid,
+                           index=self.name, **decision)
+
+    # -- acting ------------------------------------------------------------
+
+    def apply(self, decision: dict) -> None:
+        """Execute one decision synchronously on the daemon thread (the
+        store does the disk work; the searcher refresh swaps residency
+        without a retrace). Journals ``tier_daemon_applied`` with the
+        action report on the root cid."""
+        t0 = time.perf_counter()
+        action = decision["action"]
+        report: dict = {}
+        if action == "retrain":
+            from jimm_tpu.retrieval.ann.kmeans import train_centroids
+            loaded = self.store.load(self.name)
+            cb = self.store.codebook(self.name)
+            n_clusters = (self.searcher.n_clusters
+                          if self.searcher is not None
+                          else int(cb[0].shape[0]))
+            cents = train_centroids(loaded.matrix_f32(), n_clusters,
+                                    seed=self.seed)
+            self.store.set_codebook(self.name, cents, seed=self.seed)
+            report = self.store.build_ivf(self.name)
+            self._refresh(centroids=cents)
+        elif action == "build_ivf":
+            report = self.store.build_ivf(self.name)
+            self._refresh()
+        elif action == "compact":
+            report = self.store.compact(self.name)
+            self._refresh()
+        elif action == "retier":
+            plan = self.searcher.refresh(cid=self.cid)
+            report = plan.describe()
+        else:
+            raise ValueError(f"unknown action {action!r}")
+        get_journal().emit("tier_daemon_applied", cid=self.cid,
+                           index=self.name, action=action,
+                           dur_s=round(time.perf_counter() - t0, 6),
+                           **{k: v for k, v in report.items()
+                              if isinstance(v, (int, float, str))})
+
+    def _refresh(self, centroids=None) -> None:
+        """Reload the index (tombstone-filtered, fresh assignments) into
+        the live searcher so every tier follows the store's live set."""
+        if self.searcher is None:
+            return
+        loaded = self.store.load(self.name)
+        assign = self.store.load_assignments(self.name)
+        self.searcher.refresh(loaded, assign=assign,
+                              centroids=centroids, cid=self.cid)
+
+    def step(self) -> dict | None:
+        """tick() + apply() — the body of the control loop."""
+        decision = self.tick()
+        if decision is not None:
+            self.apply(decision)
+        return decision
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, interval_s: float = 30.0) -> None:
+        """Run :meth:`step` every ``interval_s`` on a daemon thread."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.step()
+                except Exception as e:  # noqa: BLE001 — a failed cycle
+                    # must not kill the loop; journal it and keep going
+                    get_journal().emit("tier_daemon_error", cid=self.cid,
+                                       index=self.name, error=str(e))
+                if self._stop.wait(interval_s):
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, name=f"index-daemon-{self.name}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    # -- introspection -----------------------------------------------------
+
+    def describe(self) -> dict:
+        """The healthz ``index_daemon`` block."""
+        return {
+            "cid": self.cid,
+            "index": self.name,
+            "retrain_high": self.retrain_high,
+            "compact_high": self.compact_high,
+            "retier_high": self.retier_high,
+            "window": self.window,
+            "cooldown": self.cooldown,
+            "running": self._thread is not None,
+            "decisions": len(self.decisions),
+            "last_decision": self.decisions[-1] if self.decisions
+            else None,
+        }

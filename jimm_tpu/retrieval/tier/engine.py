@@ -1,0 +1,782 @@
+"""Tiered IVF search: device probe + hot rescore, host ADC, exact rescue.
+
+The :class:`TieredSearcher` serves the same contract as
+:class:`~jimm_tpu.retrieval.ann.ivf.IvfIndexSearcher` but caps device
+residency at an explicit byte budget instead of holding the whole corpus
+in HBM. Two small fused programs do all the device work, both with
+fully static shapes so corpus growth and re-tiering never retrace:
+
+- the **tier probe** (:func:`make_tier_fn`) is the IVF two-stage program
+  over a *fixed-capacity* hot arena — ``hot_nb`` cluster-major blocks
+  sized from ``device_budget_bytes`` — and additionally returns the
+  coarse top-``nprobe_max`` cluster selection so the host knows which
+  warm/cold clusters each query probed;
+- the **shortlist rescore** (:func:`make_rescore_fn`) exact-scores a
+  fixed ``(bucket, shortlist, D)`` buffer of streamed full-precision
+  rows the host gathered for the non-hot candidates.
+
+Between the two device calls the host runs the PQ asymmetric-distance
+pass over the probed non-hot clusters' uint8 codes (always
+host-resident — they are the 8× compressed form) and the IO engine
+streams any probed cold clusters off disk. The order is deliberate:
+cold prefetches enqueue the moment the probe's cluster selection lands,
+so the disk reads overlap the ADC pass — FlashAttention's stream-only-
+what-you-touch discipline applied one level up the memory hierarchy,
+with FastUSP's overlap-transfer-behind-compute hiding the fetch.
+
+Quantization never corrupts a reported score: ADC only *ranks* non-hot
+rows into the shortlist; everything returned to the caller was scored
+from full-precision rows (hot rows on device, shortlist rows in the
+rescore program). Both programs warm-start store-first through the
+shared :class:`_AotProgram` wrapper (same hit/miss/fallback +
+quarantine-and-degrade contract as every other serve program).
+
+Residency state is immutable-swap: a search captures one
+:class:`_Resident` snapshot and a :meth:`TieredSearcher.refresh`
+installs a complete replacement under the dispatch lock, so re-tiering
+races no reader and a rebuilt layout can never hand back a tombstoned
+row — the new snapshot is built only from the new ``LoadedIndex``'s
+live rows, and cold segments are content-addressed so stale spills are
+simply never referenced again.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from jimm_tpu.obs import get_journal, get_registry
+from jimm_tpu.retrieval.ann.ivf import _LANES, _ceil_to, cluster_layout
+from jimm_tpu.retrieval.store import LoadedIndex, normalize_rows
+from jimm_tpu.retrieval.tier.io import TierIoEngine
+from jimm_tpu.retrieval.tier.pq import (PqCodec, encode_rows, query_luts,
+                                        train_pq)
+from jimm_tpu.retrieval.tier.residency import (AccessStats, TierPlan,
+                                               plan_tiers)
+from jimm_tpu.retrieval.topk import merge_partials
+
+__all__ = ["DEFAULT_DEVICE_BUDGET_MB", "TieredSearcher", "make_rescore_fn",
+           "make_tier_fn"]
+
+#: serve-time default hot-arena budget; ``--tier-device-budget-mb``
+#: overrides it
+DEFAULT_DEVICE_BUDGET_MB = 64
+
+#: per-query exact-rescore shortlist width for non-hot candidates
+DEFAULT_SHORTLIST = 64
+
+
+# ---------------------------------------------------------------------------
+# device programs
+# ---------------------------------------------------------------------------
+
+def make_tier_fn(k: int, nprobe_max: int, max_bpc: int) -> Callable:
+    """The IVF two-stage program over the hot arena, plus the probe.
+
+    Same signature and semantics as
+    :func:`~jimm_tpu.retrieval.ann.ivf.make_ivf_fn` with one extra
+    output: ``sel (B, nprobe_max) i32``, the coarse top clusters per
+    query (host code trims it to the runtime ``nprobe``). Non-hot
+    clusters have ``cl_count == 0`` in the resident span table, so the
+    rescore scan skips them for free while the selection still names
+    them for the host-side tiers.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    k, nprobe_max, max_bpc = int(k), int(nprobe_max), int(max_bpc)
+
+    def fn(blocks, row_ids, centroids, cl_start, cl_count, live_c,
+           nprobe, queries):
+        qf = queries.astype(jnp.float32)
+        batch = qf.shape[0]
+        block_n = blocks.shape[1]
+        kk = min(k, block_n)
+
+        cscores = qf @ centroids.astype(jnp.float32).T
+        c_iota = jax.lax.iota(jnp.int32, centroids.shape[0])
+        cscores = jnp.where(c_iota[None, :] < live_c, cscores, -jnp.inf)
+        _, sel = jax.lax.top_k(cscores, nprobe_max)  # (B, P) cluster ids
+        probe_live = jax.lax.iota(jnp.int32, nprobe_max) < nprobe
+
+        starts = cl_start[sel]
+        counts = cl_count[sel]
+        j = jax.lax.iota(jnp.int32, max_bpc)
+        cand = starts[..., None] + j[None, None, :]
+        live_cand = (j[None, None, :] < counts[..., None]) \
+            & probe_live[None, :, None]
+        cand = jnp.where(live_cand, cand, -1)
+        cand = cand.reshape(batch, nprobe_max * max_bpc)
+
+        def body(carry, bidx):
+            carry_vals, carry_idx, carry_rows = carry
+            safe = jnp.maximum(bidx, 0)
+            blk = blocks[safe]
+            rid = row_ids[safe]
+            scores = jnp.einsum("bd,bnd->bn", qf,
+                                blk.astype(jnp.float32))
+            live = (rid >= 0) & (bidx >= 0)[:, None]
+            scores = jnp.where(live, scores, -jnp.inf)
+            block_vals, block_pos = jax.lax.top_k(scores, kk)
+            block_idx = jnp.take_along_axis(
+                jnp.where(live, rid, -1), block_pos, axis=1)
+            merged_vals, merged_pos = jax.lax.top_k(
+                jnp.concatenate([carry_vals, block_vals], axis=1), k)
+            merged_idx = jnp.take_along_axis(
+                jnp.concatenate([carry_idx, block_idx], axis=1),
+                merged_pos, axis=1)
+            carry_rows = carry_rows + jnp.sum(live, axis=1,
+                                              dtype=jnp.int32)
+            return (merged_vals, merged_idx, carry_rows), None
+
+        init = (jnp.full((batch, k), -jnp.inf, jnp.float32),
+                jnp.full((batch, k), -1, jnp.int32),
+                jnp.zeros((batch,), jnp.int32))
+        (vals, idx, rows), _ = jax.lax.scan(body, init, cand.T)
+        return vals, idx, rows, sel
+
+    return fn
+
+
+def make_rescore_fn(k: int) -> Callable:
+    """Exact scorer for the streamed shortlist: ``fn(rows (B, S, D) f32,
+    ids (B, S) i32, queries (B, D) f32) -> (values (B, k), indices
+    (B, k) i32)`` — one einsum + ``top_k``, ``-1`` ids mask to -inf.
+    ``S >= k`` is enforced by the searcher."""
+    import jax
+    import jax.numpy as jnp
+
+    k = int(k)
+
+    def fn(rows, ids, queries):
+        qf = queries.astype(jnp.float32)
+        scores = jnp.einsum("bd,bsd->bs", qf, rows.astype(jnp.float32))
+        scores = jnp.where(ids >= 0, scores, -jnp.inf)
+        vals, pos = jax.lax.top_k(scores, k)
+        return vals, jnp.take_along_axis(ids, pos, axis=1)
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# store-first program wrapper (shared by both device programs)
+# ---------------------------------------------------------------------------
+
+class _AotProgram:
+    """One compiled program with the serve warm-start contract:
+    ``prepare`` is store-first under an ``aot_load`` span (hit/miss/
+    fallback counted in ``jimm_aot``, write-through on miss), the fresh
+    path is a counting jit, and a loaded executable that raises at call
+    time quarantines itself and degrades to fresh. Factored out of
+    ``IvfSearcher`` so the tier probe and the shortlist rescore share
+    one implementation."""
+
+    def __init__(self, fn: Callable, *, n_leaves: int, store: Any,
+                 label: str, key_for: Callable, arg_specs: Callable,
+                 write_through: bool = True):
+        import jax
+        self._fn = fn
+        self.n_leaves = int(n_leaves)
+        self.store = store
+        self.label = label
+        self._key_for = key_for
+        self._arg_specs = arg_specs
+        self.write_through = write_through
+        self._traces = {"count": 0}
+
+        def counting(*args):
+            self._traces["count"] += 1
+            return fn(*args)
+
+        self._fresh = jax.jit(counting)
+        self._loaded: dict[int, Callable] = {}
+        #: bucket -> "aot" | "miss" | "fallback" | "compile"
+        self.sources: dict[int, str] = {}
+
+    def trace_count(self) -> int:
+        return self._traces["count"]
+
+    def prepare(self, bucket: int) -> str:
+        bucket = int(bucket)
+        if bucket in self.sources:
+            return self.sources[bucket]
+        if self.store is None:
+            self.sources[bucket] = "compile"
+            return "compile"
+        from jimm_tpu import obs
+        from jimm_tpu.aot.warmup import _runtime_versions, aot_metrics
+        hit, miss, fallback = aot_metrics()
+        key = self._key_for(bucket)
+        fp = key.fingerprint()
+        existed = self.store.contains(fp)
+        source = "miss"
+        with obs.span("aot_load"):
+            payload = self.store.get(fp,
+                                     expect_versions=_runtime_versions())
+            if payload is not None:
+                try:
+                    self._loaded[bucket] = self._bind(payload)
+                    source = "aot"
+                except Exception as e:  # noqa: BLE001 — degrade, never die
+                    self.store.quarantine(fp,
+                                          f"deserialize/bind failed: {e}")
+                    source = "fallback"
+            elif existed:
+                source = "fallback"  # store.get already quarantined it
+        if source == "aot":
+            hit.inc()
+        elif source == "fallback":
+            fallback.inc()
+        else:
+            miss.inc()
+            if self.write_through:
+                self._export_and_put(bucket, key, fp)
+        self.sources[bucket] = source
+        return source
+
+    def _bind(self, payload: bytes) -> Callable:
+        import jax
+        from jax import export as jax_export
+        exported = jax_export.deserialize(bytearray(payload))
+        flat_avals = jax.tree.flatten(exported.in_avals)[0] \
+            if hasattr(exported, "in_avals") else []
+        if flat_avals and len(flat_avals) != self.n_leaves:
+            raise ValueError(f"artifact expects {len(flat_avals)} input "
+                             f"leaves, {self.label} provides "
+                             f"{self.n_leaves}")
+        return jax.jit(exported.call)
+
+    def _export_and_put(self, bucket: int, key, fp: str) -> None:
+        try:
+            import jax
+            from jax import export as jax_export
+
+            from jimm_tpu.aot.keys import AOT_FORMAT_VERSION
+            exported = jax_export.export(jax.jit(self._fn))(
+                *self._arg_specs(bucket))
+            self.store.put(fp, exported.serialize(),
+                           meta={"label": self.label, **key.describe(),
+                                 "format_version": AOT_FORMAT_VERSION})
+        except Exception:  # noqa: BLE001 — write-through must not break
+            pass
+
+    def __call__(self, bucket: int, *args):
+        fn = self._loaded.get(bucket)
+        if fn is not None:
+            try:
+                return fn(*args)
+            except Exception:  # noqa: BLE001 — bad artifact: quarantine,
+                # recompile fresh, answer the query anyway
+                from jimm_tpu.aot.warmup import aot_metrics
+                aot_metrics()[2].inc()
+                del self._loaded[bucket]
+                self.sources[bucket] = "fallback"
+                if self.store is not None:
+                    self.store.quarantine(
+                        self._key_for(bucket).fingerprint(),
+                        "loaded executable raised at call time")
+        return self._fresh(*args)
+
+
+# ---------------------------------------------------------------------------
+# residency snapshot
+# ---------------------------------------------------------------------------
+
+class _Resident:
+    """One immutable residency generation. A search captures exactly one
+    snapshot, so a concurrent re-tier/refresh can never hand it a
+    half-swapped layout (or a row the new index tombstoned)."""
+
+    __slots__ = ("index", "plan", "counts", "blocks", "row_ids",
+                 "centroids", "cl_start", "cl_count", "live_c",
+                 "cents_host", "warm", "codes", "cold_fp", "device_bytes",
+                 "host_bytes")
+
+    def __init__(self, **kw):
+        for name in self.__slots__:
+            setattr(self, name, kw[name])
+
+
+def _resolve_block_n(n: int, dim: int, batch: int,
+                     block_n: int | None) -> int:
+    if block_n is not None:
+        return int(block_n)
+    from jimm_tpu import tune
+    config = tune.best_config(
+        "retrieval_tier",
+        shapes=[(int(batch), int(dim)), (int(n), int(dim))],
+        dtypes=[np.dtype(np.float32)])
+    return int(config["block_n"])
+
+
+# ---------------------------------------------------------------------------
+# the searcher
+# ---------------------------------------------------------------------------
+
+class TieredSearcher:
+    """Budgeted-residency ANN search over one :class:`LoadedIndex`.
+
+    Drop-in for ``IvfIndexSearcher`` at the serving layer (``search`` /
+    ``warmup`` / ``prepare`` / ``trace_count`` / ``last_stats``), plus
+    the tier surface: :meth:`resident_bytes` (constant by construction
+    — the ``jimm_tier_device_resident_bytes`` gauge reads it),
+    :meth:`tier_stats`, :meth:`access_snapshot`, and :meth:`refresh`
+    (same-shape rebuild for growth, retrain, and re-tiering — never a
+    retrace while ``n_clusters`` and ``dim`` hold still).
+    """
+
+    def __init__(self, index: LoadedIndex, centroids: np.ndarray,
+                 assign: np.ndarray | None = None, *, k: int = 10,
+                 nprobe_max: int = 32,
+                 device_budget_bytes: int | None = None,
+                 host_budget_bytes: int | None = None,
+                 buckets: Sequence[int] = (1,),
+                 block_n: int | None = None, max_bpc: int = 8,
+                 shortlist: int = DEFAULT_SHORTLIST, pq_dsub: int = 2,
+                 pq_ksub: int = 256, aot_store: Any = None,
+                 artifacts: Any = None, label: str | None = None,
+                 seed: int = 0):
+        if len(index) == 0:
+            raise ValueError(f"index {index.name!r} is empty")
+        centroids = np.asarray(centroids, np.float32)
+        if centroids.ndim != 2 or centroids.shape[1] != index.dim:
+            raise ValueError(f"centroids must be (C, {index.dim}); got "
+                             f"{centroids.shape}")
+        self.index = index
+        self.k = int(k)
+        self.dim = int(index.dim)
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        self.n_clusters = int(centroids.shape[0])
+        self.nprobe_max = max(1, min(int(nprobe_max), self.n_clusters))
+        self.shortlist = max(int(shortlist), self.k)
+        self.pq_dsub, self.pq_ksub = int(pq_dsub), int(pq_ksub)
+        self.seed = int(seed)
+        self.label = label or f"retrieval_tier:{index.name}"
+        self.store = aot_store
+        self.block_n = _resolve_block_n(len(index), self.dim,
+                                        self.buckets[-1], block_n)
+        row_bytes = self.dim * 4
+        budget = int(device_budget_bytes
+                     if device_budget_bytes is not None
+                     else DEFAULT_DEVICE_BUDGET_MB << 20)
+        self.device_budget_bytes = budget
+        self.hot_nb = max(1, budget // (self.block_n * row_bytes))
+        self.max_bpc = max(1, min(int(max_bpc), self.hot_nb))
+        self.host_budget_bytes = host_budget_bytes
+        self._engine = (TierIoEngine(artifacts, label=index.name)
+                        if artifacts is not None else None)
+        self._clusters_padded = _ceil_to(self.n_clusters, _LANES)
+        self._dispatch_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._access = AccessStats(self.n_clusters)
+        self._tier = _AotProgram(
+            make_tier_fn(self.k, self.nprobe_max, self.max_bpc),
+            n_leaves=8, store=aot_store, label=self.label,
+            key_for=self._tier_key, arg_specs=self._tier_specs)
+        self._rescore = _AotProgram(
+            make_rescore_fn(self.k), n_leaves=3, store=aot_store,
+            label=f"{self.label}:rescore", key_for=self._rescore_key,
+            arg_specs=self._rescore_specs)
+        self.codec: PqCodec | None = None
+        self._resident: _Resident | None = None
+        self.warmup_report: dict[int, str] = {}
+        #: stats of the most recent search (obs gauges read these)
+        self.last_stats: dict[str, float] = {}
+        reg = get_registry("jimm_tier")
+        reg.gauge("jimm_tier_device_resident_bytes",
+                  lambda: float(self.resident_bytes()))
+        reg.gauge("jimm_tier_host_resident_bytes",
+                  lambda: float(self._resident.host_bytes))
+        reg.gauge("jimm_tier_cold_bytes",
+                  lambda: float(self._resident.plan.cold_bytes))
+        reg.gauge("jimm_tier_hot_clusters",
+                  lambda: float(len(self._resident.plan.hot)))
+        self._m_adc = reg.counter("jimm_tier_adc_rows_total")
+        self._m_warm_bytes = reg.counter("jimm_tier_warm_stream_bytes_total")
+        self._m_degraded = reg.counter("jimm_tier_degraded_queries_total")
+        self._install(index, assign, centroids, cid=None)
+
+    # -- residency build ---------------------------------------------------
+
+    def _install(self, index: LoadedIndex, assign: np.ndarray | None,
+                 centroids: np.ndarray, *, cid: str | None) -> None:
+        """Build a complete residency generation off-line, then swap it in
+        under the dispatch lock (assignments only — no IO under a lock).
+        Everything derives from the *new* index's live rows, so a row
+        tombstoned since the last generation cannot survive into this
+        one, whatever cold segments still sit on disk."""
+        import jax
+        from jimm_tpu.retrieval.ann.kmeans import assign_clusters
+        vectors = index.matrix_f32()
+        if assign is None:
+            assign = assign_clusters(vectors, centroids)
+        else:
+            assign = np.asarray(assign, np.int64).copy()
+            stale = np.flatnonzero(assign < 0)
+            if stale.size:
+                assign[stale] = assign_clusters(vectors[stale], centroids)
+        assign = np.asarray(assign, np.int64)
+        if assign.shape != (len(index),):
+            raise ValueError(f"assign must be ({len(index)},); got "
+                             f"{assign.shape}")
+        residuals = vectors - centroids[assign]
+        codec = train_pq(residuals, dsub=self.pq_dsub, ksub=self.pq_ksub,
+                         seed=self.seed)
+        codes_all = encode_rows(codec, residuals)
+        counts = np.bincount(assign, minlength=self.n_clusters)
+        with self._stats_lock:
+            ema = self._access.snapshot()
+        plan = plan_tiers(counts, ema, arena_blocks=self.hot_nb,
+                          block_n=self.block_n, row_bytes=self.dim * 4,
+                          max_bpc=self.max_bpc,
+                          host_budget_bytes=self.host_budget_bytes,
+                          cold_enabled=self._engine is not None)
+        positions = np.arange(len(index), dtype=np.int64)
+        hot_mask = np.isin(assign, np.asarray(plan.hot, np.int64)) \
+            if plan.hot else np.zeros(len(index), bool)
+        blocks, rids, cl_start, cl_count = cluster_layout(
+            vectors[hot_mask], assign[hot_mask], self.n_clusters,
+            block_n=self.block_n, row_ids=positions[hot_mask],
+            pad_blocks=self.hot_nb)
+        cp = self._clusters_padded
+        cents = np.zeros((cp, self.dim), np.float32)
+        cents[:self.n_clusters] = centroids
+        start_p = np.zeros(cp, np.int32)
+        count_p = np.zeros(cp, np.int32)
+        start_p[:self.n_clusters] = cl_start
+        count_p[:self.n_clusters] = cl_count
+        warm: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        codes: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        cold_fp: dict[int, str] = {}
+        host_bytes = 0
+        for c in plan.warm + plan.cold:
+            rows_c = np.flatnonzero(assign == c)
+            if not rows_c.size:
+                continue
+            codes[c] = (rows_c, codes_all[rows_c])
+            host_bytes += rows_c.nbytes + codes_all[rows_c].nbytes
+        for c in plan.warm:
+            entry = codes.get(c)
+            if entry is None:
+                continue
+            warm[c] = (entry[0], np.ascontiguousarray(vectors[entry[0]]))
+            host_bytes += warm[c][1].nbytes
+        for c in plan.cold:
+            entry = codes.get(c)
+            if entry is None:
+                continue
+            cold_fp[c] = self._engine.spill(
+                c, entry[0], vectors[entry[0]], cid=cid)
+        device_bytes = blocks.nbytes + rids.nbytes + cents.nbytes + \
+            start_p.nbytes + count_p.nbytes
+        resident = _Resident(
+            index=index, plan=plan, counts=counts,
+            blocks=jax.device_put(blocks),
+            row_ids=jax.device_put(rids),
+            centroids=jax.device_put(cents),
+            cl_start=jax.device_put(start_p),
+            cl_count=jax.device_put(count_p),
+            live_c=np.int32(self.n_clusters), cents_host=centroids,
+            warm=warm, codes=codes, cold_fp=cold_fp,
+            device_bytes=int(device_bytes), host_bytes=int(host_bytes))
+        self.codec = codec
+        with self._dispatch_lock:
+            self.index = index
+            self._resident = resident
+        get_journal().emit("tier_plan", cid=cid, rows=len(index),
+                           state=index.state, **plan.describe())
+
+    def refresh(self, index: LoadedIndex | None = None, *,
+                assign: np.ndarray | None = None,
+                centroids: np.ndarray | None = None,
+                cid: str | None = None) -> TierPlan:
+        """Install a new residency generation — after corpus growth, a
+        centroid retrain, or purely to re-tier by access frequency. The
+        compiled programs key on shapes this rebuild preserves, so a
+        refresh is never a retrace; changing ``n_clusters`` or ``dim``
+        is a rebuild-the-searcher event and is rejected here."""
+        index = self.index if index is None else index
+        if int(index.dim) != self.dim:
+            raise ValueError(f"index dim {index.dim} != searcher dim "
+                             f"{self.dim}")
+        centroids = (self._resident.cents_host if centroids is None
+                     else np.asarray(centroids, np.float32))
+        if centroids.shape != (self.n_clusters, self.dim):
+            raise ValueError(
+                f"centroids must stay ({self.n_clusters}, {self.dim}) "
+                f"(a different shape would retrace); got "
+                f"{centroids.shape}")
+        self._install(index, assign, centroids, cid=cid)
+        return self._resident.plan
+
+    # -- AOT keys ----------------------------------------------------------
+
+    def _tier_key(self, bucket: int):
+        from jimm_tpu.aot.keys import serve_forward_key
+        return serve_forward_key(
+            {"kind": "retrieval_tier", "nblocks": self.hot_nb,
+             "block_n": self.block_n, "dim": self.dim, "k": self.k,
+             "clusters_padded": self._clusters_padded,
+             "nprobe_max": self.nprobe_max, "max_bpc": self.max_bpc,
+             "corpus_dtype": "float32"},
+            method="retrieval_tier", bucket=int(bucket),
+            item_shape=(self.dim,), in_dtype=np.float32,
+            param_dtype="float32", mesh=None)
+
+    def _tier_specs(self, bucket: int):
+        import jax
+        cp = self._clusters_padded
+        return (
+            jax.ShapeDtypeStruct((self.hot_nb, self.block_n, self.dim),
+                                 np.float32),
+            jax.ShapeDtypeStruct((self.hot_nb, self.block_n), np.int32),
+            jax.ShapeDtypeStruct((cp, self.dim), np.float32),
+            jax.ShapeDtypeStruct((cp,), np.int32),
+            jax.ShapeDtypeStruct((cp,), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((int(bucket), self.dim), np.float32),
+        )
+
+    def _rescore_key(self, bucket: int):
+        from jimm_tpu.aot.keys import serve_forward_key
+        return serve_forward_key(
+            {"kind": "retrieval_tier_rescore",
+             "shortlist": self.shortlist, "dim": self.dim, "k": self.k},
+            method="retrieval_tier_rescore", bucket=int(bucket),
+            item_shape=(self.dim,), in_dtype=np.float32,
+            param_dtype="float32", mesh=None)
+
+    def _rescore_specs(self, bucket: int):
+        import jax
+        b = int(bucket)
+        return (
+            jax.ShapeDtypeStruct((b, self.shortlist, self.dim),
+                                 np.float32),
+            jax.ShapeDtypeStruct((b, self.shortlist), np.int32),
+            jax.ShapeDtypeStruct((b, self.dim), np.float32),
+        )
+
+    # -- warm-start / introspection ---------------------------------------
+
+    def trace_count(self) -> int:
+        return self._tier.trace_count() + self._rescore.trace_count()
+
+    def prepare(self, bucket: int) -> str:
+        sources = {self._tier.prepare(bucket),
+                   self._rescore.prepare(bucket)}
+        return sources.pop() if len(sources) == 1 else "mixed"
+
+    def warmup(self) -> dict[int, str]:
+        """Prepare + prime both programs for every bucket; returns the
+        {bucket: source} map the serve ready line reports."""
+        report: dict[int, str] = {}
+        for bucket in self.buckets:
+            report[bucket] = self.prepare(bucket)
+            zeros = np.zeros((bucket, self.dim), np.float32)
+            self.search(zeros, self.nprobe_max)
+        self.warmup_report = report
+        return report
+
+    def resident_bytes(self) -> int:
+        """Device-resident bytes — constant across growth/re-tiering by
+        construction (fixed arena + fixed tables)."""
+        return int(self._resident.device_bytes)
+
+    def tier_stats(self) -> dict:
+        """The daemon's (and healthz's) view of the current generation."""
+        res = self._resident
+        with self._stats_lock:
+            batches = self._access.batches
+        out = {"rows": len(res.index), "state": res.index.state,
+               "device_bytes": res.device_bytes,
+               "host_bytes": res.host_bytes,
+               "access_batches": batches,
+               "pq_bytes_per_row": self.codec.code_bytes_per_row(),
+               **res.plan.describe()}
+        if self._engine is not None:
+            out["io_pending"] = self._engine.pending()
+        return out
+
+    def access_snapshot(self) -> np.ndarray:
+        with self._stats_lock:
+            return self._access.snapshot()
+
+    def tier_plan(self) -> TierPlan:
+        return self._resident.plan
+
+    def propose_plan(self) -> TierPlan:
+        """The plan a re-tier *would* install right now, from the live
+        access EMA — the daemon diffs it against the installed plan to
+        decide whether re-tiering is worth a rebuild."""
+        res = self._resident
+        return plan_tiers(res.counts, self.access_snapshot(),
+                          arena_blocks=self.hot_nb, block_n=self.block_n,
+                          row_bytes=self.dim * 4, max_bpc=self.max_bpc,
+                          host_budget_bytes=self.host_budget_bytes,
+                          cold_enabled=self._engine is not None)
+
+    # -- search ------------------------------------------------------------
+
+    def _bucket_for(self, batch: int) -> int:
+        for bucket in self.buckets:
+            if batch <= bucket:
+                return bucket
+        raise ValueError(f"query batch {batch} exceeds largest retrieval "
+                         f"bucket {self.buckets[-1]}")
+
+    def search(self, queries: np.ndarray, nprobe: int | None = None
+               ) -> tuple[np.ndarray, np.ndarray, list[list[str]]]:
+        """Approximate top-k at the given probe width; same contract as
+        ``IvfIndexSearcher.search``. Hot clusters exact-score on device;
+        warm/cold candidates rank through the PQ ADC pass and the top
+        ``shortlist`` per query exact-rescore from full-precision rows,
+        so returned scores are never quantized estimates."""
+        nprobe = self.nprobe_max if nprobe is None else int(nprobe)
+        if not 1 <= nprobe <= self.nprobe_max:
+            raise ValueError(f"nprobe must be in [1, {self.nprobe_max}] "
+                             f"(the compiled probe width); got {nprobe}")
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if queries.ndim != 2 or queries.shape[1] != self.dim:
+            raise ValueError(f"queries must be (B, {self.dim}); got "
+                             f"{queries.shape}")
+        batch = queries.shape[0]
+        top = self.buckets[-1]
+        if batch > top:
+            outs = [self.search(queries[i:i + top], nprobe)
+                    for i in range(0, batch, top)]
+            return (np.concatenate([o[0] for o in outs], axis=0),
+                    np.concatenate([o[1] for o in outs], axis=0),
+                    sum((o[2] for o in outs), []))
+        qf = normalize_rows(queries)
+        bucket = self._bucket_for(batch)
+        qpad = np.zeros((bucket, self.dim), np.float32)
+        qpad[:batch] = qf
+        res = self._resident
+
+        # stage 1+2 on device: coarse probe + hot-arena exact rescore
+        with self._dispatch_lock:
+            out = self._tier(bucket, res.blocks, res.row_ids,
+                             res.centroids, res.cl_start, res.cl_count,
+                             res.live_c, np.int32(nprobe), qpad)
+        hot_vals = np.asarray(out[0], np.float32)[:batch]
+        hot_idx = np.asarray(out[1], np.int64)[:batch]
+        cand_hot = np.asarray(out[2], np.int64)[:batch]
+        sel = np.asarray(out[3], np.int64)[:batch, :nprobe]
+
+        with self._stats_lock:
+            self._access.record(sel.ravel())
+
+        # the probe names the non-hot clusters -> start the cold fetches
+        # *now*, so disk IO overlaps the host ADC pass below
+        probed = [set(int(c) for c in row if int(c) in res.codes)
+                  for row in sel]
+        # jaxlint: disable=JL011 bounded id set (<= B*nprobe), not scores
+        touched = sorted(set().union(*probed)) if probed else []
+        cold_needed = [c for c in touched if c in res.cold_fp]
+        for c in cold_needed:
+            self._engine.prefetch(c, res.cold_fp[c])
+
+        # host ADC over probed non-hot clusters: coarse term + LUT sums
+        luts = query_luts(self.codec, qf)            # (B, M, ksub)
+        coarse = qf @ res.cents_host.T               # (B, C)
+        m_iota = np.arange(self.codec.n_sub)[None, :]
+        cand_s: list[list[np.ndarray]] = [[] for _ in range(batch)]
+        cand_r: list[list[np.ndarray]] = [[] for _ in range(batch)]
+        cand_c: list[list[np.ndarray]] = [[] for _ in range(batch)]
+        cand_l: list[list[np.ndarray]] = [[] for _ in range(batch)]
+        adc_rows = 0
+        for c in touched:
+            rows_c, codes_c = res.codes[c]
+            qsel = [b for b in range(batch) if c in probed[b]]
+            if not qsel:
+                continue
+            est = luts[qsel][:, m_iota, codes_c].sum(
+                axis=2, dtype=np.float32)
+            est += coarse[qsel, c][:, None]
+            adc_rows += est.size
+            local = np.arange(len(rows_c), dtype=np.int64)
+            tag = np.full(len(rows_c), c, np.int64)
+            for j, b in enumerate(qsel):
+                cand_s[b].append(est[j])
+                cand_r[b].append(rows_c)
+                cand_c[b].append(tag)
+                cand_l[b].append(local)
+        self._m_adc.inc(adc_rows)
+
+        # drain the prefetches (stalls are timed/counted by the engine);
+        # a failed cold fetch degrades that query's candidates, loudly
+        staged: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        failed: set[int] = set()
+        for c in cold_needed:
+            try:
+                staged[c] = self._engine.collect(c)
+            except (KeyError, RuntimeError, TimeoutError):
+                failed.add(c)
+        if failed:
+            self._m_degraded.inc(len(failed))
+
+        # per-query shortlist -> fixed (bucket, S, D) rescore buffer
+        S = self.shortlist
+        rows_buf = np.zeros((bucket, S, self.dim), np.float32)
+        ids_buf = np.full((bucket, S), -1, np.int32)
+        warm_bytes = 0
+        for b in range(batch):
+            if not cand_s[b]:
+                continue
+            scores = np.concatenate(cand_s[b])
+            rids_b = np.concatenate(cand_r[b])
+            cls_b = np.concatenate(cand_c[b])
+            loc_b = np.concatenate(cand_l[b])
+            if len(scores) > S:
+                keep = np.argpartition(scores, -S)[-S:]
+                rids_b, cls_b, loc_b = rids_b[keep], cls_b[keep], \
+                    loc_b[keep]
+            slot = 0
+            for rid, c, loc in zip(rids_b, cls_b, loc_b):
+                c = int(c)
+                if c in failed:
+                    continue
+                if c in res.warm:
+                    row = res.warm[c][1][loc]
+                    warm_bytes += row.nbytes
+                else:
+                    row = staged[c][1][loc]
+                rows_buf[b, slot] = row
+                ids_buf[b, slot] = rid
+                slot += 1
+        self._m_warm_bytes.inc(warm_bytes)
+
+        # stage 3 on device: exact rescore of the streamed shortlist
+        with self._dispatch_lock:
+            v2, i2 = self._rescore(bucket, rows_buf, ids_buf, qpad)
+        v2 = np.asarray(v2, np.float32)[:batch]
+        i2 = np.asarray(i2, np.int64)[:batch]
+
+        k_eff = min(self.k, len(res.index))
+        vals, idx = merge_partials(np.stack([hot_vals, v2]),
+                                   np.stack([hot_idx, i2]), k_eff)
+        ids = [[res.index.ids[j] for j in row if j >= 0] for row in idx]
+        found = float(np.mean([len(row) for row in ids])) if len(ids) \
+            else 0.0
+        n_probed = max(sum(len(p) for p in probed) + 1e-9, 1e-9)
+        self.last_stats = {
+            "nprobe": float(nprobe),
+            "candidate_frac": round(
+                (float(cand_hot.sum()) + adc_rows)
+                / max(batch * len(res.index), 1), 6),
+            "fill_ratio": round(found / max(k_eff, 1), 6),
+            "hot_frac": round(1.0 - sum(len(p) for p in probed)
+                              / max(batch * nprobe, 1), 6),
+            "cold_fetches": float(len(cold_needed)),
+            "degraded_clusters": float(len(failed)),
+        }
+        return vals, idx, ids
+
+    def close(self) -> None:
+        if self._engine is not None:
+            self._engine.close()

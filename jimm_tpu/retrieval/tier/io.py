@@ -1,0 +1,248 @@
+"""Cold-tier IO engine: disk spill + journaled, double-buffered fetch.
+
+Cold clusters live as content-addressed segments on the aot
+:class:`~jimm_tpu.aot.store.ArtifactStore` (same atomic-install /
+quarantine / LRU discipline as compiled programs). The engine owns one
+daemon worker thread: request threads never touch disk — they enqueue a
+:meth:`prefetch` right after the device-side probe names the clusters,
+run the host-side ADC shortlist while the worker streams bytes in, and
+only then :meth:`collect` the staged rows. When the scan genuinely
+outruns the disk, the wait is timed under a ``tier_stall`` span (→
+``jimm_spans_tier_stall_seconds`` on the timeline) and counted on
+``jimm_tier_stalls_total`` — stalls are a first-class signal, not a
+silent latency tax. Every transfer is journaled (``tier_spill`` /
+``tier_fetch`` / ``tier_fetch_failed``) on the caller's correlation id.
+
+A corrupt or truncated cold segment is quarantined and the fetch fails
+loudly; the searcher degrades that query's candidates rather than
+serving rows it cannot trust.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import queue
+import threading
+import time
+
+import numpy as np
+
+from jimm_tpu.obs import get_journal, get_registry, span
+
+__all__ = ["TIER_FORMAT_VERSION", "TierIoEngine", "decode_cluster",
+           "encode_cluster"]
+
+#: bump when the cold-segment framing changes — old artifacts quarantine
+TIER_FORMAT_VERSION = 1
+
+#: an honest upper bound for one cluster fetch; a disk this slow is an
+#: incident, not a stall
+_COLLECT_TIMEOUT_S = 60.0
+
+
+def encode_cluster(cluster: int, row_ids: np.ndarray,
+                   rows: np.ndarray) -> bytes:
+    """Frame one cluster's full-precision rows as a cold segment:
+    header JSON line, then row ids (int64), then rows (float32)."""
+    row_ids = np.ascontiguousarray(row_ids, np.int64)
+    rows = np.ascontiguousarray(rows, np.float32)
+    if rows.ndim != 2 or len(row_ids) != len(rows):
+        raise ValueError(f"rows {rows.shape} / row_ids {row_ids.shape} "
+                         f"mismatch")
+    header = {"tier_format": TIER_FORMAT_VERSION, "cluster": int(cluster),
+              "rows": int(len(rows)), "dim": int(rows.shape[1])}
+    return json.dumps(header, sort_keys=True,
+                      separators=(",", ":")).encode() + b"\n" + \
+        row_ids.tobytes() + rows.tobytes()
+
+
+def decode_cluster(payload: bytes) -> tuple[int, np.ndarray, np.ndarray]:
+    """Inverse of :func:`encode_cluster` → ``(cluster, row_ids, rows)``;
+    raises ValueError on bad framing (callers quarantine)."""
+    head, sep, body = payload.partition(b"\n")
+    if not sep:
+        raise ValueError("cold segment has no header line")
+    try:
+        header = json.loads(head)
+    except ValueError as e:
+        raise ValueError(f"bad cold-segment header: {e}") from None
+    if header.get("tier_format") != TIER_FORMAT_VERSION:
+        raise ValueError(f"tier_format {header.get('tier_format')!r} != "
+                         f"{TIER_FORMAT_VERSION}")
+    n, dim = int(header["rows"]), int(header["dim"])
+    ids_bytes = n * 8
+    if len(body) != ids_bytes + n * dim * 4:
+        raise ValueError(f"cold segment body is {len(body)} bytes, header "
+                         f"promises {ids_bytes + n * dim * 4}")
+    row_ids = np.frombuffer(body[:ids_bytes], np.int64).copy()
+    rows = np.frombuffer(body[ids_bytes:], np.float32).reshape(n, dim)
+    return int(header["cluster"]), row_ids, rows.copy()
+
+
+class _Staged:
+    __slots__ = ("ready", "row_ids", "rows", "error", "waiters")
+
+    def __init__(self):
+        self.ready = threading.Event()
+        self.row_ids = None
+        self.rows = None
+        self.error: str | None = None
+        #: concurrent searches waiting on this fetch — the entry is
+        #: consumed only when the LAST waiter collects, so two request
+        #: threads deduping onto one disk read both get the rows
+        self.waiters = 0
+
+
+class TierIoEngine:
+    """Spill clusters to the artifact store; stream them back on demand.
+
+    One daemon worker drains the fetch queue so disk latency overlaps
+    the host-side ADC pass (FastUSP's overlap-transfer-behind-compute,
+    one level up the hierarchy). ``prefetch`` and ``collect`` are safe
+    from any thread; the staging table is guarded by its own lock and
+    no lock is ever held across disk IO or an event wait.
+    """
+
+    def __init__(self, artifacts, *, label: str = "index"):
+        self.artifacts = artifacts
+        self.label = str(label)
+        self._queue: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+        self._staged: dict[int, _Staged] = {}
+        reg = get_registry("jimm_tier")
+        self._m_spills = reg.counter("jimm_tier_spills_total")
+        self._m_fetches = reg.counter("jimm_tier_cold_fetches_total")
+        self._m_fetch_bytes = reg.counter("jimm_tier_cold_fetch_bytes_total")
+        self._m_failed = reg.counter("jimm_tier_fetch_failures_total")
+        self._m_stalls = reg.counter("jimm_tier_stalls_total")
+        self._worker = threading.Thread(target=self._drain,
+                                        name=f"tier-io-{self.label}",
+                                        daemon=True)
+        self._worker.start()
+
+    # -- spill ------------------------------------------------------------
+
+    def spill(self, cluster: int, row_ids: np.ndarray, rows: np.ndarray,
+              *, cid: str | None = None) -> str:
+        """Write one cluster cold; returns its artifact fingerprint.
+
+        Content-addressed: re-spilling identical rows is a no-op put, and
+        a re-tiered layout never aliases a stale segment.
+        """
+        payload = encode_cluster(cluster, row_ids, rows)
+        digest = hashlib.sha256(payload).hexdigest()[:16]
+        fp = f"tier-{self.label}-c{int(cluster)}-{digest}"
+        if not self.artifacts.contains(fp):
+            self.artifacts.put(fp, payload, {
+                "kind": "tier_cluster", "cluster": int(cluster),
+                "rows": int(len(rows)), "label": self.label,
+                "tier_format": TIER_FORMAT_VERSION})
+        self._m_spills.inc()
+        get_journal().emit("tier_spill", cid=cid, cluster=int(cluster),
+                           bytes=len(payload), fingerprint=fp)
+        return fp
+
+    # -- fetch ------------------------------------------------------------
+
+    def prefetch(self, cluster: int, fingerprint: str,
+                 *, cid: str | None = None) -> None:
+        """Enqueue a cold fetch. Dedups onto an already-staged or
+        in-flight entry — but every call registers a waiter, so each
+        matching :meth:`collect` (one per prefetch, from any thread)
+        gets the rows off the single disk read."""
+        with self._lock:
+            entry = self._staged.get(cluster)
+            if entry is not None:
+                entry.waiters += 1
+                return
+            entry = _Staged()
+            entry.waiters = 1
+            self._staged[cluster] = entry
+        self._queue.put((int(cluster), fingerprint, cid,
+                         time.monotonic()))
+
+    def collect(self, cluster: int,
+                *, timeout_s: float = _COLLECT_TIMEOUT_S
+                ) -> tuple[np.ndarray, np.ndarray]:
+        """Staged ``(row_ids, rows)`` for a prefetched cluster; blocks
+        (timed as a stall) only when the fetch has not landed yet. The
+        last waiter consumes the entry — staging stays bounded by the
+        probe width times the concurrent request fan-in."""
+        with self._lock:
+            entry = self._staged.get(cluster)
+        if entry is None:
+            raise KeyError(f"cluster {cluster} was never prefetched")
+        if not entry.ready.is_set():
+            self._m_stalls.inc()
+            with span("tier_stall"):
+                ok = entry.ready.wait(timeout_s)
+            if not ok:
+                self._release(cluster, entry)
+                raise TimeoutError(f"cold fetch of cluster {cluster} "
+                                   f"exceeded {timeout_s:.0f}s")
+        self._release(cluster, entry)
+        if entry.error is not None:
+            raise RuntimeError(f"cold fetch of cluster {cluster} failed: "
+                               f"{entry.error}")
+        return entry.row_ids, entry.rows
+
+    def _release(self, cluster: int, entry: _Staged) -> None:
+        with self._lock:
+            entry.waiters -= 1
+            if entry.waiters <= 0 and self._staged.get(cluster) is entry:
+                del self._staged[cluster]
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._staged)
+
+    def close(self) -> None:
+        self._queue.put(None)
+        self._worker.join(timeout=5.0)
+
+    # -- worker -----------------------------------------------------------
+
+    def _drain(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            cluster, fp, cid, t_enq = item
+            t0 = time.monotonic()
+            err = None
+            row_ids = rows = None
+            try:
+                payload = self.artifacts.get(fp)
+                if payload is None:
+                    err = f"artifact {fp} missing"
+                else:
+                    got, row_ids, rows = decode_cluster(payload)
+                    if got != cluster:
+                        raise ValueError(f"segment names cluster {got}")
+            except ValueError as e:
+                self.artifacts.quarantine(fp, f"tier decode: {e}")
+                err = str(e)
+            except Exception as e:  # noqa: BLE001 — a dead worker would
+                err = str(e)        # strand every future collect
+
+            with self._lock:
+                entry = self._staged.get(cluster)
+            if entry is None:          # consumed by a timed-out collect
+                continue
+            dur = time.monotonic() - t0
+            if err is None:
+                entry.row_ids, entry.rows = row_ids, rows
+                self._m_fetches.inc()
+                self._m_fetch_bytes.inc(rows.nbytes + row_ids.nbytes)
+                get_journal().emit("tier_fetch", cid=cid,
+                                   cluster=cluster, tier="cold",
+                                   bytes=int(rows.nbytes), dur_s=dur,
+                                   queued_s=t0 - t_enq)
+            else:
+                entry.error = err
+                self._m_failed.inc()
+                get_journal().emit("tier_fetch_failed", cid=cid,
+                                   cluster=cluster, fingerprint=fp,
+                                   error=err)
+            entry.ready.set()

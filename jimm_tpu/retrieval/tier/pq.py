@@ -1,0 +1,226 @@
+"""Product-quantization residual codec: 8× smaller resident bytes.
+
+The coarse quantizer (the IVF codebook) already explains most of a row's
+energy — what a tier holds per row is the **residual** ``r = row -
+centroid[assign]``. This module quantizes residuals product-wise: the D
+dims split into ``M = D / dsub`` independent subspaces, each with its own
+``ksub``-entry sub-codebook, so one row stores as ``M`` uint8 codes. At
+the defaults (``dsub=2``, ``ksub=256``) that is ``D/2`` bytes against the
+``4D`` of float32 — the 8× the ROADMAP names.
+
+Scoring is **asymmetric distance computation** (ADC): queries stay full
+precision, only the corpus side is coded. For the cosine/dot metric,
+
+    q . row  =  q . centroid[c]  +  q . r
+             ~  coarse_score     +  sum_m lut[m, code[n, m]]
+
+where ``lut[m, j] = q_sub[m] . codebooks[m, j]`` is one small ``(M,
+ksub)`` table per query — built once, then every coded row scores in M
+byte-indexed adds, no decode. The coarse term is already computed by the
+device-side probe, so ADC here ranks rows *within* probed clusters; the
+exact-rescore stage re-ranks the shortlist from full-precision rows, so
+the measured recall frontier stays honest (quantization error can demote
+a candidate out of the shortlist, never corrupt a reported score).
+
+Pure NumPy, no jax: codecs train/encode/score on host (the tier IO
+engine's side of the hierarchy), and the CLI stays accelerator-free.
+Training is a seeded per-subspace Lloyd's over a bounded sample — CI
+trains in milliseconds, and the same seed reproduces the same codec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+__all__ = ["PQ_FORMAT_VERSION", "PqCodec", "adc_scores", "decode_pq",
+           "encode_pq", "encode_rows", "query_luts", "train_pq"]
+
+#: bump when the codec payload framing changes — stale artifacts then
+#: fail loudly instead of decoding garbage
+PQ_FORMAT_VERSION = 1
+
+#: training sample cap: Lloyd's over more rows buys nothing a tier can
+#: measure, and the daemon retrains on a schedule anyway
+_TRAIN_SAMPLE_ROWS = 65536
+
+_ASSIGN_CHUNK = 8192
+
+
+@dataclasses.dataclass(frozen=True)
+class PqCodec:
+    """One trained product quantizer: ``codebooks (M, ksub, dsub)`` f32.
+
+    ``meta`` carries provenance (trained rows, seed) for the artifact
+    header; equality of two codecs is equality of their codebook bytes.
+    """
+
+    codebooks: np.ndarray
+    meta: dict
+
+    @property
+    def n_sub(self) -> int:
+        return int(self.codebooks.shape[0])
+
+    @property
+    def ksub(self) -> int:
+        return int(self.codebooks.shape[1])
+
+    @property
+    def dsub(self) -> int:
+        return int(self.codebooks.shape[2])
+
+    @property
+    def dim(self) -> int:
+        return self.n_sub * self.dsub
+
+    def code_bytes_per_row(self) -> int:
+        return self.n_sub
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PqCodec) and \
+            self.codebooks.shape == other.codebooks.shape and \
+            bool(np.array_equal(self.codebooks, other.codebooks))
+
+
+def _split_sub(mat: np.ndarray, n_sub: int, dsub: int) -> np.ndarray:
+    """(N, D) -> (M, N, dsub) contiguous subspace views."""
+    n = mat.shape[0]
+    return np.ascontiguousarray(
+        mat.reshape(n, n_sub, dsub).transpose(1, 0, 2))
+
+
+def train_pq(residuals: np.ndarray, *, dsub: int = 2, ksub: int = 256,
+             iters: int = 10, seed: int = 0) -> PqCodec:
+    """Train per-subspace sub-codebooks over ``(N, D)`` residuals.
+
+    ``D`` must divide by ``dsub``; ``ksub`` caps at 256 (codes are uint8)
+    and clamps down to the sample size when the corpus is tiny. Seeded
+    and deterministic: same residual sample, same codec.
+    """
+    residuals = np.asarray(residuals, np.float32)
+    if residuals.ndim != 2:
+        raise ValueError(f"residuals must be (N, D); got "
+                         f"{residuals.shape}")
+    n, dim = residuals.shape
+    dsub = int(dsub)
+    if dsub < 1 or dim % dsub:
+        raise ValueError(f"dsub={dsub} must divide dim {dim}")
+    if not 1 <= int(ksub) <= 256:
+        raise ValueError(f"ksub={ksub} outside [1, 256] (uint8 codes)")
+    n_sub = dim // dsub
+    rng = np.random.default_rng(seed)
+    if n > _TRAIN_SAMPLE_ROWS:
+        sample = residuals[rng.choice(n, _TRAIN_SAMPLE_ROWS,
+                                      replace=False)]
+    else:
+        sample = residuals
+    k = max(1, min(int(ksub), len(sample) or 1))
+    subs = _split_sub(sample, n_sub, dsub)          # (M, Ns, dsub)
+    books = np.zeros((n_sub, k, dsub), np.float32)
+    for m in range(n_sub):
+        pts = subs[m]
+        init = rng.choice(len(pts), k, replace=len(pts) < k) \
+            if len(pts) else np.zeros(k, np.int64)
+        cents = pts[init].copy() if len(pts) else books[m]
+        for _ in range(max(1, int(iters))):
+            # one Lloyd's step: nearest-center assign + mean update;
+            # ||p - c||^2 argmin == argmax(p.c - ||c||^2/2) (dot trick)
+            scores = pts @ cents.T - 0.5 * np.sum(cents * cents, axis=1)
+            assign = np.argmax(scores, axis=1)
+            counts = np.bincount(assign, minlength=k).astype(np.float32)
+            sums = np.zeros((k, dsub), np.float32)
+            np.add.at(sums, assign, pts)
+            live = counts > 0
+            cents[live] = sums[live] / counts[live, None]
+            # dead centers re-seed on the farthest points so every code
+            # stays usable (mirrors kmeans.train_centroids' resplit)
+            if not live.all() and len(pts):
+                dead_idx = np.flatnonzero(~live)[:len(pts)]
+                far = np.argpartition(np.max(scores, axis=1),
+                                      min(len(dead_idx),
+                                          len(pts) - 1))
+                cents[dead_idx] = pts[far[:len(dead_idx)]]
+        books[m, :k] = cents
+    return PqCodec(codebooks=books,
+                   meta={"trained_rows": int(len(sample)),
+                         "seed": int(seed), "iters": int(iters)})
+
+
+def encode_rows(codec: PqCodec, residuals: np.ndarray) -> np.ndarray:
+    """Quantize ``(N, D)`` residuals to ``(N, M)`` uint8 codes."""
+    residuals = np.asarray(residuals, np.float32)
+    n = residuals.shape[0]
+    if residuals.shape != (n, codec.dim):
+        raise ValueError(f"residuals must be (N, {codec.dim}); got "
+                         f"{residuals.shape}")
+    codes = np.zeros((n, codec.n_sub), np.uint8)
+    half = 0.5 * np.sum(codec.codebooks * codec.codebooks, axis=2)
+    for lo in range(0, n, _ASSIGN_CHUNK):
+        chunk = _split_sub(residuals[lo:lo + _ASSIGN_CHUNK],
+                           codec.n_sub, codec.dsub)
+        for m in range(codec.n_sub):
+            scores = chunk[m] @ codec.codebooks[m].T - half[m]
+            codes[lo:lo + _ASSIGN_CHUNK, m] = np.argmax(scores, axis=1)
+    return codes
+
+
+def query_luts(codec: PqCodec, queries: np.ndarray) -> np.ndarray:
+    """ADC lookup tables for ``(B, D)`` queries: ``(B, M, ksub)`` where
+    ``lut[b, m, j] = q_sub[b, m] . codebooks[m, j]``."""
+    queries = np.asarray(queries, np.float32)
+    if queries.ndim == 1:
+        queries = queries[None, :]
+    qs = queries.reshape(queries.shape[0], codec.n_sub, codec.dsub)
+    return np.einsum("bmd,mjd->bmj", qs, codec.codebooks,
+                     dtype=np.float32)
+
+
+def adc_scores(codec: PqCodec, lut: np.ndarray,
+               codes: np.ndarray) -> np.ndarray:
+    """Residual dot-product estimates for one query's ``(M, ksub)`` lut
+    against ``(N, M)`` codes: ``(N,)`` f32, ``sum_m lut[m, codes[:, m]]``.
+    Add the coarse ``q . centroid`` term for a full score estimate."""
+    codes = np.asarray(codes)
+    return lut[np.arange(codec.n_sub)[None, :],
+               codes.astype(np.int64)].sum(axis=1, dtype=np.float32)
+
+
+# ---------------------------------------------------------------------------
+# artifact framing (same header-line + raw-bytes shape as segments)
+# ---------------------------------------------------------------------------
+
+def encode_pq(codec: PqCodec) -> bytes:
+    """Frame a codec as one content-addressable payload."""
+    header = {"pq_format": PQ_FORMAT_VERSION, "n_sub": codec.n_sub,
+              "ksub": codec.ksub, "dsub": codec.dsub, **codec.meta}
+    return json.dumps(header, sort_keys=True,
+                      separators=(",", ":")).encode() + b"\n" + \
+        np.ascontiguousarray(codec.codebooks, np.float32).tobytes()
+
+
+def decode_pq(payload: bytes) -> PqCodec:
+    """Inverse of :func:`encode_pq`; raises ValueError on bad framing
+    (callers quarantine)."""
+    head, sep, body = payload.partition(b"\n")
+    if not sep:
+        raise ValueError("pq payload has no header line")
+    try:
+        header = json.loads(head)
+    except ValueError as e:
+        raise ValueError(f"bad pq header: {e}") from None
+    if header.get("pq_format") != PQ_FORMAT_VERSION:
+        raise ValueError(f"pq_format {header.get('pq_format')!r} != "
+                         f"{PQ_FORMAT_VERSION}")
+    shape = (int(header["n_sub"]), int(header["ksub"]),
+             int(header["dsub"]))
+    expected = shape[0] * shape[1] * shape[2] * 4
+    if len(body) != expected:
+        raise ValueError(f"pq body is {len(body)} bytes, header promises "
+                         f"{expected}")
+    books = np.frombuffer(body, np.float32).reshape(shape).copy()
+    meta = {k: v for k, v in header.items()
+            if k not in ("pq_format", "n_sub", "ksub", "dsub")}
+    return PqCodec(codebooks=books, meta=meta)

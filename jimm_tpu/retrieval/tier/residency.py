@@ -1,0 +1,155 @@
+"""Tier planning: which clusters live where, under explicit byte budgets.
+
+A :class:`TierPlan` assigns every cluster to exactly one residency tier:
+
+- **hot** — full-precision rows packed into the fixed-capacity device
+  arena (``device_budget_bytes`` worth of ``block_n``-row blocks). The
+  arena's *shape* never changes — growth repacks its contents, so the
+  compiled rescore program and the ``jimm_tier_device_resident_bytes``
+  gauge both stay flat by construction.
+- **warm** — full-precision rows pinned in host RAM, streamed onto
+  device per probe.
+- **cold** — full-precision rows spilled to disk segments on the
+  artifact store, fetched by the IO engine when probed.
+
+Placement is greedy by access frequency: clusters sort on their decayed
+access EMA (ties broken by cluster id, so planning is deterministic) and
+fill hot until the arena is full, then warm until the host budget runs
+out, and the remainder goes cold. A cluster wider than ``max_bpc``
+blocks is never hot — the compiled scan's per-cluster span is a
+build-time constant, so an oversize cluster would force a retrace.
+
+PQ codes for every non-hot cluster always stay host-resident (they are
+the 8× compressed form — the whole point is that *they* fit when the
+full-precision rows do not), so the planner only budgets full-precision
+bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["AccessStats", "TierPlan", "plan_tiers"]
+
+#: decay applied to every cluster's access EMA per recorded batch — high
+#: enough that a burst promotes quickly, low enough that one quiet period
+#: does not evict the working set
+EMA_DECAY = 0.9
+
+
+class AccessStats:
+    """Per-cluster probe-frequency EMA the planner ranks on.
+
+    ``record`` is called with the probed cluster ids of one search batch;
+    all counters decay together so the ranking is a frequency, not a
+    lifetime total. Snapshotting is cheap (one array copy) — the daemon
+    reads it from its own thread.
+    """
+
+    def __init__(self, n_clusters: int):
+        self.ema = np.zeros(int(n_clusters), np.float64)
+        self.batches = 0
+
+    def record(self, probed: np.ndarray) -> None:
+        self.ema *= EMA_DECAY
+        hit = np.unique(np.asarray(probed, np.int64))
+        hit = hit[(hit >= 0) & (hit < len(self.ema))]
+        self.ema[hit] += 1.0
+        self.batches += 1
+
+    def snapshot(self) -> np.ndarray:
+        return self.ema.copy()
+
+
+@dataclasses.dataclass(frozen=True)
+class TierPlan:
+    """One residency assignment. ``hot``/``warm``/``cold`` are sorted
+    cluster-id tuples; byte totals are full-precision row bytes per tier
+    (the arena's *allocated* bytes are fixed elsewhere — ``hot_bytes``
+    here is the used portion)."""
+
+    hot: tuple[int, ...]
+    warm: tuple[int, ...]
+    cold: tuple[int, ...]
+    hot_blocks: int
+    hot_bytes: int
+    warm_bytes: int
+    cold_bytes: int
+
+    def tier_of(self, cluster: int) -> str:
+        if cluster in self._hot_set:
+            return "hot"
+        if cluster in self._warm_set:
+            return "warm"
+        return "cold"
+
+    @property
+    def _hot_set(self) -> frozenset:
+        return frozenset(self.hot)
+
+    @property
+    def _warm_set(self) -> frozenset:
+        return frozenset(self.warm)
+
+    def describe(self) -> dict:
+        return {"hot_clusters": len(self.hot),
+                "warm_clusters": len(self.warm),
+                "cold_clusters": len(self.cold),
+                "hot_blocks": self.hot_blocks,
+                "hot_bytes": self.hot_bytes,
+                "warm_bytes": self.warm_bytes,
+                "cold_bytes": self.cold_bytes}
+
+
+def plan_tiers(counts: np.ndarray, ema: np.ndarray, *,
+               arena_blocks: int, block_n: int, row_bytes: int,
+               max_bpc: int,
+               host_budget_bytes: int | None = None,
+               cold_enabled: bool = True) -> TierPlan:
+    """Greedy residency assignment for ``counts[c]`` rows per cluster.
+
+    ``arena_blocks`` is the device arena capacity in blocks;
+    ``row_bytes`` is one full-precision row (``dim * itemsize``). With
+    ``cold_enabled=False`` (no artifact store to spill to) everything
+    that misses the arena is warm regardless of the host budget.
+    """
+    counts = np.asarray(counts, np.int64)
+    ema = np.asarray(ema, np.float64)
+    n_clusters = len(counts)
+    if ema.shape != (n_clusters,):
+        raise ValueError(f"ema must be ({n_clusters},); got {ema.shape}")
+    blocks_per = (counts + block_n - 1) // block_n
+    # rank: hottest first, deterministic tie order by cluster id
+    order = np.lexsort((np.arange(n_clusters), -ema))
+    hot: list[int] = []
+    warm: list[int] = []
+    cold: list[int] = []
+    free = int(arena_blocks)
+    host_free = (float("inf") if host_budget_bytes is None
+                 else int(host_budget_bytes))
+    hot_bytes = warm_bytes = cold_bytes = 0
+    for c in (int(i) for i in order):
+        if not counts[c]:
+            # empty clusters are nominally hot: probing one costs nothing
+            hot.append(c)
+            continue
+        nbytes = int(counts[c]) * row_bytes
+        nblocks = int(blocks_per[c])
+        if nblocks <= free and nblocks <= max_bpc:
+            hot.append(c)
+            free -= nblocks
+            hot_bytes += nbytes
+        elif not cold_enabled or nbytes <= host_free:
+            warm.append(c)
+            host_free -= nbytes
+            warm_bytes += nbytes
+        else:
+            cold.append(c)
+            cold_bytes += nbytes
+    return TierPlan(hot=tuple(sorted(hot)), warm=tuple(sorted(warm)),
+                    cold=tuple(sorted(cold)),
+                    hot_blocks=int(arena_blocks) - free,
+                    hot_bytes=hot_bytes, warm_bytes=warm_bytes,
+                    cold_bytes=cold_bytes)

@@ -283,6 +283,12 @@ class Searcher:
     def trace_count(self) -> int:
         return self._traces["count"]
 
+    def resident_bytes(self) -> int:
+        """Device-resident corpus bytes (padded blocks + offset table) —
+        the same accounting the tiered searcher reports, so serve_bench
+        rows compare across index modes."""
+        return int(self._blocks.nbytes) + int(self._offsets.nbytes)
+
     # -- AOT keys ---------------------------------------------------------
 
     def key_for(self, bucket: int):
@@ -487,6 +493,9 @@ class IndexSearcher:
 
     def trace_count(self) -> int:
         return sum(s.trace_count() for s in self.searchers)
+
+    def resident_bytes(self) -> int:
+        return sum(s.resident_bytes() for s in self.searchers)
 
     def prepare(self, bucket: int) -> str:
         sources = {s.prepare(bucket) for s in self.searchers}

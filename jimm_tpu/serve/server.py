@@ -495,8 +495,8 @@ class ServingServer:
                "scores": [round(float(v), 6)
                           for v in values[0][:len(ids[0])]],
                "trace_id": rid}
-        if self.retrieval.mode == "ivf":
-            out["index_mode"] = "ivf"
+        if self.retrieval.mode in ("ivf", "tiered"):
+            out["index_mode"] = self.retrieval.mode
             out["nprobe"] = int(
                 self.retrieval.searcher.last_stats.get(
                     "nprobe", self.retrieval.default_nprobe))

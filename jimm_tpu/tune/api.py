@@ -30,7 +30,7 @@ from jimm_tpu.tune.space import (bias_flash_space, flash_space,
                                  fp8_matmul_space, int8_flash_space,
                                  int8_matmul_space, ivf_space, ln_space,
                                  masked_flash_space, retrieval_space,
-                                 sigmoid_space)
+                                 sigmoid_space, tier_space)
 
 __all__ = ["KERNELS", "KernelSpec", "best_config", "configure", "get_cache",
            "tune_kernel"]
@@ -248,6 +248,48 @@ def _ivf_bench(shapes: Shapes, dtypes: Dtypes,
                         cl_start, cl_count, live_c, nprobe, queries)
 
 
+def _tier_default(shapes: Shapes, dtypes: Dtypes) -> dict:
+    # opposite preference to _ivf_default: block_n is also the hot
+    # arena's allocation quantum, and a small corpus-per-cluster means a
+    # large block mostly buys padding — pick the *smallest* feasible
+    # block at or above the lane width so the budget packs more clusters
+    feasible = {c["block_n"] for c in tier_space(shapes, dtypes)}
+    return {"block_n": min(feasible)}
+
+
+def _tier_bench(shapes: Shapes, dtypes: Dtypes,
+                config: Mapping[str, int]) -> Callable[[], Any]:
+    """Timed closure: one hot-arena tier pass (coarse scan + probe +
+    rescore + probe-selection output) at the candidate block over a
+    synthetic clustered corpus. Explicit block_n bypasses the tuner —
+    no recursion."""
+    import jax
+    import numpy as np
+
+    from jimm_tpu.retrieval.ann.ivf import cluster_layout
+    from jimm_tpu.retrieval.ann.kmeans import (assign_clusters,
+                                               clustered_rows)
+    from jimm_tpu.retrieval.tier.engine import make_tier_fn
+    batch, dim = int(shapes[0][-2]), int(shapes[0][-1])
+    n_rows = int(shapes[-1][-2])
+    dt = np.dtype(dtypes[-1]) if dtypes else np.dtype(np.float32)
+    clusters = max(1, min(64, n_rows // 64))
+    rows, cents = clustered_rows(n_rows, dim, clusters, seed=0)
+    corpus = np.asarray(rows, dt)
+    assign = assign_clusters(rows, cents)
+    blocks, rids, cl_start, cl_count = cluster_layout(
+        corpus, assign, clusters, block_n=int(config["block_n"]))
+    nprobe_max = max(1, min(8, clusters))
+    max_bpc = max(1, int(cl_count.max(initial=0)))
+    rng = np.random.default_rng(1)
+    queries = rng.standard_normal((batch, dim), dtype=np.float32)
+    step = jax.jit(make_tier_fn(10, nprobe_max, max_bpc))
+    live_c = np.int32(clusters)
+    nprobe = np.int32(nprobe_max)
+    return lambda: step(blocks, rids, np.asarray(cents, np.float32),
+                        cl_start, cl_count, live_c, nprobe, queries)
+
+
 def _int8_matmul_default(shapes: Shapes, dtypes: Dtypes) -> dict:
     from jimm_tpu.ops.int8_matmul import DEFAULT_BLOCK_M, DEFAULT_BLOCK_N
     return {"block_m": DEFAULT_BLOCK_M, "block_n": DEFAULT_BLOCK_N}
@@ -371,6 +413,9 @@ KERNELS: dict[str, KernelSpec] = {
     "retrieval_ivf": KernelSpec(version=1, space=ivf_space,
                                 default=_ivf_default,
                                 bench=_ivf_bench),
+    "retrieval_tier": KernelSpec(version=1, space=tier_space,
+                                 default=_tier_default,
+                                 bench=_tier_bench),
     "int8_matmul": KernelSpec(version=1, space=int8_matmul_space,
                               default=_int8_matmul_default,
                               bench=_int8_matmul_bench),

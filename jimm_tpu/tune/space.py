@@ -23,7 +23,7 @@ __all__ = ["FLASH_BLOCKS", "FP8_MATMUL_BLOCK_M", "FP8_MATMUL_BLOCK_N",
            "kernel_space", "ln_space",
            "ln_vmem_bytes", "masked_flash_space", "masked_flash_vmem_bytes",
            "retrieval_space", "retrieval_vmem_bytes", "sigmoid_space",
-           "sigmoid_vmem_bytes"]
+           "sigmoid_vmem_bytes", "tier_space"]
 
 _LANES = 128
 _SUBLANES = 8
@@ -211,6 +211,17 @@ def ivf_space(shapes: Sequence[Sequence[int]],
     return out or [{"block_n": RETRIEVAL_BLOCK_N[0]}]
 
 
+def tier_space(shapes: Sequence[Sequence[int]],
+               dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Feasible ``{"block_n"}`` candidates for the tiered searcher's hot
+    scan. The device program is the IVF scan plus a probe-selection
+    output (a few KiB — below model resolution), so feasibility is the
+    IVF model's; what differs is the *preference*: block_n is also the
+    hot arena's allocation quantum, so smaller blocks pack more clusters
+    per device budget (see ``tune.api._tier_default``)."""
+    return ivf_space(shapes, dtypes)
+
+
 #: int8 matmul grid tiles: rows align to the int8 32-sublane tile, columns
 #: to 128 lanes. The wrapper clamps to the padded M/N, so oversize
 #: candidates are pruned here as redundant.
@@ -357,6 +368,7 @@ _SPACES = {"flash_attention": flash_space,
            "layer_norm": ln_space,
            "retrieval_topk": retrieval_space,
            "retrieval_ivf": ivf_space,
+           "retrieval_tier": tier_space,
            "int8_matmul": int8_matmul_space,
            "fp8_matmul": fp8_matmul_space,
            "flash_attention_int8": int8_flash_space}

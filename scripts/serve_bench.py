@@ -554,7 +554,7 @@ def bench_search(args) -> tuple[list[dict], str | None]:
     clients = args.clients
     per_client = max(1, (args.requests or 16 * clients) // clients)
     total = per_client * clients
-    ivf = args.index_mode == "ivf"
+    ivf = args.index_mode in ("ivf", "tiered")
     rng = np.random.RandomState(0)
 
     recs: list[dict] = []
@@ -579,7 +579,20 @@ def bench_search(args) -> tuple[list[dict], str | None]:
             name=f"bench{n}", ids=tuple(f"r{i}" for i in range(n)),
             vectors=corpus, dim=dim, dtype="float32", metric="cosine",
             state=f"bench{n}", updated=time.time())
-        if ivf:
+        if args.index_mode == "tiered":
+            from jimm_tpu.retrieval.tier import TieredSearcher
+            n_clusters = max(1, min(int(np.sqrt(n)) or 1, n))
+            codebook = train_centroids(corpus, n_clusters, iters=10,
+                                       seed=0)
+            budget = (args.tier_device_budget_mb << 20
+                      if args.tier_device_budget_mb else None)
+            searcher = TieredSearcher(
+                index, codebook, k=args.k, buckets=(1,),
+                nprobe_max=max(args.nprobe, 1), block_n=args.block_n,
+                device_budget_bytes=budget)
+            service = RetrievalService(index, searcher, mode="tiered",
+                                       nprobe=args.nprobe)
+        elif ivf:
             n_clusters = max(1, min(int(np.sqrt(n)) or 1, n))
             codebook = train_centroids(corpus, n_clusters, iters=10,
                                        seed=0)
@@ -645,6 +658,10 @@ def bench_search(args) -> tuple[list[dict], str | None]:
             "index_mode": args.index_mode,
             "nprobe": args.nprobe if ivf else None,
             "recall_at_10": round(recall, 4),
+            # obs regress gates this with direction -1: a run whose
+            # device footprint grows past baseline fails like a latency
+            # regression (the tiered arena is supposed to stay flat)
+            "resident_bytes": searcher.resident_bytes(),
             "n_devices": plan.n_devices,
             "replicas": plan.replicas,
             "model_parallel": plan.model_parallel,
@@ -654,6 +671,8 @@ def bench_search(args) -> tuple[list[dict], str | None]:
         if error is None and compile_delta:
             error = (f"corpus {n}: {compile_delta} recompile(s) after "
                      f"warmup")
+        if hasattr(searcher, "close"):
+            searcher.close()
     return recs, error
 
 
@@ -725,13 +744,18 @@ def main() -> int:
                    help="corpus block size for --search (default: the "
                         "tuner's best_config)")
     p.add_argument("--index-mode", default="exact",
-                   choices=["exact", "ivf"],
-                   help="--search retrieval mode; ivf trains a ~sqrt(N) "
-                        "codebook over a clustered synthetic corpus and "
-                        "stamps measured recall_at_10 vs the exact oracle")
+                   choices=["exact", "ivf", "tiered"],
+                   help="--search retrieval mode; ivf/tiered train a "
+                        "~sqrt(N) codebook over a clustered synthetic "
+                        "corpus and stamp measured recall_at_10 vs the "
+                        "exact oracle; tiered additionally budgets the "
+                        "device arena and stamps resident_bytes")
     p.add_argument("--nprobe", type=int, default=8,
-                   help="--search --index-mode ivf: clusters probed per "
-                        "query (stamped into the ledger row)")
+                   help="--search --index-mode ivf/tiered: clusters probed "
+                        "per query (stamped into the ledger row)")
+    p.add_argument("--tier-device-budget-mb", type=int, default=None,
+                   help="--search --index-mode tiered: hot-arena device "
+                        "budget in MiB (default 64)")
     args = p.parse_args()
 
     if args.tenants:

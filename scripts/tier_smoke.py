@@ -1,0 +1,253 @@
+"""CI tier-1 smoke for tiered billion-row-pattern retrieval
+(docs/retrieval.md, "Tiered residency & PQ").
+
+Forces 8 virtual CPU devices and proves the three-tier serving path end
+to end in one process, at CI scale:
+
+1. **Store past the budget**: a tmp :class:`VectorStore` gets 6k
+   clustered rows (1.5 MiB) over a 32-centroid codebook; the tiered
+   searcher is pinned to an 8-block (256 KiB) device arena and a 2 MiB
+   host budget, so the corpus spans hot, warm AND cold from the first
+   generation.
+2. **Growth, flat residency**: three add/refresh rounds grow the corpus
+   10x (60k rows, ~60x the device budget). After every round the
+   ``jimm_tier_device_resident_bytes`` gauge must read EXACTLY its
+   warmup value — growth repacks the fixed arena, never grows it — and
+   the trace count must not move (repack, not retrace).
+3. **Recall through the cascade**: top-10 at the smoke ``nprobe`` vs
+   the exact NumPy oracle over 128 mixture queries, compared on id
+   strings (build_ivf reorders rows) — recall@10 >= 0.95 after the
+   PQ-coarse probe + exact rescore.
+4. **Daemon cycle on one cid**: 10x growth leaves the codebook stale;
+   one :class:`IndexDaemon` step must decide ``retrain``, retrain +
+   rebuild + re-tier, and leave the whole cycle — decision, apply, and
+   the installed plan — on ONE correlation id in the journal.
+5. **Live /v1/search**: a closed client loop against a real
+   :class:`ServingServer` over the grown index — every request
+   answered, zero post-warmup recompiles, gauge still flat.
+
+Exits nonzero (with a JSON error line) on any violation.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m scripts.tier_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from concurrent.futures import ThreadPoolExecutor
+
+ROWS_BASE = 6_000
+GROWTH_ROUNDS = 3
+ROWS_PER_ROUND = 18_000          # 6k + 3*18k = 60k = 10x the base
+DIM = 64
+CENTERS = 32                     # mixture components in the corpus
+CLUSTERS = 32                    # trained codebook size
+K = 10
+BLOCK_N = 128
+ARENA_BLOCKS = 8                 # 8 * 128 * 64 * 4 B = 256 KiB device
+HOST_BUDGET = 1 << 20            # 1 MiB host — the tail goes cold
+NPROBE_SMOKE = 8
+NPROBE_MAX = 32
+RECALL_QUERIES = 128
+RECALL_FLOOR = 0.95
+CLIENTS = 16
+PER_CLIENT = 2
+DAEMON_CID = "tier-smoke-drill"
+
+
+def fail(msg: str) -> int:
+    print(json.dumps({"metric": "tier_smoke", "value": 0.0,
+                      "error": msg}), flush=True)
+    return 1
+
+
+def main() -> int:
+    # must land before jax initializes its backends
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+
+    import numpy as np
+    from flax import nnx
+
+    from jimm_tpu import CLIP, preset
+    from jimm_tpu.aot import ArtifactStore
+    from jimm_tpu.cli import _tiny_override
+    from jimm_tpu.obs import get_journal, get_registry
+    from jimm_tpu.retrieval import (IndexDaemon, RetrievalService,
+                                    VectorStore)
+    from jimm_tpu.retrieval.ann import clustered_rows, train_centroids
+    from jimm_tpu.serve import (BucketTable, InferenceEngine, ServeClient,
+                                ServingServer, counting_forward)
+
+    total = ROWS_BASE + GROWTH_ROUNDS * ROWS_PER_ROUND
+    corpus, centers = clustered_rows(total, DIM, CENTERS, seed=3)
+    queries, _ = clustered_rows(RECALL_QUERIES, DIM, CENTERS, seed=11,
+                                center_mat=centers)
+    ids = [f"doc{i:05d}" for i in range(total)]
+    buckets = (8,)
+    device_budget = ARENA_BLOCKS * BLOCK_N * DIM * 4
+
+    def oracle_ids(loaded, q):
+        scores = q @ loaded.matrix_f32().T
+        order = np.argsort(-scores, axis=1, kind="stable")[:, :K]
+        return [{loaded.ids[j] for j in row} for row in order]
+
+    def recall_now(service, vstore) -> float:
+        loaded = vstore.load("corpus")
+        want = oracle_ids(loaded, queries)
+        hits = 0
+        for start in range(0, RECALL_QUERIES, buckets[-1]):
+            batch = queries[start:start + buckets[-1]]
+            _vals, id_rows = service.search_blocking(batch)
+            for qi, row in enumerate(id_rows):
+                hits += len(set(row) & want[start + qi])
+        return hits / (RECALL_QUERIES * K)
+
+    def resident_gauge() -> float:
+        return get_registry("jimm_tier").snapshot()[
+            "jimm_tier_device_resident_bytes"]
+
+    with tempfile.TemporaryDirectory(prefix="jimm-tier-smoke-") as root:
+        vstore = VectorStore(os.path.join(root, "index"))
+        vstore.create("corpus", DIM)
+        vstore.add("corpus", ids[:ROWS_BASE], corpus[:ROWS_BASE])
+        codebook = train_centroids(corpus[:ROWS_BASE], CLUSTERS, seed=0)
+        vstore.set_codebook("corpus", codebook, trained_rows=ROWS_BASE)
+        vstore.build_ivf("corpus")
+        store = ArtifactStore(os.path.join(root, "aot"))
+
+        service = RetrievalService.from_store(
+            vstore, "corpus", k=K, buckets=buckets, block_n=BLOCK_N,
+            aot_store=store, mode="tiered", nprobe=NPROBE_SMOKE,
+            nprobe_max=NPROBE_MAX, device_budget_bytes=device_budget,
+            host_budget_bytes=HOST_BUDGET)
+        searcher = service.searcher
+        service.warmup()
+
+        tiers = searcher.tier_plan().describe()
+        if not (tiers["hot_clusters"] and tiers["warm_clusters"]
+                and tiers["cold_clusters"]):
+            return fail(f"base corpus must span all three tiers under a "
+                        f"{device_budget}-byte arena; plan={tiers}")
+        resident0 = searcher.resident_bytes()
+        # the arena obeys the budget; ids/centroids/cluster tables ride
+        # on top but are fixed-size — allow them, flatness catches leaks
+        if resident0 > device_budget + (128 << 10):
+            return fail(f"device-resident {resident0} B far exceeds the "
+                        f"{device_budget} B arena budget at warmup")
+        traces0 = service.trace_count()
+
+        # --- growth: 10x past the device budget, gauge-flat --------------
+        for r in range(GROWTH_ROUNDS):
+            lo = ROWS_BASE + r * ROWS_PER_ROUND
+            vstore.add("corpus", ids[lo:lo + ROWS_PER_ROUND],
+                       corpus[lo:lo + ROWS_PER_ROUND])
+            searcher.refresh(vstore.load("corpus"),
+                             assign=vstore.load_assignments("corpus"))
+            service.search_blocking(queries[:buckets[-1]])
+            gauge = resident_gauge()
+            if gauge != resident0:
+                return fail(f"growth round {r}: device-resident gauge "
+                            f"moved {resident0} -> {gauge} B; the arena "
+                            f"must be fixed")
+        if service.trace_count() != traces0:
+            return fail(f"growth retraced "
+                        f"{service.trace_count() - traces0}x — a refresh "
+                        f"must repack, never retrace")
+
+        # --- recall@10 through PQ-coarse + exact rescore ------------------
+        recall = recall_now(service, vstore)
+        if recall < RECALL_FLOOR:
+            return fail(f"recall@{K} = {recall:.4f} < {RECALL_FLOOR} at "
+                        f"nprobe={NPROBE_SMOKE} over {total} rows")
+
+        # --- daemon: stale codebook -> retrain cycle on one cid -----------
+        daemon = IndexDaemon(vstore, "corpus", searcher, window=1,
+                             cooldown=0, cid=DAEMON_CID, seed=0)
+        staleness = daemon.sample()["staleness"]
+        decision = daemon.step()
+        if decision is None or decision["action"] != "retrain":
+            return fail(f"10x growth (staleness={staleness:.2f}) must "
+                        f"trip a retrain; decision={decision}")
+        chain = [e["event"] for e in get_journal().chain(DAEMON_CID)]
+        for ev in ("tier_daemon_decision", "tier_daemon_applied",
+                   "tier_plan"):
+            if ev not in chain:
+                return fail(f"daemon cycle not fully journaled on "
+                            f"{DAEMON_CID!r}: missing {ev} in {chain}")
+        if vstore.ann_status("corpus")["staleness"] != 0.0:
+            return fail("retrain did not clear staleness")
+        if resident_gauge() != resident0:
+            return fail("retrain/re-tier moved the device-resident gauge")
+        recall_post = recall_now(service, vstore)
+        if recall_post < RECALL_FLOOR:
+            return fail(f"post-retrain recall@{K} = {recall_post:.4f} < "
+                        f"{RECALL_FLOOR}")
+
+        # --- live /v1/search over the grown index -------------------------
+        cfg = _tiny_override(preset("clip-vit-base-patch16"))
+        model = CLIP(cfg, rngs=nnx.Rngs(0))
+        size = cfg.vision.image_size
+        forward, traces = counting_forward(model, "encode_image")
+        engine = InferenceEngine(forward, item_shape=(size, size, 3),
+                                 buckets=BucketTable((1,)),
+                                 max_delay_ms=2.0, trace_count=traces)
+        server = ServingServer(engine, retrieval=service, port=0)
+        server.start()
+        try:
+            topk_traces = service.trace_count()
+
+            def one_client(seed: int) -> int:
+                client = ServeClient(port=server.port, timeout_s=60.0)
+                try:
+                    done = 0
+                    for j in range(PER_CLIENT):
+                        q = queries[(seed * PER_CLIENT + j)
+                                    % RECALL_QUERIES]
+                        out = client.search(vector=q, k=K)
+                        if len(out["ids"]) == K:
+                            done += 1
+                    return done
+                finally:
+                    client.close()
+
+            with ThreadPoolExecutor(max_workers=CLIENTS) as pool:
+                answered = sum(pool.map(one_client, range(CLIENTS)))
+            if answered != CLIENTS * PER_CLIENT:
+                return fail(f"only {answered}/{CLIENTS * PER_CLIENT} "
+                            f"searches answered")
+            delta = service.trace_count() - topk_traces
+            if delta:
+                return fail(f"live serving retraced {delta}x post-warmup")
+            if resident_gauge() != resident0:
+                return fail("serving load moved the device-resident gauge")
+        finally:
+            server.stop()
+            searcher.close()
+
+        stats = searcher.tier_stats()
+        print(json.dumps({
+            "metric": "tier_smoke", "value": 1.0,
+            "rows": total, "dim": DIM, "clusters": CLUSTERS, "k": K,
+            "block_n": BLOCK_N, "nprobe": NPROBE_SMOKE,
+            "device_budget_bytes": device_budget,
+            "device_resident_bytes": resident0,
+            "corpus_bytes": total * DIM * 4,
+            "recall_at_10": round(recall, 4),
+            "recall_post_retrain": round(recall_post, 4),
+            "staleness_at_decision": round(staleness, 4),
+            "daemon_chain": sorted(set(chain)),
+            "tiers": {key: stats[key] for key in
+                      ("hot_clusters", "warm_clusters", "cold_clusters")},
+            "pq_bytes_per_row": stats["pq_bytes_per_row"],
+            "searches": answered,
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Interprocedural JL023 seed: the cold-cluster payload is fetched
+inline, three hops below the do_GET handler — per-file rules can't see
+the handler, the call graph can. The clean twin names the cluster to the
+IO engine worker (``prefetch``) and waits on the staged result
+(``collect``), and the daemon-side ``spill``/``get`` calls show the
+rule's thread-root boundary: tier IO off the request path is silent.
+"""
+
+import numpy as np
+
+
+class ArtifactStore:
+    def get(self, fp, *, expect_versions=None):
+        return b""
+
+    def put(self, fp, payload, meta):
+        return fp
+
+
+class TierIoEngine:
+    def prefetch(self, cluster, fingerprint):
+        pass
+
+    def collect(self, cluster, *, timeout_s=60.0):
+        return np.empty(0, np.int64), np.empty((0, 8), np.float32)
+
+
+def _read_segment(store: ArtifactStore, cluster):
+    return store.get(f"tier-idx-c{cluster}")  # JL023: inline disk get
+
+
+def _load_shard(path):
+    return np.load(path)  # JL023: inline mmap/read on the request path
+
+
+class InlineFetchHandler:
+    def __init__(self, artifacts: ArtifactStore):
+        self.artifacts = artifacts
+
+    def do_GET(self):
+        return self._serve_query([3, 7])
+
+    def _serve_query(self, clusters):
+        return [_read_segment(self.artifacts, c) for c in clusters]
+
+    def do_POST(self):
+        return _load_shard("/tmp/shard.npy")
+
+
+class WorkerFetchHandler:
+    """Clean: the request thread only enqueues and waits; the transfer
+    itself happens on the engine's worker thread."""
+
+    def __init__(self, engine: TierIoEngine):
+        self.engine = engine
+
+    def do_GET(self):
+        self.engine.prefetch(3, "fp3")
+        return self.engine.collect(3)
+
+
+def _daemon_cycle(store: ArtifactStore, engine: TierIoEngine):
+    # clean: maintenance-thread IO — same calls, no http-handler root
+    payload = store.get("tier-idx-c9")
+    store.put("tier-idx-c9", payload, {"kind": "tier_cluster"})
+    return payload

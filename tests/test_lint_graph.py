@@ -128,6 +128,22 @@ class TestConcurrencyRules:
         findings = run_concurrency_checks(fixture_files("handler_jit.py"))
         assert rules_and_lines(findings) == {("JL008", 18)}
 
+    def test_jl023_inline_tier_io_on_request_path(self):
+        fx = str(FIXTURES / "retrieval" / "tier" / "streaming_fetch.py")
+        findings = run_concurrency_checks([fx])
+        assert rules_and_lines(findings) == {
+            ("JL023", 29),  # ArtifactStore.get three hops below do_GET
+            ("JL023", 33),  # np.load on the do_POST path
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert "prefetch" in findings[0].message
+
+    def test_jl023_worker_split_and_daemon_io_are_clean(self):
+        fx = str(FIXTURES / "retrieval" / "tier" / "streaming_fetch.py")
+        findings = run_concurrency_checks([fx])
+        assert not any("WorkerFetchHandler" in f.message or
+                       "_daemon_cycle" in f.message for f in findings)
+
     def test_jl014_waived_by_base_class_eviction(self):
         child = CONC / "serve" / "child_table.py"
         per_file = [f for f in lint_file(child) if f.rule == "JL014"]

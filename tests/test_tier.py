@@ -1,0 +1,513 @@
+"""jimm_tpu.retrieval.tier: PQ residual codec, tier planner, cold IO
+engine, the budgeted TieredSearcher, and the IndexDaemon.
+
+The searcher tests pin the tiered path to the same stable NumPy argsort
+oracle the exact kernel answers to — but compare on *id strings*, because
+``build_ivf`` rewrites segments cluster-major and row positions move.
+The residency tests assert the two load-bearing invariants: device-
+resident bytes stay flat across growth/re-tiering (fixed arena), and the
+runtime ``nprobe``/growth/re-tier path never retraces. The store-
+interleaving tests pin that tombstoned rows never surface through any
+tier once a refresh lands.
+"""
+
+import numpy as np
+import pytest
+
+from jimm_tpu.aot.store import ArtifactStore
+from jimm_tpu.obs import get_journal, get_registry, reset_journal
+from jimm_tpu.retrieval import VectorStore
+from jimm_tpu.retrieval.ann import (assign_clusters, clustered_rows,
+                                    train_centroids)
+from jimm_tpu.retrieval.tier import (AccessStats, IndexDaemon,
+                                     PQ_FORMAT_VERSION, PqCodec,
+                                     TierIoEngine, TieredSearcher,
+                                     adc_scores, decode_cluster, decode_pq,
+                                     encode_cluster, encode_pq,
+                                     encode_rows, plan_tiers, query_luts,
+                                     train_pq)
+
+DIM = 32
+N_CLUSTERS = 12
+
+
+def seeded_store(root, n=1200, seed=3):
+    rows, centers = clustered_rows(n, DIM, N_CLUSTERS, seed=seed)
+    store = VectorStore(str(root))
+    store.create("idx", DIM)
+    store.add("idx", [f"r{i}" for i in range(n)], rows)
+    cents = train_centroids(rows, N_CLUSTERS, iters=5, seed=0)
+    store.set_codebook("idx", cents, seed=0)
+    store.build_ivf("idx")
+    return store, rows, centers, cents
+
+
+def oracle_ids(queries, loaded, k=10):
+    """Stable argsort oracle over the *loaded* snapshot, answered in id
+    strings (positions are layout-dependent after build_ivf)."""
+    scores = np.asarray(queries, np.float32) @ loaded.matrix_f32().T
+    order = np.argsort(-scores, axis=1, kind="stable")[:, :k]
+    return [[loaded.ids[j] for j in row] for row in order]
+
+
+def recall_at(got_ids, want_ids, k=10):
+    return float(np.mean([
+        len(set(g[:k]) & set(w[:k])) / k
+        for g, w in zip(got_ids, want_ids)]))
+
+
+# ---------------------------------------------------------------------------
+# PQ residual codec
+# ---------------------------------------------------------------------------
+
+class TestPqCodec:
+    def _residuals(self, n=800, seed=1):
+        rows, _ = clustered_rows(n, DIM, N_CLUSTERS, seed=seed)
+        cents = train_centroids(rows, N_CLUSTERS, iters=4, seed=0)
+        return rows - cents[assign_clusters(rows, cents)], rows
+
+    def test_train_is_seeded_and_8x(self):
+        residuals, _ = self._residuals()
+        a = train_pq(residuals, seed=0)
+        b = train_pq(residuals, seed=0)
+        assert a == b  # same seed, bit-identical codebooks
+        assert a != train_pq(residuals, seed=1)
+        assert a.codebooks.shape == (DIM // 2, 256, 2)
+        # 8x: D/2 uint8 codes vs 4*D float32 bytes
+        assert a.code_bytes_per_row() * 8 == DIM * 4
+
+    def test_adc_approximates_residual_dots(self):
+        residuals, rows = self._residuals()
+        codec = train_pq(residuals, seed=0)
+        codes = encode_rows(codec, residuals)
+        assert codes.shape == (len(residuals), codec.n_sub)
+        assert codes.dtype == np.uint8
+        q = rows[:4].astype(np.float32)
+        luts = query_luts(codec, q)
+        est = np.stack([adc_scores(codec, luts[b], codes)
+                        for b in range(4)])
+        true = q @ residuals.T
+        # quantization noise must be small against the residual energy:
+        # ADC only ranks within clusters; exact rescore fixes the rest
+        assert np.abs(est - true).mean() < 0.25 * np.abs(true).mean()
+
+    def test_artifact_round_trip_and_framing_errors(self):
+        residuals, _ = self._residuals(n=300)
+        codec = train_pq(residuals, dsub=4, ksub=64, seed=2)
+        payload = encode_pq(codec)
+        back = decode_pq(payload)
+        assert back == codec
+        assert back.meta["seed"] == 2
+        with pytest.raises(ValueError, match="header"):
+            decode_pq(b"garbage-without-newline")
+        with pytest.raises(ValueError, match="pq_format"):
+            decode_pq(b'{"pq_format":99}\n')
+        with pytest.raises(ValueError, match="bytes"):
+            decode_pq(payload[:-8])
+
+    def test_validation(self):
+        residuals, _ = self._residuals(n=100)
+        with pytest.raises(ValueError, match="dsub"):
+            train_pq(residuals, dsub=5)
+        with pytest.raises(ValueError, match="ksub"):
+            train_pq(residuals, ksub=512)
+        codec = train_pq(residuals, seed=0)
+        with pytest.raises(ValueError, match="residuals"):
+            encode_rows(codec, residuals[:, : DIM // 2])
+
+
+# ---------------------------------------------------------------------------
+# tier planner
+# ---------------------------------------------------------------------------
+
+class TestPlanner:
+    def test_greedy_by_ema_deterministic_and_budgeted(self):
+        counts = np.array([100, 100, 100, 100, 0])
+        ema = np.array([1.0, 3.0, 2.0, 0.5, 0.0])
+        kw = dict(arena_blocks=2, block_n=128, row_bytes=DIM * 4,
+                  max_bpc=4, host_budget_bytes=100 * DIM * 4)
+        plan = plan_tiers(counts, ema, **kw)
+        assert plan == plan_tiers(counts, ema, **kw)  # deterministic
+        # hottest two fill the 2-block arena; next by EMA takes the host
+        # budget; the rest is cold; the empty cluster is nominally hot
+        assert plan.hot == (1, 2, 4)
+        assert plan.warm == (0,)
+        assert plan.cold == (3,)
+        assert plan.hot_blocks <= 2
+        assert plan.warm_bytes <= 100 * DIM * 4
+        assert plan.tier_of(3) == "cold" and plan.tier_of(4) == "hot"
+
+    def test_oversize_cluster_never_hot(self):
+        counts = np.array([1000, 10])
+        ema = np.array([9.0, 1.0])  # hottest, but 8 blocks > max_bpc
+        plan = plan_tiers(counts, ema, arena_blocks=16, block_n=128,
+                          row_bytes=DIM * 4, max_bpc=2)
+        assert 0 in plan.warm and 1 in plan.hot
+
+    def test_cold_disabled_spills_nothing(self):
+        counts = np.array([500, 500, 500])
+        plan = plan_tiers(counts, np.zeros(3), arena_blocks=1,
+                          block_n=128, row_bytes=DIM * 4, max_bpc=1,
+                          host_budget_bytes=0, cold_enabled=False)
+        assert plan.cold == ()
+
+    def test_access_stats_decay_and_rank(self):
+        stats = AccessStats(4)
+        for _ in range(5):
+            stats.record(np.array([2, 2, 3]))  # dedup within a batch
+        stats.record(np.array([1]))
+        snap = stats.snapshot()
+        assert snap[2] > snap[1] > snap[0] == 0.0
+        assert stats.batches == 6
+        # out-of-range ids are ignored, not crashed on
+        stats.record(np.array([-1, 99]))
+
+
+# ---------------------------------------------------------------------------
+# cold IO engine
+# ---------------------------------------------------------------------------
+
+class TestIoEngine:
+    def test_segment_round_trip_and_framing_errors(self):
+        ids = np.arange(10, dtype=np.int64)
+        rows = np.random.default_rng(0).standard_normal(
+            (10, DIM)).astype(np.float32)
+        c, got_ids, got_rows = decode_cluster(encode_cluster(7, ids, rows))
+        assert c == 7
+        assert np.array_equal(got_ids, ids)
+        assert np.array_equal(got_rows, rows)
+        with pytest.raises(ValueError, match="header"):
+            decode_cluster(b"no-newline-here")
+        with pytest.raises(ValueError, match="tier_format"):
+            decode_cluster(b'{"tier_format":0}\n')
+        with pytest.raises(ValueError, match="bytes"):
+            decode_cluster(encode_cluster(7, ids, rows)[:-4])
+
+    def test_spill_prefetch_collect(self, tmp_path):
+        engine = TierIoEngine(ArtifactStore(str(tmp_path)), label="t")
+        ids = np.arange(6, dtype=np.int64)
+        rows = np.ones((6, DIM), np.float32)
+        fp = engine.spill(3, ids, rows)
+        assert engine.spill(3, ids, rows) == fp  # content-addressed
+        engine.prefetch(3, fp)
+        engine.prefetch(3, fp)  # dedups the read, registers a waiter
+        got_ids, got_rows = engine.collect(3)
+        assert np.array_equal(got_ids, ids)
+        assert np.array_equal(got_rows, rows)
+        got_ids2, _ = engine.collect(3)  # second waiter still served
+        assert np.array_equal(got_ids2, ids)
+        assert engine.pending() == 0  # last waiter consumed the entry
+        with pytest.raises(KeyError):
+            engine.collect(3)
+        engine.close()
+
+    def test_concurrent_searches_share_one_fetch(self, tmp_path):
+        """Two request threads racing prefetch+collect on the same
+        cluster must both get rows — the losing thread must never see
+        the winner consume the staging entry out from under it."""
+        from concurrent.futures import ThreadPoolExecutor
+        engine = TierIoEngine(ArtifactStore(str(tmp_path)), label="t")
+        ids = np.arange(8, dtype=np.int64)
+        rows = np.full((8, DIM), 2.0, np.float32)
+        fp = engine.spill(9, ids, rows)
+
+        def one(_):
+            engine.prefetch(9, fp)
+            got_ids, _rows = engine.collect(9, timeout_s=10.0)
+            return np.array_equal(got_ids, ids)
+
+        try:
+            for _ in range(20):
+                with ThreadPoolExecutor(max_workers=8) as pool:
+                    assert all(pool.map(one, range(8)))
+                assert engine.pending() == 0
+        finally:
+            engine.close()
+
+    def test_corrupt_segment_fails_loudly_and_quarantines(self, tmp_path):
+        artifacts = ArtifactStore(str(tmp_path))
+        engine = TierIoEngine(artifacts, label="t")
+        artifacts.put("bad-fp", b"not a segment", {"kind": "tier_cluster"})
+        reset_journal()
+        try:
+            engine.prefetch(5, "bad-fp")
+            with pytest.raises(RuntimeError, match="cluster 5"):
+                engine.collect(5)
+            events = [e["event"] for e in get_journal().events()]
+            assert "tier_fetch_failed" in events
+            assert artifacts.get("bad-fp") is None  # quarantined
+        finally:
+            engine.close()
+            reset_journal()
+
+    def test_missing_artifact_fails(self, tmp_path):
+        engine = TierIoEngine(ArtifactStore(str(tmp_path)), label="t")
+        try:
+            engine.prefetch(1, "never-spilled")
+            with pytest.raises(RuntimeError, match="missing"):
+                engine.collect(1)
+        finally:
+            engine.close()
+
+
+# ---------------------------------------------------------------------------
+# TieredSearcher: recall, residency, zero-recompile
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiered_env(tmp_path_factory):
+    """One warm+cold searcher over a seeded store: 6-block arena,
+    host budget small enough to force cold clusters."""
+    root = tmp_path_factory.mktemp("tier")
+    store, rows, centers, cents = seeded_store(root / "vs")
+    searcher = TieredSearcher(
+        store.load("idx"), cents, store.load_assignments("idx"), k=10,
+        nprobe_max=N_CLUSTERS, device_budget_bytes=6 * 128 * DIM * 4,
+        block_n=128, buckets=(8,), max_bpc=4,
+        host_budget_bytes=120 * DIM * 4,
+        artifacts=ArtifactStore(str(root / "art")))
+    yield store, searcher, centers
+    searcher.close()
+
+
+class TestTieredSearcher:
+    def test_plan_spans_all_three_tiers(self, tiered_env):
+        _store, searcher, _ = tiered_env
+        d = searcher.tier_plan().describe()
+        assert d["hot_clusters"] and d["warm_clusters"] \
+            and d["cold_clusters"]
+
+    def test_full_probe_matches_oracle(self, tiered_env):
+        store, searcher, centers = tiered_env
+        queries, _ = clustered_rows(8, DIM, N_CLUSTERS, seed=9,
+                                    center_mat=centers)
+        _vals, _idx, ids = searcher.search(queries, nprobe=N_CLUSTERS)
+        want = oracle_ids(queries, store.load("idx"))
+        assert recall_at(ids, want) == 1.0
+        stats = searcher.last_stats
+        assert stats["nprobe"] == N_CLUSTERS
+        assert stats["degraded_clusters"] == 0
+
+    def test_partial_probe_recall_floor_no_retrace(self, tiered_env):
+        store, searcher, centers = tiered_env
+        queries, _ = clustered_rows(8, DIM, N_CLUSTERS, seed=11,
+                                    center_mat=centers)
+        searcher.search(queries, nprobe=2)  # warm both programs
+        tc = searcher.trace_count()
+        want = oracle_ids(queries, store.load("idx"))
+        for nprobe in (2, 4, 8, N_CLUSTERS):
+            _v, _i, ids = searcher.search(queries, nprobe=nprobe)
+        assert recall_at(ids, want) >= 0.95
+        assert searcher.trace_count() == tc  # runtime scalar, no retrace
+
+    def test_cold_path_journaled_and_counted(self, tiered_env):
+        store, searcher, centers = tiered_env
+        queries, _ = clustered_rows(4, DIM, N_CLUSTERS, seed=13,
+                                    center_mat=centers)
+        reset_journal()
+        try:
+            searcher.search(queries, nprobe=N_CLUSTERS)  # probes all
+            events = [e["event"] for e in get_journal().events()]
+            assert "tier_fetch" in events
+        finally:
+            reset_journal()
+        stats = searcher.tier_stats()
+        assert stats["io_pending"] == 0
+        snap = get_registry("jimm_tier").snapshot()
+        assert snap["jimm_tier_cold_fetches_total"] > 0
+        assert snap["jimm_tier_device_resident_bytes"] \
+            == searcher.resident_bytes()
+
+    def test_gauges_follow_latest_searcher(self, tiered_env):
+        _store, searcher, _ = tiered_env
+        snap = get_registry("jimm_tier").snapshot()
+        assert snap["jimm_tier_hot_clusters"] \
+            == len(searcher.tier_plan().hot)
+        assert snap["jimm_tier_host_resident_bytes"] > 0
+
+    def test_validation(self, tiered_env):
+        _store, searcher, _ = tiered_env
+        with pytest.raises(ValueError, match="nprobe"):
+            searcher.search(np.zeros((1, DIM), np.float32),
+                            nprobe=N_CLUSTERS + 1)
+        with pytest.raises(ValueError, match="queries must be"):
+            searcher.search(np.zeros((1, DIM + 1), np.float32))
+
+
+class TestResidencyAcrossGrowth:
+    def test_growth_retier_flat_bytes_zero_retrace(self, tmp_path):
+        store, rows, centers, cents = seeded_store(tmp_path / "vs", n=900)
+        searcher = TieredSearcher(
+            store.load("idx"), cents, store.load_assignments("idx"),
+            k=10, nprobe_max=N_CLUSTERS,
+            device_budget_bytes=5 * 128 * DIM * 4, block_n=128,
+            buckets=(8,), max_bpc=4,
+            artifacts=ArtifactStore(str(tmp_path / "art")))
+        try:
+            queries, _ = clustered_rows(8, DIM, N_CLUSTERS, seed=21,
+                                        center_mat=centers)
+            searcher.search(queries, nprobe=4)
+            tc = searcher.trace_count()
+            rb = searcher.resident_bytes()
+            # 3 growth rounds: add -> reload -> refresh -> search
+            for round_i in range(3):
+                more, _ = clustered_rows(300, DIM, N_CLUSTERS,
+                                         seed=30 + round_i,
+                                         center_mat=centers)
+                store.add("idx", [f"g{round_i}_{j}" for j in range(300)],
+                          more)
+                searcher.refresh(store.load("idx"),
+                                 assign=store.load_assignments("idx"))
+                _v, _i, ids = searcher.search(queries, nprobe=N_CLUSTERS)
+                want = oracle_ids(queries, store.load("idx"))
+                assert recall_at(ids, want) >= 0.95
+                assert searcher.resident_bytes() == rb  # arena is fixed
+            assert searcher.trace_count() == tc  # repack, not retrace
+            assert len(searcher.index) == 900 + 3 * 300
+        finally:
+            searcher.close()
+
+    def test_refresh_rejects_shape_changes(self, tmp_path):
+        store, rows, _centers, cents = seeded_store(tmp_path / "vs",
+                                                    n=600)
+        searcher = TieredSearcher(store.load("idx"), cents, k=10,
+                                  nprobe_max=4, block_n=128, buckets=(1,))
+        try:
+            with pytest.raises(ValueError, match="centroid"):
+                searcher.refresh(centroids=cents[: N_CLUSTERS - 2])
+        finally:
+            searcher.close()
+
+
+class TestStoreInterleaving:
+    """The satellite invariant: interleaved add/delete/compact under a
+    live tier map never resurrects a tombstoned row through any tier."""
+
+    def test_tombstoned_rows_never_fetched_back(self, tmp_path):
+        store, rows, centers, cents = seeded_store(tmp_path / "vs",
+                                                   n=1000)
+        searcher = TieredSearcher(
+            store.load("idx"), cents, store.load_assignments("idx"),
+            k=10, nprobe_max=N_CLUSTERS,
+            device_budget_bytes=4 * 128 * DIM * 4, block_n=128,
+            buckets=(8,), max_bpc=4, host_budget_bytes=100 * DIM * 4,
+            artifacts=ArtifactStore(str(tmp_path / "art")))
+        try:
+            queries, _ = clustered_rows(8, DIM, N_CLUSTERS, seed=17,
+                                        center_mat=centers)
+            _v, _i, before = searcher.search(queries, nprobe=N_CLUSTERS)
+            # tombstone exactly the rows the searcher currently returns
+            # (they live in hot, warm AND cold clusters), plus interleave
+            # an add so segment layout churns
+            doomed = sorted({rid for row in before for rid in row})
+            assert doomed
+            store.delete("idx", doomed)
+            more, _ = clustered_rows(200, DIM, N_CLUSTERS, seed=23,
+                                     center_mat=centers)
+            store.add("idx", [f"n{j}" for j in range(200)], more)
+            store.compact("idx")
+            store.build_ivf("idx")
+            searcher.refresh(store.load("idx"),
+                             assign=store.load_assignments("idx"))
+            _v, _i, after = searcher.search(queries, nprobe=N_CLUSTERS)
+            got = {rid for row in after for rid in row}
+            assert not got & set(doomed), \
+                "tombstoned rows surfaced through a tier"
+            # and the post-delete oracle still agrees
+            want = oracle_ids(queries, store.load("idx"))
+            assert recall_at(after, want) >= 0.95
+            assert any(rid.startswith("n") for rid in got)
+        finally:
+            searcher.close()
+
+
+# ---------------------------------------------------------------------------
+# IndexDaemon
+# ---------------------------------------------------------------------------
+
+class TestIndexDaemon:
+    def test_quiet_store_no_decision(self, tmp_path):
+        store, *_ = seeded_store(tmp_path / "vs", n=600)
+        d = IndexDaemon(store, "idx", window=1, cooldown=0)
+        assert d.step() is None
+        assert d.describe()["decisions"] == 0
+
+    def test_staleness_trips_retrain_and_one_cid_chain(self, tmp_path):
+        store, rows, centers, _ = seeded_store(tmp_path / "vs", n=600)
+        # grow past the staleness threshold with run-less segments
+        more, _ = clustered_rows(400, DIM, N_CLUSTERS, seed=5,
+                                 center_mat=centers)
+        store.add("idx", [f"s{j}" for j in range(400)], more)
+        assert store.ann_status("idx")["staleness"] >= 0.25
+        d = IndexDaemon(store, "idx", window=1, cooldown=0, seed=0)
+        reset_journal()
+        try:
+            decision = d.step()
+            assert decision["action"] == "retrain"
+            assert store.ann_status("idx")["staleness"] == 0.0
+            chain = [e["event"] for e in get_journal().chain(d.cid)]
+            assert "tier_daemon_decision" in chain
+            assert "tier_daemon_applied" in chain
+        finally:
+            reset_journal()
+        # hysteresis: the signal is gone, the next tick stays quiet
+        assert d.step() is None
+
+    def test_tombstones_trip_compact(self, tmp_path):
+        store, *_ = seeded_store(tmp_path / "vs", n=600)
+        store.delete("idx", [f"r{i}" for i in range(250)])
+        d = IndexDaemon(store, "idx", window=1, cooldown=0)
+        decision = d.step()
+        assert decision["action"] == "compact"
+        assert len(store.manifest("idx").get("tombstones", [])) == 0
+
+    def test_window_and_cooldown_bound_decisions(self, tmp_path):
+        store, *_ = seeded_store(tmp_path / "vs", n=600)
+        store.delete("idx", [f"r{i}" for i in range(250)])
+        d = IndexDaemon(store, "idx", window=3, cooldown=2)
+        # ticks 1-2: window not full yet
+        assert d.tick() is None and d.tick() is None
+        decision = d.tick()
+        assert decision is not None  # exactly one decision fires
+        # cooldown: even with the signal still tripped, the next 2 ticks
+        # stay quiet
+        assert d.tick() is None and d.tick() is None
+
+    def test_drift_trips_retier_with_live_searcher(self, tmp_path):
+        store, rows, centers, cents = seeded_store(tmp_path / "vs",
+                                                   n=900)
+        searcher = TieredSearcher(
+            store.load("idx"), cents, store.load_assignments("idx"),
+            k=10, nprobe_max=2, device_budget_bytes=3 * 128 * DIM * 4,
+            block_n=128, buckets=(4,), max_bpc=2)
+        try:
+            # hammer two specific clusters so the access EMA disagrees
+            # with the install-time (uniform) ranking
+            probe_q = np.repeat(cents[N_CLUSTERS - 2:][:, :], 2, axis=0)
+            for _ in range(12):
+                searcher.search(probe_q.astype(np.float32), nprobe=2)
+            d = IndexDaemon(store, "idx", searcher, window=1, cooldown=0)
+            sample = d.sample()
+            if sample["hot_drift"] >= d.retier_high:
+                decision = d.step()
+                assert decision["action"] == "retier"
+                hot_now = set(searcher.tier_plan().hot)
+                proposed = set(searcher.propose_plan().hot)
+                assert hot_now == proposed  # re-tier converged
+        finally:
+            searcher.close()
+
+    def test_start_stop_thread(self, tmp_path):
+        store, *_ = seeded_store(tmp_path / "vs", n=600)
+        d = IndexDaemon(store, "idx", window=1, cooldown=0)
+        d.start(interval_s=0.05)
+        assert d.describe()["running"]
+        d.stop()
+        assert not d.describe()["running"]
+
+    def test_validation(self, tmp_path):
+        store, *_ = seeded_store(tmp_path / "vs", n=600)
+        with pytest.raises(ValueError, match="window"):
+            IndexDaemon(store, "idx", window=0)
+        with pytest.raises(ValueError, match="trip"):
+            IndexDaemon(store, "idx", compact_high=0.0)
