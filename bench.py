@@ -211,6 +211,7 @@ def emit_error(model: str, msg: str, detail: str = "") -> None:
         "n_devices": 1,
         "replicas": 1,
         "model_parallel": 1,
+        "seq_parallel": 1,
         # no measurement happened at all: stamped like the CPU-smoke rows
         # so window_report/MEASUREMENTS consumers can never mistake this
         # for a TPU datapoint (the BENCH_r01-r05 misread)
@@ -589,6 +590,11 @@ def child_main(args: argparse.Namespace, disarm_probe) -> int:
         "n_devices": 1,
         "replicas": 1,
         "model_parallel": 1,
+        # sequence identity: obs-regress keys segment on these, so a long-
+        # sequence (temporal/NaFlex) or ring-sharded run never gates
+        # against the short single-chip baseline
+        "seq_len": int(cfg.vision.seq_len),
+        "seq_parallel": 1,
     }
     # Emit the measured datapoint IMMEDIATELY — the crosscheck below can
     # touch the tunnel (lower+compile round-trip) whose failure mode is a

@@ -603,21 +603,38 @@ def cmd_train(args: argparse.Namespace) -> int:
                 args.data, args.batch_size,
                 image_size=cfg.vision.image_size, **data_kw)
         else:
+            # temporal towers train on synthetic (B, T, H, W, C) clips;
+            # the file loaders stay image-only for now
             data = blob_classification(args.batch_size,
                                        image_size=cfg.vision.image_size,
                                        num_classes=cfg.num_classes,
-                                       seed=args.seed)
+                                       seed=args.seed,
+                                       num_frames=cfg.vision.num_frames)
     else:
         # ring losses shard the batch over the "data" axis — on a mesh
         # without one (e.g. model-only TP) the dense loss is the default
-        ring_ok = mesh is not None and "data" in mesh.shape
+        ring_ok = mesh is not None and ("data" in mesh.shape
+                                        or mesh.shape.get("seq", 1) > 1)
         if fam == "clip":
             loss_kind = args.loss or ("clip_ring" if ring_ok else "clip")
         else:
             loss_kind = args.loss or ("siglip_ring" if ring_ok
                                       else "siglip")
+        # a seq axis joins the pair-dimension ring: the contrastive batch
+        # shards over ("data", "seq") combined, so sequence-parallel
+        # meshes spend every chip on the pairwise loss too
+        loss_axis = "data"
+        if (loss_kind.endswith("_ring") and mesh is not None
+                and mesh.shape.get("seq", 1) > 1):
+            loss_axis = tuple(a for a in ("data", "seq")
+                              if a in mesh.shape)
         step_fn = make_contrastive_train_step(loss_kind, mesh=mesh,
+                                              axis_name=loss_axis,
                                               donate=True)
+        if rules is not None and isinstance(loss_axis, tuple):
+            # batches land sharded over both pair axes (the loss's
+            # shard_map in_specs expect it)
+            rules = dataclasses.replace(rules, batch=loss_axis)
         if args.naflex:
             # variable-resolution SigLIP2 training (beyond the reference)
             if fam != "siglip":
@@ -1634,7 +1651,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         from jimm_tpu.aot import ArtifactStore
         store = ArtifactStore(args.aot_store)
     from jimm_tpu.serve.topology import build_replica_forwards, plan_topology
-    plan = plan_topology(args.replicas, args.model_parallel)
+    plan = plan_topology(args.replicas, args.model_parallel,
+                         getattr(args, "seq_parallel", 1))
 
     def _build_forward(mdl, mdl_method, mdl_size, key):
         if not plan.is_trivial:
@@ -1936,7 +1954,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "compilation cache) so restarted runs skip the "
                          "train-step compile")
     sp.add_argument("--mesh", default=None,
-                    help='e.g. "data=4,model=2" (default: no mesh)')
+                    help='e.g. "data=4,model=2" or "data=2,model=1,seq=4" '
+                         '(a seq axis turns on sequence-parallel attention '
+                         'and joins the ring losses; default: no mesh)')
     sp.add_argument("--max-devices", type=int, default=None,
                     help="build the mesh over only the first N visible "
                          "devices (elastic restarts: a shrunk attempt plans "
@@ -1944,7 +1964,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "the checkpoint onto it)")
     sp.add_argument("--rules", default=None,
                     choices=["replicated", "dp", "tp", "fsdp",
-                             "fsdp_tp", "sp", "pp"],
+                             "fsdp_tp", "sp", "fsdp_sp", "pp"],
                     help="sharding rules preset (requires --mesh)")
     sp.add_argument("--loss", default=None,
                     choices=["clip", "clip_ring", "siglip", "siglip_ring"])
@@ -2244,6 +2264,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="devices per replica: each forward's params are "
                          "tensor-parallel over a (data=1, model=k) submesh "
                          "(big towers that don't fit one chip)")
+    sp.add_argument("--seq-parallel", type=int, default=1,
+                    help="sequence-parallel ways per replica: the submesh "
+                         "grows a seq axis and attention runs ring/ulysses "
+                         "across it (sequences too long for one chip; "
+                         "composes with --model-parallel)")
     sp.add_argument("--self-heal", action="store_true",
                     help="escalate a watchdog fence: probe the fenced "
                          "replica (transient fault -> revive in place), "

@@ -219,6 +219,11 @@ class VisionConfig:
     image_size: int = 224
     patch_size: int = 16
     channels: int = 3
+    #: frames per clip for temporal (video) towers: each frame patchifies
+    #: independently and the T * grid^2 tokens flatten into ONE sequence
+    #: (pos table covers the full flattened length) — long-sequence work
+    #: that the seq-parallel mesh axis shards across chips. 1 = image.
+    num_frames: int = 1
     width: int = 768
     depth: int = 12
     num_heads: int = 12
@@ -247,7 +252,7 @@ class VisionConfig:
 
     @property
     def num_patches(self) -> int:
-        return self.grid * self.grid
+        return self.grid * self.grid * self.num_frames
 
     @property
     def seq_len(self) -> int:
@@ -373,6 +378,20 @@ def _vit(size: str, patch: int, image: int, classes: int = 1000) -> ViTConfig:
         num_classes=classes)
 
 
+def _vit_temporal(size: str, patch: int, image: int, frames: int,
+                  classes: int = 1000) -> ViTConfig:
+    """Temporal ViT: frames flattened into one sequence (T * grid^2
+    tokens) — the video workload the sequence-parallel mesh axis exists
+    for. No architectural surgery beyond the longer pos table; attention
+    is full spatio-temporal. MAP pooling on purpose: a CLS token would
+    make the sequence odd and lock out every even ring size, while
+    T * grid^2 divides cleanly across the ``seq`` axis."""
+    base = _vit(size, patch, image, classes)
+    return dataclasses.replace(
+        base, vision=dataclasses.replace(base.vision, num_frames=frames,
+                                         pooling="map"))
+
+
 def _siglip(size: str, patch: int, image: int, vocab: int = 32000,
             ctx: int = 64) -> SigLIPConfig:
     w, d, h, m = {
@@ -416,6 +435,10 @@ PRESETS: dict[str, Any] = {
     "vit-base-patch32-384": _vit("B", 32, 384),
     "vit-large-patch16-384": _vit("L", 16, 384),
     "vit-huge-patch14-224": _vit("H", 14, 224),
+    # Temporal ViT (video: frames flattened into sequence — 8 * 196 + 1 =
+    # 1569 tokens; train/serve these across a seq-parallel mesh axis)
+    "vit-temporal-small-patch16-224-f8": _vit_temporal("S", 16, 224, 8),
+    "vit-temporal-base-patch16-224-f8": _vit_temporal("B", 16, 224, 8),
     # CLIP
     "clip-vit-base-patch32": _clip("B", 32),
     "clip-vit-base-patch16": _clip("B", 16),

@@ -15,9 +15,16 @@ import numpy as np
 
 def blob_classification(batch_size: int, *, image_size: int = 28,
                         num_classes: int = 4, channels: int = 3,
-                        seed: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+                        seed: int = 0, num_frames: int = 1
+                        ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     """Classify which quadrant contains a bright Gaussian blob — a learnable
-    stand-in for MNIST in the from-scratch training demo."""
+    stand-in for MNIST in the from-scratch training demo.
+
+    ``num_frames > 1`` yields ``(B, T, H, W, C)`` clips for temporal
+    towers: the blob drifts a little per frame (same label), so the
+    temporal preset has motion to attend over. ``num_frames=1`` keeps the
+    legacy ``(B, H, W, C)`` stream byte for byte (same RandomState draw
+    order), so existing fingerprint-based smokes stay stable."""
     rng = np.random.RandomState(seed)
     grid = np.stack(np.meshgrid(np.arange(image_size), np.arange(image_size),
                                 indexing="ij"), -1).astype(np.float32)
@@ -28,7 +35,13 @@ def blob_classification(batch_size: int, *, image_size: int = 28,
         labels = rng.randint(0, num_classes, size=batch_size)
         jitter = rng.randn(batch_size, 2).astype(np.float32) * half * 0.15
         mu = centers[labels % 4] + jitter
-        d2 = np.sum((grid[None] - mu[:, None, None]) ** 2, -1)
+        if num_frames > 1:
+            drift = rng.randn(batch_size, 2).astype(np.float32) * half * 0.05
+            t = np.arange(num_frames, dtype=np.float32)[None, :, None]
+            mu_t = mu[:, None] + drift[:, None] * t      # (B, T, 2)
+            d2 = np.sum((grid[None, None] - mu_t[:, :, None, None]) ** 2, -1)
+        else:
+            d2 = np.sum((grid[None] - mu[:, None, None]) ** 2, -1)
         images = np.exp(-d2 / (2 * (image_size * 0.08) ** 2))
         images = images[..., None].repeat(channels, -1)
         images += rng.randn(*images.shape).astype(np.float32) * 0.05

@@ -123,6 +123,16 @@ Rule catalog (see ``docs/static_analysis.md`` for the narrative version):
   ``train.profile.trace``), anomaly captures through
   ``CaptureManager.trigger``. Tests are exempt; deliberate direct calls
   carry a ``# jaxlint: disable=JL022`` justification.
+- **JL024** dense score materialization or full-KV ``all_gather`` inside
+  ``parallel/seqpar`` — the sequence-parallel ring's contract is that no
+  device ever holds the full sequence: KV chunks move peer-to-peer via
+  ``ppermute`` (O(local) memory per hop) and scores exist only one
+  chunk-pair tile at a time inside per-hop helpers. An ``all_gather``
+  reassembles the full KV on every device (memory scales with S again,
+  exactly what the seq axis was bought to avoid), and a score-shaped
+  ``einsum`` (output keeping a free sequence letter from each operand)
+  outside a ``*hop*``-named function is the full ``(S, S)`` matrix.
+  Deliberate gathers carry a ``# jaxlint: disable=JL024`` justification.
 """
 
 from __future__ import annotations
@@ -1447,6 +1457,107 @@ def check_profiler_bypass(tree: ast.AST, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JL024 — sequence-parallel discipline inside parallel/seqpar
+# ---------------------------------------------------------------------------
+
+def _path_is_seqpar(path: str) -> bool:
+    """Non-test files named ``seqpar*`` under ``parallel/`` — the modules
+    whose whole point is never holding the full sequence on one device."""
+    parts = path.replace("\\", "/").split("/")
+    return (not _path_is_test(path) and "parallel" in parts
+            and parts[-1].startswith("seqpar"))
+
+
+def _einsum_is_dense_scores(equation: str) -> bool:
+    """True for ``"bqnd,bknd->bnqk"``-shaped equations: each operand
+    contributes exactly one free letter to the output and those two
+    letters are the output's trailing pair — the ``(..., Sq, Sk)`` outer
+    product over two sequence axes, i.e. materialized attention scores.
+    The trailing-pair requirement keeps ``p @ V`` contractions
+    (``"bnqk,bknd->bqnd"``) and plain projections clean."""
+    try:
+        ins, out = equation.replace(" ", "").split("->")
+        a, b = ins.split(",")
+    except ValueError:
+        return False
+    free_a = (set(a) - set(b)) & set(out)
+    free_b = (set(b) - set(a)) & set(out)
+    if len(free_a) != 1 or len(free_b) != 1 or len(out) < 2:
+        return False
+    return set(out[-2:]) == free_a | free_b
+
+
+def _enclosing_function_name(node: ast.AST) -> str:
+    cur = _parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur.name
+        cur = _parent(cur)
+    return ""
+
+
+def check_seqpar_discipline(tree: ast.AST, path: str) -> list[Finding]:
+    """JL024: dense ``(S, S)`` score materialization or unpermuted full-KV
+    gathers inside ``parallel/seqpar``.
+
+    The ring's contract is that no device ever holds more than one
+    sequence chunk of K/V or one chunk-pair tile of scores: KV moves by
+    ``ppermute`` (peer-to-peer, O(local) memory) and scores exist only
+    per hop. Two AST shapes break that contract mechanically:
+
+    - ``jax.lax.all_gather`` — reassembles the full sequence on every
+      device, turning the ring into replicated attention with extra
+      steps (memory scales with S again, exactly what the seq axis was
+      bought to avoid);
+    - a score-shaped ``einsum`` (output carrying a free sequence letter
+      from each operand) outside a per-hop helper (function name
+      containing ``hop``) — at module scope that outer product is the
+      full (S, S) score matrix, not a chunk tile.
+
+    ``tests/lint_fixtures/jimm_tpu/parallel/`` keeps the living fixture."""
+    if not _path_is_seqpar(path):
+        return []
+    imported_gather: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+                "jax.lax", "jax._src.lax.parallel"):
+            for alias in node.names:
+                if alias.name == "all_gather":
+                    imported_gather.add(alias.asname or alias.name)
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        if fname is None:
+            continue
+        leaf = fname.rsplit(".", 1)[-1]
+        if fname in imported_gather or (leaf == "all_gather"
+                                        and fname.endswith("lax.all_gather")):
+            findings.append(Finding(
+                "JL024", ERROR, path, node.lineno,
+                "all_gather inside parallel/seqpar reassembles the full "
+                "KV sequence on every device — per-device memory scales "
+                "with S again, defeating the seq axis. Rotate chunks with "
+                "jax.lax.ppermute (see _rotate), or justify with "
+                "# jaxlint: disable=JL024"))
+            continue
+        if leaf == "einsum" and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str) \
+                and _einsum_is_dense_scores(node.args[0].value) \
+                and "hop" not in _enclosing_function_name(node):
+            findings.append(Finding(
+                "JL024", ERROR, path, node.lineno,
+                "score-shaped einsum outside a per-hop helper "
+                "materializes the dense (S, S) score matrix — seqpar "
+                "scores may only exist one chunk-pair tile at a time "
+                "inside *hop* functions, or justify with "
+                "# jaxlint: disable=JL024"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_all(tree: ast.AST, path: str,
             vmem_budget: int | None = None) -> list[Finding]:
@@ -1470,4 +1581,5 @@ def run_all(tree: ast.AST, path: str,
     findings += check_bare_lowp_cast(tree, path)
     findings += check_cascade_thresholds(tree, path)
     findings += check_profiler_bypass(tree, path)
+    findings += check_seqpar_discipline(tree, path)
     return findings

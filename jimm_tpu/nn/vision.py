@@ -108,12 +108,26 @@ class VisionTower(nnx.Module):
 
     def __call__(self, images: jax.Array) -> jax.Array:
         """(B, H, W, C) images -> pooled (B, width) (or (B, N, width) when
-        ``pooling == "none"``)."""
+        ``pooling == "none"``). Temporal towers (``cfg.num_frames > 1``)
+        take ``(B, T, H, W, C)`` clips: each frame patchifies
+        independently and the tokens flatten into one (B, T*N, width)
+        sequence."""
+        frames = self.cfg.num_frames
+        if frames > 1:
+            if images.ndim != 5 or images.shape[1] != frames:
+                raise ValueError(
+                    f"temporal tower expects (B, {frames}, "
+                    f"{self.cfg.image_size}, {self.cfg.image_size}, C) "
+                    f"clips, got {images.shape}")
+            b = images.shape[0]
+            images = images.reshape((b * frames,) + images.shape[2:])
         if images.shape[1:3] != (self.cfg.image_size, self.cfg.image_size):
             raise ValueError(
                 f"expected {self.cfg.image_size}x{self.cfg.image_size} input "
                 f"images (NHWC), got {images.shape}")
         x = self.patch_embed(images)
+        if frames > 1:
+            x = x.reshape(b, frames * x.shape[1], x.shape[-1])
         if self.cfg.pooling == "cls":
             cls = jnp.broadcast_to(self.cls_token[...],
                                    (x.shape[0], 1, x.shape[-1])).astype(x.dtype)

@@ -75,13 +75,20 @@ def _preset_of(rec: dict) -> str:
 
 
 def row_key(rec: dict) -> str | None:
-    """Stable ``workload/backend/preset[/precision][/attn_impl]`` identity
-    for one row, or None for rows that carry no workload identity at all.
+    """Stable ``workload/backend/preset[/precision][/attn_impl][/seq...]``
+    identity for one row, or None for rows that carry no workload identity
+    at all.
 
     Precision/attn-impl segments append only when the row stamps them
     (bench/train rows since the low-precision fast path landed), so legacy
     rows keep their adopted keys — and a bf16 baseline can never be
-    compared against an fp8 or int8-attention run of the same preset."""
+    compared against an fp8 or int8-attention run of the same preset.
+    ``seq_len``/``seq_parallel`` segment the same way (rows since the
+    sequence-parallel mesh axis landed): an 8-chip ring run of a preset
+    never gates against its single-chip baseline, and a longer-sequence
+    NaFlex/temporal row never gates against the short one. ``seq_parallel``
+    only appends when > 1, so a stamped-but-degenerate run keeps the
+    single-chip key."""
     workload = rec.get("phase") or rec.get("metric")
     if not workload:
         return None
@@ -93,6 +100,12 @@ def row_key(rec: dict) -> str | None:
     attn_impl = rec.get("attn_impl")
     if attn_impl:
         key += f"/{attn_impl}"
+    seq_len = rec.get("seq_len")
+    if seq_len:
+        key += f"/seq{int(seq_len)}"
+    seq_parallel = rec.get("seq_parallel")
+    if seq_parallel and int(seq_parallel) > 1:
+        key += f"/sp{int(seq_parallel)}"
     return key
 
 

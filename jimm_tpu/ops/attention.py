@@ -18,8 +18,11 @@ The reference locks attention to ``flax.nnx.MultiHeadAttention``'s einsum path
   Analysis, and Best Practices for Sigmoid Self-Attention"): the natural
   pairing for SigLIP's sigmoid loss. Supports key-padding masks.
 - ``"ring"`` — sequence-parallel ring attention over the ambient mesh's
-  ``seq`` axis (long context across chips; flash within each chip on TPU).
-  See `jimm_tpu/parallel/ring_attention.py`.
+  ``seq`` axis (long context across chips; flash within each hop on TPU).
+  Key-padding masks ride the rotation. Causal softmax keeps the
+  zigzag-balanced ring in `jimm_tpu/parallel/ring_attention.py`; the
+  masked/sigmoid variants run the shared-carry ring in
+  `jimm_tpu/parallel/seqpar.py`.
 - ``"ulysses"`` — all-to-all sequence parallelism over the same ``seq``
   axis: one head-redistributing all_to_all in, full-sequence local
   attention (flash on TPU), one all_to_all out. Exact causal for free;
@@ -29,9 +32,12 @@ The reference locks attention to ``flax.nnx.MultiHeadAttention``'s einsum path
   ``checkpoint_name`` so the ``"dots+attn"`` remat policy can keep them: the
   remat'd backward then skips the qk^T + softmax recompute at the cost of one
   (B, N, Sq, Sk) bf16 tensor per layer. Only sensible at short sequence.
-- ``"auto"`` — flash on TPU when shapes qualify, else XLA. Key-padding
-  masks route to ``flash_masked`` (instead of silently densifying) and
-  batch-free biases to ``flash_bias``.
+- ``"auto"`` — when the ambient mesh carries a live ``seq`` axis and the
+  shapes divide, route to the sequence-parallel planner (ring vs ulysses
+  by comm cost — `jimm_tpu/parallel/seqpar.py`); otherwise flash on TPU
+  when shapes qualify, else XLA. Key-padding masks route to
+  ``flash_masked`` (instead of silently densifying) and batch-free biases
+  to ``flash_bias``.
 """
 
 from __future__ import annotations
@@ -62,6 +68,28 @@ def _flash_eligible(q: jax.Array, k: jax.Array) -> bool:
     return q.shape[1] >= 512 and k.shape[1] >= 512
 
 
+def _ambient_seq_axis() -> tuple[str, int] | None:
+    """The ambient mesh's sequence-parallel axis, if one is installed and
+    still available: size > 1 and not already consumed by an enclosing
+    ``shard_map`` (a nested manual axis cannot be re-mapped). This is the
+    gate that lets ``impl="auto"`` route to the sequence-parallel schemes
+    exactly when the program runs under a seq-sharded mesh — single-chip
+    programs never pay for the check beyond a mesh lookup."""
+    from jimm_tpu.parallel.sharding import current_rules
+    from jimm_tpu.utils.compat import get_abstract_mesh, manual_axis_names
+    rules = current_rules()
+    axis = (rules.seq if rules is not None and rules.seq else "seq")
+    if not isinstance(axis, str):
+        return None
+    mesh = get_abstract_mesh()
+    if mesh is None or getattr(mesh, "empty", True):
+        return None
+    size = int(dict(getattr(mesh, "shape", {}) or {}).get(axis, 1))
+    if size <= 1 or axis in manual_axis_names(mesh):
+        return None
+    return axis, size
+
+
 def _is_key_padding_mask(mask: jax.Array) -> bool:
     """True for masks the flash family handles natively: per-sample key
     masks shaped ``(B, Sk)`` or the broadcast convention ``(B, 1, 1, Sk)``
@@ -83,6 +111,21 @@ def dot_product_attention(
 ) -> jax.Array:
     """Scaled dot-product attention over (batch, seq, heads, head_dim)."""
     if impl == "auto":
+        # Sequence parallelism first: when the ambient mesh carries a live
+        # seq axis the activations are (or are about to be) sharded along
+        # sequence, so a single-chip kernel would silently all-gather the
+        # full S — route to the seq-parallel schemes instead. Sq != Sk
+        # (e.g. the MAP-pooling 1-row probe) or non-divisible lengths fall
+        # through to the single-chip paths below.
+        sp = (None if bias is not None
+              or (mask is not None and not _is_key_padding_mask(mask))
+              else _ambient_seq_axis())
+        if (sp is not None and q.shape[1] == k.shape[1]
+                and q.shape[1] % sp[1] == 0):
+            from jimm_tpu.parallel.seqpar import seq_parallel_attention
+            return seq_parallel_attention(q, k, v, mask=mask,
+                                          is_causal=is_causal,
+                                          axis_name=sp[0], plan="auto")
         if _default_backend() == "tpu" and _flash_eligible(q, k):
             if bias is not None and mask is None and bias.ndim <= 3:
                 impl = "flash_bias"
@@ -147,23 +190,28 @@ def dot_product_attention(
         from jimm_tpu.ops.flash_attention import sigmoid_attention
         return sigmoid_attention(q, k, v, is_causal=is_causal, mask=mask)
     if impl in ("ring", "ulysses"):
-        if mask is not None or bias is not None:
+        if bias is not None:
             raise ValueError(
-                f"{impl} attention does not support masks or biases — the "
-                "cross-chip exchange has no per-sample mask plumbing. "
-                "Key-padding masks are supported single-chip via "
-                "impl='flash_masked' (or impl='auto'); otherwise use "
-                "is_causal or impl='xla'")
+                f"{impl} attention does not take an additive bias — the "
+                "cross-chip exchange only rotates per-sample key-padding "
+                "rows; use impl='flash_bias' single-chip or impl='xla'")
+        if mask is not None and not _is_key_padding_mask(mask):
+            raise ValueError(
+                f"{impl} attention supports key-padding masks only "
+                f"((B, Sk) or (B, 1, 1, Sk)); got {tuple(mask.shape)} — "
+                "arbitrary masks need impl='xla'")
         from jimm_tpu.parallel.sharding import current_rules
         rules = current_rules()
         axis = (rules.seq if rules is not None and rules.seq else "seq")
-        if impl == "ring":
+        if impl == "ring" and is_causal and mask is None:
+            # causal softmax keeps the zigzag-balanced ring (exact causal
+            # skipping); the seqpar ring is the masked/sigmoid generalist
             from jimm_tpu.parallel.ring_attention import ring_attention
             return ring_attention(q, k, v, axis_name=axis,
-                                  is_causal=is_causal, impl="auto")
-        from jimm_tpu.parallel.ulysses import ulysses_attention
-        return ulysses_attention(q, k, v, axis_name=axis,
-                                 is_causal=is_causal, impl="auto")
+                                  is_causal=True, impl="auto")
+        from jimm_tpu.parallel.seqpar import seq_parallel_attention
+        return seq_parallel_attention(q, k, v, mask=mask, axis_name=axis,
+                                      is_causal=is_causal, plan=impl)
     if impl == "xla":
         return jax.nn.dot_product_attention(q, k, v, bias=bias, mask=mask,
                                             is_causal=is_causal)

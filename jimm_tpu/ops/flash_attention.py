@@ -1027,3 +1027,34 @@ def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
                                                        block_k)
     o3, lse3 = _flash_lse(q3, k3, v3, is_causal, sm_scale, block_q, block_k)
     return _unflatten_heads(o3, b, n)[..., :d], lse3.reshape(b, n, sq)
+
+
+# ---------------------------------------------------------------------------
+# External-residual hop entry points — the sequence-parallel ring
+# (`jimm_tpu/parallel/seqpar.py`) drives the SAME kernels per KV hop
+# ---------------------------------------------------------------------------
+
+def ring_hop_fwd(q3, k3, v3, maskadd, spec, sm_scale, logit_bias,
+                 block_q, block_k):
+    """One ring-hop forward in flattened-heads ``(B*N, S, D)`` space:
+    returns ``(o, lse)`` for the hop's local (q × visiting-KV) product
+    (``lse`` is None for the sigmoid kind, which keeps no normalizer).
+    The caller owns the cross-hop merge and differentiation — this is a
+    plain function, not a custom_vjp."""
+    o, res = _flash_fwd_impl(q3, k3, v3, maskadd, None, False, spec,
+                             sm_scale, logit_bias, block_q, block_k)
+    return o, res[6]
+
+
+def ring_hop_bwd(q3, k3, v3, maskadd, o3, lse3, do3, spec, sm_scale,
+                 logit_bias, block_q, block_k):
+    """One ring-hop backward against GLOBAL residuals: ``o3``/``lse3`` are
+    the fully-merged output and logsumexp (all chunks folded), so the
+    kernels' ``p = exp(s - lse)`` and ``delta = rowsum(do·o)`` are the
+    global row statistics and the per-hop dq/dk/dv are exact partial
+    gradients — summing them over hops reproduces the unsharded backward.
+    (Sigmoid ignores o3/lse3: no normalizer, no delta.)"""
+    dq, dk, dv, _, _ = _flash_bwd(False, spec, sm_scale, logit_bias,
+                                  block_q, block_k,
+                                  (q3, k3, v3, maskadd, None, o3, lse3), do3)
+    return dq, dk, dv

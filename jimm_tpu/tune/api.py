@@ -30,7 +30,7 @@ from jimm_tpu.tune.space import (bias_flash_space, flash_space,
                                  fp8_matmul_space, int8_flash_space,
                                  int8_matmul_space, ivf_space, ln_space,
                                  masked_flash_space, retrieval_space,
-                                 sigmoid_space, tier_space)
+                                 ring_space, sigmoid_space, tier_space)
 
 __all__ = ["KERNELS", "KernelSpec", "best_config", "configure", "get_cache",
            "tune_kernel"]
@@ -93,6 +93,34 @@ def _masked_flash_bench(shapes: Shapes, dtypes: Dtypes,
     from jimm_tpu.ops.flash_attention import flash_attention_masked
     q, k, v = _attn_qkv(shapes, dtypes)
     b, sk = q.shape[0], k.shape[1]
+    mask = (jax.random.uniform(jax.random.PRNGKey(1), (b, sk)) > 0.25)
+    mask = mask.at[:, 0].set(True)
+    bq, bk = int(config["block_q"]), int(config["block_k"])
+
+    def loss(q, k, v):
+        o = flash_attention_masked(q, k, v, mask, block_q=bq, block_k=bk)
+        return jnp.sum(o.astype(jnp.float32))
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return lambda: step(q, k, v)
+
+
+def _ring_bench(shapes: Shapes, dtypes: Dtypes,
+                config: Mapping[str, int]) -> Callable[[], Any]:
+    """Timed closure for the sequence-parallel ring's per-hop kernel.
+    ``shapes`` are the LOCAL chunk shapes ``(B, S/p, N, D)`` — the blocks
+    only govern the per-hop flash call (`seqpar.ring_hop_fwd`/`_bwd`,
+    which is the masked single-chip product over one chunk), so benching
+    masked flash at chunk shape measures exactly what the config
+    controls; the ppermute schedule is block-independent. Explicit block
+    kwargs bypass the tuner — no recursion."""
+    import jax
+    import jax.numpy as jnp
+
+    from jimm_tpu.ops.flash_attention import flash_attention_masked
+    q, k, v = _attn_qkv(shapes, dtypes)
+    b, sk = q.shape[0], k.shape[1]
+    # the ring's traveling mask rows look like NaFlex padding per chunk
     mask = (jax.random.uniform(jax.random.PRNGKey(1), (b, sk)) > 0.25)
     mask = mask.at[:, 0].set(True)
     bq, bk = int(config["block_q"]), int(config["block_k"])
@@ -427,6 +455,11 @@ KERNELS: dict[str, KernelSpec] = {
     "fp8_matmul": KernelSpec(version=1, space=fp8_matmul_space,
                              default=_fp8_matmul_default,
                              bench=_fp8_matmul_bench),
+    # keyed on the per-device LOCAL chunk shapes (B, S/p, N, D) — see
+    # parallel/seqpar.py::_resolve_ring_blocks
+    "ring_attention": KernelSpec(version=1, space=ring_space,
+                                 default=_flash_default,
+                                 bench=_ring_bench),
 }
 
 

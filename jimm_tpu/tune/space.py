@@ -22,8 +22,9 @@ __all__ = ["FLASH_BLOCKS", "FP8_MATMUL_BLOCK_M", "FP8_MATMUL_BLOCK_N",
            "int8_matmul_vmem_bytes", "ivf_space", "ivf_vmem_bytes",
            "kernel_space", "ln_space",
            "ln_vmem_bytes", "masked_flash_space", "masked_flash_vmem_bytes",
-           "retrieval_space", "retrieval_vmem_bytes", "sigmoid_space",
-           "sigmoid_vmem_bytes", "tier_space"]
+           "retrieval_space", "retrieval_vmem_bytes", "ring_space",
+           "ring_vmem_bytes", "sigmoid_space", "sigmoid_vmem_bytes",
+           "tier_space"]
 
 _LANES = 128
 _SUBLANES = 8
@@ -128,6 +129,28 @@ def sigmoid_space(shapes: Sequence[Sequence[int]],
                   dtypes: Sequence[Any] = ()) -> list[dict]:
     """Candidates for sigmoid attention (no-normalizer online loop)."""
     return _attn_space(shapes, sigmoid_vmem_bytes)
+
+
+def ring_vmem_bytes(block_q: int, block_k: int, d: int) -> int:
+    """Per-hop cell of the sequence-parallel ring
+    (`parallel/seqpar.py`): each hop IS a masked softmax flash call over
+    the local chunk (the traveling key-padding row resident like the
+    single-chip masked variant), so the hop's VMEM model is the masked
+    formula — the ring adds HBM-resident chunk buffers, not VMEM
+    (mirrors ``kind='softmax', has_mask=True`` in
+    ``_per_head_vmem_bytes``; sync-tested)."""
+    return masked_flash_vmem_bytes(block_q, block_k, d)
+
+
+def ring_space(shapes: Sequence[Sequence[int]],
+               dtypes: Sequence[Any] = ()) -> list[dict]:
+    """Feasible ``{"block_q", "block_k"}`` candidates for ONE ring hop.
+    ``shapes`` are the per-device LOCAL chunk shapes ``(B, S/p, N, D)`` —
+    the key the wrapper resolves under (`seqpar._resolve_ring_blocks`):
+    the hop kernel never sees more than a chunk, so candidates larger
+    than the 128-padded chunk are redundant exactly like the single-chip
+    clamp."""
+    return _attn_space(shapes, ring_vmem_bytes)
 
 
 def ln_vmem_bytes(block_rows: int, features: int) -> int:
@@ -371,7 +394,8 @@ _SPACES = {"flash_attention": flash_space,
            "retrieval_tier": tier_space,
            "int8_matmul": int8_matmul_space,
            "fp8_matmul": fp8_matmul_space,
-           "flash_attention_int8": int8_flash_space}
+           "flash_attention_int8": int8_flash_space,
+           "ring_attention": ring_space}
 
 
 def kernel_space(kernel: str, shapes: Sequence[Sequence[int]],

@@ -77,8 +77,12 @@ def build_engine(args, qos=None):
         quantize_model(model)
     method = "encode_image" if fam in ("clip", "siglip") else "__call__"
     size = cfg.vision.image_size
+    # row-identity stash for the ledger stamps (every row carries
+    # seq_len/seq_parallel — obs/baseline.py::row_key segments on them)
+    args._seq_len = int(cfg.vision.seq_len)
     plan = plan_topology(getattr(args, "replicas", None),
-                         getattr(args, "model_parallel", None))
+                         getattr(args, "model_parallel", None),
+                         getattr(args, "seq_parallel", None))
     if plan.is_trivial:
         forward, traces = counting_forward(model, method)
     else:
@@ -259,6 +263,8 @@ def bench_tenants(args) -> tuple[dict, str | None]:
         "n_devices": jax.device_count(),
         "replicas": plan.replicas,
         "model_parallel": plan.model_parallel,
+        "seq_parallel": plan.seq_parallel,
+        "seq_len": getattr(args, "_seq_len", None),
     }
     error = None
     if rec["compile_count_delta"]:
@@ -427,6 +433,8 @@ def bench_cascade(args) -> tuple[dict, str | None]:
         "n_devices": jax.device_count(),
         "replicas": 1,
         "model_parallel": 1,
+        "seq_parallel": 1,
+        "seq_len": int(cfg.vision.seq_len),
     }
     error = None
     if compile_delta:
@@ -528,6 +536,8 @@ def bench_cold_start(args) -> dict:
         "n_devices": jax.device_count(),
         "replicas": 1,
         "model_parallel": 1,
+        "seq_parallel": 1,
+        "seq_len": int(cfg.vision.seq_len),
     }
 
 
@@ -548,7 +558,8 @@ def bench_search(args) -> tuple[list[dict], str | None]:
     from jimm_tpu.serve import plan_topology
 
     on_tpu = jax.default_backend() == "tpu"
-    plan = plan_topology(args.replicas, args.model_parallel)
+    plan = plan_topology(args.replicas, args.model_parallel,
+                         getattr(args, "seq_parallel", None))
     dim = args.dim or (512 if on_tpu else 64)
     sizes = [int(s) for s in args.corpus_sizes.split(",")]
     clients = args.clients
@@ -665,6 +676,9 @@ def bench_search(args) -> tuple[list[dict], str | None]:
             "n_devices": plan.n_devices,
             "replicas": plan.replicas,
             "model_parallel": plan.model_parallel,
+            # synthetic index, no model: seq_parallel still stamps (the
+            # searcher rides the plan's meshes) but there is no seq_len
+            "seq_parallel": plan.seq_parallel,
         })
         if error is None and done != total:
             error = f"corpus {n}: only {done}/{total} searches completed"
@@ -703,6 +717,11 @@ def main() -> int:
                         "submesh and executor thread)")
     p.add_argument("--model-parallel", type=int, default=1,
                    help="devices per replica the model is sharded over")
+    p.add_argument("--seq-parallel", type=int, default=1,
+                   help="sequence-parallel ways per replica: attention "
+                        "runs ring/ulysses across a seq mesh axis "
+                        "(docs/performance.md); stamped in every ledger "
+                        "row so obs-regress keys segment on it")
     p.add_argument("--tenants", default=None,
                    metavar="NAME=CLASS:N,...",
                    help='mixed-tenant QoS workload, e.g. '
@@ -879,6 +898,8 @@ def main() -> int:
         "n_devices": plan.n_devices,
         "replicas": plan.replicas,
         "model_parallel": plan.model_parallel,
+        "seq_parallel": plan.seq_parallel,
+        "seq_len": getattr(args, "_seq_len", None),
     }
     if getattr(engine, "_multi", False):
         rec["replica_dispatch"] = [r["dispatched"]

@@ -1,0 +1,45 @@
+"""JL024 living fixture: sequence-parallel discipline violations.
+
+Only linted when named explicitly from tests/test_lint.py — the path is
+shaped like the real module (``parallel/seqpar*``) so the rule's scope
+check fires, but lives under lint_fixtures so directory walks skip it.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.lax import all_gather
+
+
+def gather_full_kv(k, axis_name):
+    # reassembles the whole KV sequence on every device — ring defeated
+    return all_gather(k, axis_name, axis=1, tiled=True)
+
+
+def gather_full_kv_dotted(v, axis_name):
+    return jax.lax.all_gather(v, axis_name, axis=1, tiled=True)
+
+
+def dense_scores(q, k, sm_scale):
+    # full (S, S) outer product outside any per-hop helper
+    return jnp.einsum("bqnd,bknd->bnqk", q, k) * sm_scale
+
+
+def _hop_scores_ok(q, kj):
+    # same equation, sanctioned site: one chunk-pair tile per hop
+    return jnp.einsum("bqnd,bknd->bnqk", q, kj)
+
+
+def rotate_ok(k, axis_name, perm):
+    # ppermute is the sanctioned KV-movement primitive
+    return jax.lax.ppermute(k, axis_name, perm)
+
+
+def project_ok(x, w):
+    # a contraction, not an outer product over two sequence axes
+    return jnp.einsum("bsnd,ndh->bsh", x, w)
+
+
+def deliberate_gather(mask, axis_name):
+    # justified gather stays clean
+    return jax.lax.all_gather(  # jaxlint: disable=JL024 tiny bool mask, O(S) bytes
+        mask, axis_name, tiled=True)

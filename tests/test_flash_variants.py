@@ -313,11 +313,17 @@ class TestDispatch:
         with pytest.raises(ValueError, match="key-padding masks only"):
             dot_product_attention(q, k, v, mask=full, impl="flash")
 
-    def test_ring_mask_rejection_names_masked_flash(self, rng):
+    def test_ring_masked_needs_mesh_dense_mask_names_xla(self, rng):
+        # key-padding masks are now first-class on the seqpar ring — but
+        # an explicit impl="ring" still demands a seq mesh to run on
         q, k, v = qkv(rng, s=16)
         mask = jnp.ones((2, 1, 1, 16), bool)
-        with pytest.raises(ValueError, match="flash_masked"):
+        with pytest.raises(ValueError, match="mesh"):
             dot_product_attention(q, k, v, mask=mask, impl="ring")
+        # arbitrary dense masks stay rejected, pointing at impl="xla"
+        full = jnp.ones((2, 2, 16, 16), bool)
+        with pytest.raises(ValueError, match="xla"):
+            dot_product_attention(q, k, v, mask=full, impl="ring")
 
     def test_flash_masked_requires_mask(self, rng):
         q, k, v = qkv(rng, s=16)

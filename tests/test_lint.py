@@ -484,6 +484,45 @@ class TestRuleFixtures:
         assert check_profiler_bypass(
             tree, "tests/test_profile.py") == []
 
+    def test_jl024_seqpar_discipline(self):
+        findings = findings_for("parallel/seqpar_bad.py")
+        assert rules_and_lines(findings) == {
+            ("JL024", 15),  # all_gather — from-import spelling
+            ("JL024", 19),  # jax.lax.all_gather on the KV chunk
+            ("JL024", 24),  # dense (S, S) score einsum outside a hop fn
+        }
+        assert all(f.severity == ERROR for f in findings)
+        assert any("ppermute" in f.message for f in findings)
+        # the per-hop tile (_hop_scores_ok), the sanctioned ppermute, the
+        # projection einsum, and the justified mask gather all stay clean
+
+    def test_jl024_scoped_to_seqpar_modules(self):
+        import ast
+
+        from jimm_tpu.lint.rules_ast import check_seqpar_discipline
+        src = "import jax\nx = jax.lax.all_gather(k, 'seq')\n"
+        tree = ast.parse(src)
+        assert check_seqpar_discipline(
+            tree, "jimm_tpu/parallel/seqpar.py") != []
+        # the zigzag ring module and the ring losses gather on purpose
+        # (loss terms, not KV) — only seqpar* carries the contract
+        assert check_seqpar_discipline(
+            tree, "jimm_tpu/parallel/ring_attention.py") == []
+        assert check_seqpar_discipline(
+            tree, "jimm_tpu/train/losses.py") == []
+        assert check_seqpar_discipline(
+            tree, "tests/test_seqpar.py") == []
+
+    def test_jl024_pv_contraction_not_score_shaped(self):
+        from jimm_tpu.lint.rules_ast import _einsum_is_dense_scores
+        assert _einsum_is_dense_scores("bqnd,bknd->bnqk")
+        assert _einsum_is_dense_scores("bqd,bkd->bqk")
+        # p @ V, grad contractions, and projections are contractions over
+        # one of the two sequence axes — not materialized scores
+        assert not _einsum_is_dense_scores("bnqk,bknd->bqnd")
+        assert not _einsum_is_dense_scores("bnqk,bqnd->bknd")
+        assert not _einsum_is_dense_scores("bsnd,ndh->bsh")
+
     def test_clean_counterexamples_and_suppression(self):
         # guarded config, canonical specs, static branches, and both
         # same-line and next-line `# jaxlint: disable=` forms: no findings
